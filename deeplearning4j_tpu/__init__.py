@@ -1,0 +1,24 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A ground-up, idiomatic JAX/XLA/Pallas re-design with the capabilities of the
+Deeplearning4j ecosystem (reference fork: shimdakyum/deeplearning4j).  The
+reference's op-by-op interpreted execution (ND4J -> JNI -> libnd4j CUDA
+kernels) is replaced by declare-then-compile whole-step `jax.jit` programs;
+its Aeron-based gradient sharing is replaced by XLA collectives over ICI/DCN
+via `jax.sharding` meshes.
+
+Package layout (see SURVEY.md §7):
+  ops/       op inventory (activations, losses, inits, linalg, pallas kernels)
+  nn/        layer-config NN API (MultiLayerNetwork / ComputationGraph)
+  graph/     SameDiff-equivalent declare-then-compile graph engine
+  train/     updaters, schedules, listeners, evaluation, early stopping
+  data/      DataVec-equivalent record readers, transforms, iterators
+  parallel/  device meshes, DP/TP/PP/SP sharded training, ParallelWrapper
+  models/    model zoo (LeNet, ResNet, VGG, BERT, LSTM char-LM, ...)
+  utils/     serialization (ModelSerializer), profiling, config
+  runtime/   native (C++) host-side runtime components
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.utils.config import Config, get_config  # noqa: F401

@@ -1,0 +1,4 @@
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet  # noqa: F401
+from deeplearning4j_tpu.data.iterators import (  # noqa: F401
+    ArrayDataSetIterator, AsyncDataSetIterator, DataSetIterator,
+    ListDataSetIterator)

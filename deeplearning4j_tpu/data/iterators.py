@@ -1,0 +1,129 @@
+"""DataSetIterator implementations.
+
+Reference: `DataSetIterator`/`MultiDataSetIterator` interfaces and the
+stock iterators (`nd4j-api/.../dataset/api/iterator/**`,
+`deeplearning4j-core/.../datasets/iterator/**`): ListDataSetIterator,
+ExistingDataSetIterator, IteratorDataSetIterator, AsyncDataSetIterator
+(background-thread prefetch).
+
+The async iterator reproduces `AsyncDataSetIterator`'s role — overlap host
+ETL with device compute — using a daemon thread + bounded queue.  On TPU the
+jitted step's dispatch is already async, so a queue depth of 2 suffices to
+keep the chip fed.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+class DataSetIterator:
+    """Iterator protocol (reference `DataSetIterator`): iterable over
+    DataSet batches, with `reset()`, `batch_size()`, `total_examples()`."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+    def batch_size(self) -> int:
+        raise NotImplementedError
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate a pre-built list of DataSets (reference
+    `ListDataSetIterator`)."""
+
+    def __init__(self, datasets: Sequence[DataSet], batch_size: Optional[int] = None):
+        if batch_size is not None and len(datasets) == 1:
+            datasets = datasets[0].batch_by(batch_size)
+        self._list: List[DataSet] = list(datasets)
+        self._bs = batch_size or (self._list[0].num_examples() if self._list else 0)
+
+    def __iter__(self):
+        return iter(self._list)
+
+    def __len__(self):
+        return len(self._list)
+
+    def batch_size(self) -> int:
+        return self._bs
+
+
+class ArrayDataSetIterator(DataSetIterator):
+    """Batch plain (features, labels) arrays, with optional shuffling per
+    epoch (the common `new ListDataSetIterator<>(dataSet.batchBy(n))`
+    pattern)."""
+
+    def __init__(self, features: np.ndarray, labels: np.ndarray,
+                 batch_size: int, shuffle: bool = False, seed: int = 0,
+                 drop_last: bool = False):
+        self.features = np.asarray(features)
+        self.labels = np.asarray(labels)
+        self._bs = int(batch_size)
+        self._shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+        self._drop_last = drop_last
+
+    def __iter__(self):
+        n = self.features.shape[0]
+        idx = self._rng.permutation(n) if self._shuffle else np.arange(n)
+        end = (n // self._bs) * self._bs if self._drop_last else n
+        for i in range(0, end, self._bs):
+            sl = idx[i:i + self._bs]
+            yield DataSet(self.features[sl], self.labels[sl])
+
+    def __len__(self):
+        n = self.features.shape[0]
+        return n // self._bs if self._drop_last else -(-n // self._bs)
+
+    def batch_size(self) -> int:
+        return self._bs
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-prefetch wrapper (reference `AsyncDataSetIterator`,
+    `deeplearning4j-core/.../datasets/iterator/AsyncDataSetIterator.java`):
+    a daemon thread pulls from the underlying iterator into a bounded queue
+    so host-side ETL overlaps device compute."""
+
+    _END = object()
+
+    def __init__(self, underlying: DataSetIterator, queue_size: int = 2):
+        self.underlying = underlying
+        self.queue_size = queue_size
+
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
+        err: List[BaseException] = []
+
+        def producer():
+            try:
+                for ds in self.underlying:
+                    q.put(ds)
+            except BaseException as e:  # propagate to consumer
+                err.append(e)
+            finally:
+                q.put(self._END)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is self._END:
+                if err:
+                    raise err[0]
+                return
+            yield item
+
+    def reset(self):
+        self.underlying.reset()
+
+    def batch_size(self) -> int:
+        return self.underlying.batch_size()

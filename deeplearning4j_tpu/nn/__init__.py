@@ -1,0 +1,31 @@
+"""NN layer-config API (DL4J-nn equivalent)."""
+from deeplearning4j_tpu.nn.core import InputType, Layer  # noqa: F401
+from deeplearning4j_tpu.nn.layers import (  # noqa: F401
+    ActivationLayer, BatchNormalizationLayer, Convolution1DLayer,
+    ConvolutionLayer, Deconvolution2DLayer, DenseLayer,
+    DepthwiseConvolution2DLayer, DropoutLayer, ElementWiseMultiplicationLayer,
+    EmbeddingLayer, EmbeddingSequenceLayer, GlobalPoolingLayer,
+    LayerNormalizationLayer, LocalResponseNormalizationLayer, LossLayer,
+    OutputLayer, SeparableConvolution2DLayer, SubsamplingLayer,
+    Upsampling2DLayer, ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.multilayer import (  # noqa: F401
+    MultiLayerConfiguration, MultiLayerNetwork, NeuralNetConfiguration)
+
+_LAYER_CLASSES = [
+    ActivationLayer, BatchNormalizationLayer, Convolution1DLayer,
+    ConvolutionLayer, Deconvolution2DLayer, DenseLayer,
+    DepthwiseConvolution2DLayer, DropoutLayer, ElementWiseMultiplicationLayer,
+    EmbeddingLayer, EmbeddingSequenceLayer, GlobalPoolingLayer,
+    LayerNormalizationLayer, LocalResponseNormalizationLayer, LossLayer,
+    OutputLayer, SeparableConvolution2DLayer, SubsamplingLayer,
+    Upsampling2DLayer, ZeroPaddingLayer,
+]
+
+# Name -> class registry for config JSON round-trip (the reference's Jackson
+# @JsonTypeInfo role). Recurrent/attention layers register on import.
+LAYER_REGISTRY = {c.__name__: c for c in _LAYER_CLASSES}
+
+
+def register_layer(cls):
+    LAYER_REGISTRY[cls.__name__] = cls
+    return cls

@@ -1,0 +1,160 @@
+"""Layer-config NN API core.
+
+TPU-native re-design of the reference's DL4J-nn config layer
+(`deeplearning4j-nn/.../nn/conf/**`, `nn/layers/**`): layer *configs* are
+lightweight dataclasses; parameters live in a jax pytree keyed by layer name;
+forward/backward is one traced function compiled by XLA.  The reference's
+hand-managed workspace choreography (WS_LAYER_WORKING_MEM etc.,
+`MultiLayerNetwork.java`) is intentionally absent — XLA buffer assignment
+owns activation memory when the whole step is jitted.
+
+Data layout is NHWC / HWIO (TPU-native), not the reference's NCHW default;
+the Keras/TF importers transpose at the boundary.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.activations import get_activation
+from deeplearning4j_tpu.ops.initializers import init_weights
+
+PyTree = Any
+
+
+# ---------------------------------------------------------------------------
+# InputType — mirrors org.deeplearning4j.nn.conf.inputs.InputType
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class InputType:
+    """Shape metadata (without batch dim) used for layer shape inference,
+    replacing `InputType.feedForward/convolutional/recurrent` and the
+    auto-added InputPreProcessors."""
+
+    kind: str           # "feedforward" | "convolutional" | "recurrent"
+    shape: Tuple[int, ...]
+
+    @staticmethod
+    def feed_forward(size: int) -> "InputType":
+        return InputType("feedforward", (int(size),))
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputType":
+        # NHWC without batch: (H, W, C)
+        return InputType("convolutional", (int(height), int(width), int(channels)))
+
+    @staticmethod
+    def recurrent(size: int, timesteps: Optional[int] = None) -> "InputType":
+        # (T, F) without batch; T may be None (dynamic padded length)
+        return InputType("recurrent", (timesteps if timesteps is None else int(timesteps), int(size)))
+
+    def flat_size(self) -> int:
+        n = 1
+        for s in self.shape:
+            if s is None:
+                raise ValueError("Cannot flatten dynamic dimension")
+            n *= s
+        return n
+
+    def to_json(self) -> dict:
+        return {"kind": self.kind, "shape": list(self.shape)}
+
+    @staticmethod
+    def from_json(d: dict) -> "InputType":
+        return InputType(d["kind"], tuple(d["shape"]))
+
+
+# ---------------------------------------------------------------------------
+# Layer base
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(kw_only=True)
+class Layer:
+    """Base layer config — the `org.deeplearning4j.nn.conf.layers.Layer`
+    equivalent.  Subclasses implement `initialize` (params + output InputType)
+    and `apply` (pure forward).
+
+    Per-layer hyperparameters override the global defaults set on
+    `NeuralNetConfiguration` (same precedence as the reference's
+    `BaseLayer.Builder` overrides).
+    """
+
+    name: Optional[str] = None
+    activation: Optional[Any] = None          # name or callable
+    weight_init: Optional[str] = None         # WeightInit scheme name
+    bias_init: float = 0.0
+    updater: Optional[Any] = None             # per-layer IUpdater override
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    weight_decay: Optional[float] = None
+    dropout: Optional[float] = None           # RETAIN probability (reference semantics)
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: float = 1.0
+    frozen: bool = False                      # transfer-learning freeze
+
+    # param keys subject to l1/l2/weight-decay (biases excluded, ref default)
+    REGULARIZABLE: Tuple[str, ...] = ("W",)
+    # does this layer carry non-trainable state (e.g. BN running stats)?
+    HAS_STATE: bool = False
+    # does apply() consume an rng in train mode (dropout etc.)?
+    STOCHASTIC: bool = False
+
+    def initialize(self, rng: jax.Array, input_type: InputType,
+                   dtype=jnp.float32) -> Tuple[PyTree, PyTree, InputType]:
+        """Returns (params, state, output_type)."""
+        raise NotImplementedError
+
+    def apply(self, params: PyTree, state: PyTree, x: jnp.ndarray, *,
+              train: bool = False, rng: Optional[jax.Array] = None,
+              mask: Optional[jnp.ndarray] = None
+              ) -> Tuple[jnp.ndarray, PyTree]:
+        """Returns (output, new_state)."""
+        raise NotImplementedError
+
+    # ---- config resolution helpers ----
+    def act_fn(self, default="identity"):
+        return get_activation(self.activation if self.activation is not None else default)
+
+    def winit(self, default="XAVIER") -> str:
+        return self.weight_init if self.weight_init is not None else default
+
+    def maybe_input_dropout(self, x, train, rng):
+        """Reference semantics: `dropOut` on a layer config drops the layer
+        *input* (IDropout applied in `BaseLayer.applyDropOutIfNecessary`)."""
+        if not train or self.dropout is None or self.dropout >= 1.0 or rng is None:
+            return x
+        p = self.dropout  # retain probability
+        keep = jax.random.bernoulli(rng, p, x.shape)
+        return jnp.where(keep, x / p, 0.0)
+
+    # ---- JSON round-trip ----
+    def to_json(self) -> dict:
+        from deeplearning4j_tpu.train.updaters import IUpdater
+        d = {}
+        for f in dataclasses.fields(self):
+            if f.name in ("REGULARIZABLE", "HAS_STATE", "STOCHASTIC"):
+                continue
+            v = getattr(self, f.name)
+            if isinstance(v, IUpdater):
+                v = v.to_json()
+            if callable(v) and not isinstance(v, str):
+                v = getattr(v, "__name__", str(v))
+            d[f.name] = v
+        d["@layer"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_json(d: dict) -> "Layer":
+        from deeplearning4j_tpu.nn import LAYER_REGISTRY
+        from deeplearning4j_tpu.train.updaters import IUpdater
+        d = dict(d)
+        cls = LAYER_REGISTRY[d.pop("@layer")]
+        if isinstance(d.get("updater"), dict):
+            d["updater"] = IUpdater.from_json(d["updater"])
+        field_names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in field_names})
+

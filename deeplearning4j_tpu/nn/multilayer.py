@@ -1,0 +1,473 @@
+"""MultiLayerNetwork: sequential-stack model with a compiled train step.
+
+Reference: `deeplearning4j-nn/.../nn/multilayer/MultiLayerNetwork.java` (~4k
+LoC) plus the config DSL `NeuralNetConfiguration.Builder` ->
+`MultiLayerConfiguration` (`nn/conf/**`) and the optimize loop
+`Solver`/`StochasticGradientDescent`/`BaseOptimizer`
+(`optimize/solvers/**`).
+
+Architectural inversion (SURVEY.md §7): the reference runs layer-by-layer
+`activate()`/`backpropGradient()` with hand-choreographed workspaces and an
+in-place flattened `gradientView`; here `fit()` traces ONE pure function
+(forward + loss + `jax.grad` + updater) and `jax.jit` compiles it, donating
+params/updater-state buffers so XLA reuses HBM in place.  Parameter-averaging
+/ gradient-sharing DP becomes a sharding annotation on the same step
+(see parallel/).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.core import InputType, Layer, PyTree
+from deeplearning4j_tpu.train.updaters import (
+    IUpdater, Sgd, apply_gradient_normalization)
+
+Params = Dict[str, PyTree]
+
+
+# ---------------------------------------------------------------------------
+# Configuration
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class MultiLayerConfiguration:
+    """Sequential config (reference `MultiLayerConfiguration`): ordered layer
+    configs + global defaults. JSON round-trip is a public contract
+    (checkpoints embed it, `MultiLayerConfiguration.toJson/fromJson`)."""
+
+    layers: List[Layer]
+    input_type: InputType
+    seed: int = 0
+    updater: IUpdater = dataclasses.field(default_factory=lambda: Sgd(1e-2))
+    weight_init: str = "XAVIER"
+    activation: Any = "identity"
+    l1: float = 0.0
+    l2: float = 0.0
+    weight_decay: float = 0.0
+    dtype: str = "float32"
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: float = 1.0
+
+    def layer_name(self, i: int) -> str:
+        return self.layers[i].name or f"layer_{i}"
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "format": "deeplearning4j_tpu.MultiLayerConfiguration.v1",
+            "layers": [l.to_json() for l in self.layers],
+            "input_type": self.input_type.to_json(),
+            "seed": self.seed,
+            "updater": self.updater.to_json(),
+            "weight_init": self.weight_init,
+            "activation": self.activation if isinstance(self.activation, str)
+                          else getattr(self.activation, "__name__", "identity"),
+            "l1": self.l1, "l2": self.l2, "weight_decay": self.weight_decay,
+            "dtype": self.dtype,
+            "gradient_normalization": self.gradient_normalization,
+            "gradient_normalization_threshold": self.gradient_normalization_threshold,
+        }, indent=2)
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        d = json.loads(s)
+        return MultiLayerConfiguration(
+            layers=[Layer.from_json(l) for l in d["layers"]],
+            input_type=InputType.from_json(d["input_type"]),
+            seed=d["seed"],
+            updater=IUpdater.from_json(d["updater"]),
+            weight_init=d["weight_init"],
+            activation=d["activation"],
+            l1=d["l1"], l2=d["l2"], weight_decay=d.get("weight_decay", 0.0),
+            dtype=d.get("dtype", "float32"),
+            gradient_normalization=d.get("gradient_normalization"),
+            gradient_normalization_threshold=d.get("gradient_normalization_threshold", 1.0),
+        )
+
+
+class NeuralNetConfiguration:
+    """Fluent builder mirroring `NeuralNetConfiguration.Builder` ->
+    `.list()` -> `.build()`."""
+
+    class Builder:
+        def __init__(self):
+            self._seed = 0
+            self._updater: IUpdater = Sgd(1e-2)
+            self._weight_init = "XAVIER"
+            self._activation: Any = "identity"
+            self._l1 = 0.0
+            self._l2 = 0.0
+            self._weight_decay = 0.0
+            self._dtype = "float32"
+            self._grad_norm = None
+            self._grad_norm_threshold = 1.0
+            self._input_type: Optional[InputType] = None
+
+        def seed(self, s: int):
+            self._seed = int(s); return self
+
+        def updater(self, u: IUpdater):
+            self._updater = u; return self
+
+        def weight_init(self, w: str):
+            self._weight_init = w; return self
+
+        def activation(self, a):
+            self._activation = a; return self
+
+        def l1(self, v: float):
+            self._l1 = float(v); return self
+
+        def l2(self, v: float):
+            self._l2 = float(v); return self
+
+        def weight_decay(self, v: float):
+            self._weight_decay = float(v); return self
+
+        def dtype(self, dt: str):
+            self._dtype = dt; return self
+
+        def gradient_normalization(self, mode: str, threshold: float = 1.0):
+            self._grad_norm = mode; self._grad_norm_threshold = threshold; return self
+
+        def set_input_type(self, it: InputType):
+            self._input_type = it; return self
+
+        def list(self, layers: Sequence[Layer]) -> "NeuralNetConfiguration.ListBuilder":
+            return NeuralNetConfiguration.ListBuilder(self, list(layers))
+
+    class ListBuilder:
+        def __init__(self, parent: "NeuralNetConfiguration.Builder", layers: List[Layer]):
+            self.parent = parent
+            self.layers = layers
+
+        def set_input_type(self, it: InputType):
+            self.parent._input_type = it; return self
+
+        def build(self) -> MultiLayerConfiguration:
+            p = self.parent
+            if p._input_type is None:
+                raise ValueError("set_input_type(...) is required (shape inference)")
+            return MultiLayerConfiguration(
+                layers=self.layers, input_type=p._input_type, seed=p._seed,
+                updater=p._updater, weight_init=p._weight_init,
+                activation=p._activation, l1=p._l1, l2=p._l2,
+                weight_decay=p._weight_decay, dtype=p._dtype,
+                gradient_normalization=p._grad_norm,
+                gradient_normalization_threshold=p._grad_norm_threshold,
+            )
+
+    @staticmethod
+    def builder() -> "NeuralNetConfiguration.Builder":
+        return NeuralNetConfiguration.Builder()
+
+
+# ---------------------------------------------------------------------------
+# Network
+# ---------------------------------------------------------------------------
+
+class MultiLayerNetwork:
+    """Sequential network (reference `MultiLayerNetwork`).
+
+    Public surface parity: `init`, `fit(x, y | iterator)`, `output`,
+    `feed_forward`, `score`, `evaluate`, `params`/`set_params` (flat-buffer
+    view semantics at the API/checkpoint boundary only), `gradient_for`
+    (gradient-check hook), `save`/`load` via utils.serialization.
+    """
+
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.params_: Optional[Params] = None
+        self.state_: Optional[Params] = None      # BN running stats etc.
+        self.opt_state_: Optional[PyTree] = None
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners: List[Any] = []
+        self._rng = jax.random.PRNGKey(conf.seed)
+        self._train_step = None
+        self._output_fn = None
+        self._layer_types: List[InputType] = []
+
+    # ---- init ----
+    def init(self) -> "MultiLayerNetwork":
+        dtype = jnp.dtype(self.conf.dtype)
+        it = self.conf.input_type
+        params: Params = {}
+        state: Params = {}
+        key = jax.random.PRNGKey(self.conf.seed)
+        self._layer_types = [it]
+        for i, layer in enumerate(self.conf.layers):
+            key, sub = jax.random.split(key)
+            if layer.weight_init is None:
+                layer.weight_init = self.conf.weight_init
+            if layer.activation is None and not hasattr(layer, "loss"):
+                layer.activation = self.conf.activation
+            p, s, it = layer.initialize(sub, it, dtype)
+            params[self.conf.layer_name(i)] = p
+            state[self.conf.layer_name(i)] = s
+            self._layer_types.append(it)
+        self.params_ = params
+        self.state_ = state
+        self.opt_state_ = self._init_opt_state(params)
+        return self
+
+    def _updater_for(self, i: int) -> IUpdater:
+        layer = self.conf.layers[i]
+        return layer.updater if layer.updater is not None else self.conf.updater
+
+    def _init_opt_state(self, params: Params) -> PyTree:
+        return {
+            self.conf.layer_name(i): self._updater_for(i).init_state(
+                params[self.conf.layer_name(i)])
+            for i in range(len(self.conf.layers))
+        }
+
+    # ---- forward ----
+    def _forward(self, params: Params, state: Params, x, *, train: bool,
+                 rng: Optional[jax.Array], mask=None,
+                 upto: Optional[int] = None) -> Tuple[jnp.ndarray, Params]:
+        new_state = dict(state)
+        n = len(self.conf.layers) if upto is None else upto
+        for i in range(n):
+            layer = self.conf.layers[i]
+            name = self.conf.layer_name(i)
+            lrng = None
+            if rng is not None and layer.STOCHASTIC:
+                rng, lrng = jax.random.split(rng)
+            x, s = layer.apply(params[name], state[name], x, train=train,
+                               rng=lrng, mask=mask)
+            new_state[name] = s
+        return x, new_state
+
+    def _loss(self, params: Params, state: Params, x, y, rng,
+              features_mask=None, labels_mask=None, train: bool = True
+              ) -> Tuple[jnp.ndarray, Params]:
+        """Score = data loss (+ l1/l2 penalties, matching the reference's
+        `calcRegularizationScore` contribution to `score()`).
+
+        features_mask feeds the forward pass (sequence padding masks for
+        pooling/rnn layers); labels_mask feeds the loss reduction — the same
+        split the reference makes in `MultiLayerNetwork.setLayerMaskArrays`.
+        """
+        out_idx = len(self.conf.layers) - 1
+        head = self.conf.layers[out_idx]
+        if not hasattr(head, "compute_loss"):
+            raise ValueError("Last layer must be an OutputLayer/LossLayer")
+        h, new_state = self._forward(params, state, x, train=train, rng=rng,
+                                     mask=features_mask, upto=out_idx)
+        name = self.conf.layer_name(out_idx)
+        hrng = None if rng is None else jax.random.fold_in(rng, out_idx)
+        loss = head.compute_loss(params[name], state[name], h, y, train=train,
+                                 rng=hrng, mask=labels_mask)
+        loss = loss + self._reg_penalty(params)
+        return loss, new_state
+
+    def _reg_penalty(self, params: Params):
+        penalty = 0.0
+        for i, layer in enumerate(self.conf.layers):
+            name = self.conf.layer_name(i)
+            l1 = layer.l1 if layer.l1 is not None else self.conf.l1
+            l2 = layer.l2 if layer.l2 is not None else self.conf.l2
+            if l1 == 0.0 and l2 == 0.0:
+                continue
+            for k in layer.REGULARIZABLE:
+                if k in params[name]:
+                    w = params[name][k]
+                    if l1:
+                        penalty = penalty + l1 * jnp.sum(jnp.abs(w))
+                    if l2:
+                        # reference L2Regularization: 0.5 * coeff * ||w||^2
+                        penalty = penalty + 0.5 * l2 * jnp.sum(w * w)
+        return penalty
+
+    # ---- compiled step ----
+    def _build_train_step(self):
+        conf = self.conf
+
+        def step(params, state, opt_state, x, y, fmask, lmask, rng,
+                 iteration, epoch):
+            def loss_fn(p):
+                loss, new_state = self._loss(p, state, x, y, rng, fmask, lmask)
+                return loss, new_state
+
+            (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+
+            new_params = {}
+            new_opt = {}
+            for i, layer in enumerate(conf.layers):
+                name = conf.layer_name(i)
+                if layer.frozen:
+                    # FrozenLayer semantics (reference `nn/layers/FrozenLayer`):
+                    # no update applied, updater state untouched.
+                    new_params[name] = params[name]
+                    new_opt[name] = opt_state[name]
+                    continue
+                g = grads[name]
+                gn = (layer.gradient_normalization
+                      if layer.gradient_normalization is not None
+                      else conf.gradient_normalization)
+                if gn:
+                    thr = (layer.gradient_normalization_threshold
+                           if layer.gradient_normalization is not None
+                           else conf.gradient_normalization_threshold)
+                    g = apply_gradient_normalization(g, gn, thr)
+                upd_cfg = self._updater_for(i)
+                upd, new_opt[name] = upd_cfg.apply(opt_state[name], g,
+                                                   iteration, epoch,
+                                                   params=params[name])
+                # decoupled weight decay (reference WeightDecay regularization,
+                # applyLR=true): update += lr * coeff * w for regularizable params
+                wd = (layer.weight_decay if layer.weight_decay is not None
+                      else conf.weight_decay)
+                if wd:
+                    lr = upd_cfg.lr_at(iteration, epoch)
+                    upd = {
+                        k: (v + lr * wd * params[name][k]
+                            if k in layer.REGULARIZABLE else v)
+                        for k, v in upd.items()
+                    }
+                new_params[name] = jax.tree_util.tree_map(
+                    lambda p_, u_: p_ - u_, params[name], upd)
+            return new_params, new_state, new_opt, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _get_train_step(self):
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        return self._train_step
+
+    # ---- public API ----
+    def fit(self, data, labels=None, *, epochs: int = 1, features_mask=None,
+            labels_mask=None):
+        """fit(x, y) for one batch, or fit(iterator, epochs=N)
+        (reference `fit(INDArray, INDArray)` / `fit(DataSetIterator, int)`)."""
+        if labels is not None:
+            self._fit_batch(jnp.asarray(data), jnp.asarray(labels),
+                            features_mask, labels_mask)
+            return self
+        for _ in range(epochs):
+            if hasattr(data, "reset"):
+                data.reset()
+            for ds in data:
+                fm = getattr(ds, "features_mask", None)
+                lm = getattr(ds, "labels_mask", None)
+                self._fit_batch(jnp.asarray(ds.features), jnp.asarray(ds.labels),
+                                None if fm is None else jnp.asarray(fm),
+                                None if lm is None else jnp.asarray(lm))
+            self.epoch += 1
+            for lst in self.listeners:
+                if hasattr(lst, "on_epoch_end"):
+                    lst.on_epoch_end(self)
+        return self
+
+    def _fit_batch(self, x, y, fmask=None, lmask=None):
+        step = self._get_train_step()
+        self._rng, rng = jax.random.split(self._rng)
+        self.params_, self.state_, self.opt_state_, loss = step(
+            self.params_, self.state_, self.opt_state_, x, y, fmask, lmask,
+            rng, jnp.asarray(self.iteration, jnp.int32),
+            jnp.asarray(self.epoch, jnp.int32))
+        self._score = loss
+        self.iteration += 1
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, self.epoch)
+
+    def score(self) -> float:
+        """Loss of the most recent minibatch (reference `score()`)."""
+        s = getattr(self, "_score", None)
+        return float(s) if s is not None else float("nan")
+
+    def score_for(self, x, y, features_mask=None, labels_mask=None) -> float:
+        """Score on given data without updating (reference `score(DataSet)`):
+        eval mode — no dropout, BN uses running statistics."""
+        loss, _ = self._loss(self.params_, self.state_, jnp.asarray(x),
+                             jnp.asarray(y), None, features_mask, labels_mask,
+                             train=False)
+        return float(loss)
+
+    def output(self, x, train: bool = False) -> jnp.ndarray:
+        """Inference forward pass (reference `output(INDArray)`), jitted."""
+        if self._output_fn is None:
+            self._output_fn = jax.jit(
+                lambda p, s, x_: self._forward(p, s, x_, train=False, rng=None)[0])
+        return self._output_fn(self.params_, self.state_, jnp.asarray(x))
+
+    def feed_forward(self, x, train: bool = False) -> List[jnp.ndarray]:
+        """All layer activations (reference `feedForward()`)."""
+        acts = [jnp.asarray(x)]
+        h = acts[0]
+        state = self.state_
+        for i in range(len(self.conf.layers)):
+            name = self.conf.layer_name(i)
+            h, _ = self.conf.layers[i].apply(
+                self.params_[name], state[name], h, train=train, rng=None)
+            acts.append(h)
+        return acts
+
+    def evaluate(self, iterator, evaluation=None):
+        """Classification eval over an iterator (reference
+        `evaluate(DataSetIterator)`)."""
+        from deeplearning4j_tpu.train.evaluation import Evaluation
+        ev = evaluation or Evaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            out = self.output(ds.features)
+            ev.eval(np.asarray(ds.labels), np.asarray(out))
+        return ev
+
+    # ---- flat-param view (checkpoint/API contract) ----
+    def num_params(self) -> int:
+        return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self.params_))
+
+    def params(self) -> np.ndarray:
+        """Single flat parameter vector — the reference's flattened-view
+        `params()` contract, preserved at the boundary only (internally
+        params live as a sharded pytree)."""
+        leaves = jax.tree_util.tree_leaves(self.params_)
+        return np.concatenate([np.asarray(l).ravel() for l in leaves]) if leaves \
+            else np.zeros((0,), np.float32)
+
+    def set_params(self, flat: np.ndarray):
+        leaves, treedef = jax.tree_util.tree_flatten(self.params_)
+        out, off = [], 0
+        for l in leaves:
+            n = int(np.prod(l.shape))
+            out.append(jnp.asarray(flat[off:off + n], l.dtype).reshape(l.shape))
+            off += n
+        if off != flat.size:
+            raise ValueError(f"Param count mismatch: {flat.size} vs {off}")
+        self.params_ = jax.tree_util.tree_unflatten(treedef, out)
+
+    # ---- gradient-check hook ----
+    def gradient_for(self, x, y, features_mask=None, labels_mask=None) -> Params:
+        """Analytic gradients of the score wrt params (no update) — the
+        `computeGradientAndScore` half used by GradientCheckUtil."""
+        def loss_fn(p):
+            return self._loss(p, self.state_, jnp.asarray(x), jnp.asarray(y),
+                              None, features_mask, labels_mask)[0]
+        return jax.grad(loss_fn)(self.params_)
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def add_listeners(self, *listeners):
+        self.listeners.extend(listeners)
+        return self
+
+    # ---- persistence (delegates to ModelSerializer) ----
+    def save(self, path: str, save_updater: bool = True):
+        from deeplearning4j_tpu.utils.serialization import write_model
+        write_model(self, path, save_updater=save_updater)
+
+    @staticmethod
+    def load(path: str, load_updater: bool = True) -> "MultiLayerNetwork":
+        from deeplearning4j_tpu.utils.serialization import read_model
+        return read_model(path, load_updater=load_updater)

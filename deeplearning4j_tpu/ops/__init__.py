@@ -1,0 +1,3 @@
+from deeplearning4j_tpu.ops.activations import ACTIVATIONS, get_activation  # noqa: F401
+from deeplearning4j_tpu.ops.initializers import init_weights  # noqa: F401
+from deeplearning4j_tpu.ops.losses import LOSSES, get_loss  # noqa: F401

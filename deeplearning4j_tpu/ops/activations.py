@@ -1,0 +1,142 @@
+"""Activation function inventory.
+
+Covers the reference's `org.nd4j.linalg.activations.Activation` enum
+(IActivation implementations under `org/nd4j/linalg/activations/impl/`).
+Every entry is a pure jax function so XLA fuses it into the surrounding
+matmul/conv — the TPU replacement for libnd4j's standalone transform kernels
+(`libnd4j/include/loops/transform_float.h` etc.), which on GPU each cost a
+kernel launch and an HBM round trip.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+Activation = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def identity(x):
+    return x
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+def leakyrelu(x, alpha=0.01):
+    return jax.nn.leaky_relu(x, negative_slope=alpha)
+
+
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha=alpha)
+
+
+def selu(x):
+    return jax.nn.selu(x)
+
+
+def gelu(x):
+    return jax.nn.gelu(x, approximate=False)
+
+
+def gelu_tanh(x):
+    return jax.nn.gelu(x, approximate=True)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def rationaltanh(x):
+    # Reference: RationalTanh — 1.7159 * tanh(2x/3) approximated rationally;
+    # we use the exact closed form (XLA tanh is cheap on TPU).
+    return 1.7159 * jnp.tanh(2.0 * x / 3.0)
+
+
+def rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def swish(x):
+    return jax.nn.swish(x)
+
+
+def mish(x):
+    return jax.nn.mish(x)
+
+
+def cube(x):
+    return x * x * x
+
+
+def thresholdedrelu(x, theta=1.0):
+    return jnp.where(x > theta, x, 0.0)
+
+
+ACTIVATIONS: Dict[str, Activation] = {
+    "identity": identity,
+    "linear": identity,
+    "relu": relu,
+    "relu6": relu6,
+    "leakyrelu": leakyrelu,
+    "elu": elu,
+    "selu": selu,
+    "gelu": gelu,
+    "gelu_tanh": gelu_tanh,
+    "sigmoid": sigmoid,
+    "hardsigmoid": hardsigmoid,
+    "tanh": tanh,
+    "hardtanh": hardtanh,
+    "rationaltanh": rationaltanh,
+    "rectifiedtanh": rectifiedtanh,
+    "softmax": softmax,
+    "softplus": softplus,
+    "softsign": softsign,
+    "swish": swish,
+    "mish": mish,
+    "cube": cube,
+    "thresholdedrelu": thresholdedrelu,
+}
+
+
+def get_activation(name_or_fn) -> Activation:
+    """Resolve an activation by enum-style name (case-insensitive) or pass
+    through a callable (the IActivation escape hatch)."""
+    if callable(name_or_fn):
+        return name_or_fn
+    key = str(name_or_fn).lower()
+    if key not in ACTIVATIONS:
+        raise ValueError(
+            f"Unknown activation '{name_or_fn}'. Known: {sorted(ACTIVATIONS)}"
+        )
+    return ACTIVATIONS[key]

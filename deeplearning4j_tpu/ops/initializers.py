@@ -1,0 +1,83 @@
+"""Weight initialization inventory.
+
+Covers the reference's `org.deeplearning4j.nn.weights.WeightInit` enum and
+`WeightInitUtil` (deeplearning4j-nn/.../nn/weights/).  Fan-in/fan-out
+conventions follow the reference: for a dense W of shape [nIn, nOut],
+fanIn = nIn, fanOut = nOut; for conv kernels [kh, kw, cin, cout],
+fanIn = kh*kw*cin, fanOut = kh*kw*cout.
+"""
+from __future__ import annotations
+
+import math
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _fans(shape: Sequence[int]) -> Tuple[float, float]:
+    if len(shape) == 1:
+        return float(shape[0]), float(shape[0])
+    if len(shape) == 2:
+        return float(shape[0]), float(shape[1])
+    # conv kernels [spatial..., cin, cout]
+    receptive = 1.0
+    for s in shape[:-2]:
+        receptive *= s
+    return receptive * shape[-2], receptive * shape[-1]
+
+
+def init_weights(key: jax.Array, shape: Sequence[int], scheme: str,
+                 dtype=jnp.float32, dist_params=None) -> jnp.ndarray:
+    """Initialize a weight tensor per a DL4J WeightInit scheme name."""
+    scheme = scheme.upper()
+    fan_in, fan_out = _fans(shape)
+    shape = tuple(shape)
+    if scheme == "ZERO":
+        return jnp.zeros(shape, dtype)
+    if scheme == "ONES":
+        return jnp.ones(shape, dtype)
+    if scheme == "IDENTITY":
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("IDENTITY init requires a square 2-D shape")
+        return jnp.eye(shape[0], dtype=dtype)
+    if scheme == "CONSTANT":
+        value = (dist_params or {}).get("value", 0.0)
+        return jnp.full(shape, value, dtype)
+    if scheme == "NORMAL":
+        # Reference NORMAL: N(0, 1/sqrt(fanIn))
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+    if scheme == "GAUSSIAN":
+        return jax.random.normal(key, shape, dtype)
+    if scheme == "UNIFORM":
+        a = math.sqrt(1.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "XAVIER":
+        # Glorot normal: N(0, 2/(fanIn+fanOut))
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme == "XAVIER_UNIFORM":
+        a = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "XAVIER_FAN_IN":
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+    if scheme in ("RELU", "HE", "HE_NORMAL"):
+        # He normal: N(0, 2/fanIn)
+        return math.sqrt(2.0 / fan_in) * jax.random.normal(key, shape, dtype)
+    if scheme in ("RELU_UNIFORM", "HE_UNIFORM"):
+        a = math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme in ("LECUN_NORMAL",):
+        return jax.random.normal(key, shape, dtype) * math.sqrt(1.0 / fan_in)
+    if scheme in ("LECUN_UNIFORM",):
+        a = math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "SIGMOID_UNIFORM":
+        a = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "VAR_SCALING_NORMAL_FAN_AVG":
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, shape, dtype)
+    if scheme == "ORTHOGONAL":
+        return jax.nn.initializers.orthogonal()(key, shape, dtype)
+    raise ValueError(f"Unknown weight init scheme '{scheme}'")

@@ -1,0 +1,199 @@
+"""Loss function inventory.
+
+Covers the reference's `org.nd4j.linalg.lossfunctions.LossFunctions.LossFunction`
+enum and ILossFunction implementations (`org/nd4j/linalg/lossfunctions/impl/`).
+Each loss is `loss(labels, preactivations_or_probs, mask) -> scalar mean score`
+as a pure jax function; gradients come from `jax.grad` of the whole step,
+replacing the reference's hand-written `computeGradient` per loss.
+
+Score convention matches the reference: per-example losses are summed over
+the output dimension, then averaged over (unmasked) examples.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+LossFn = Callable[..., jnp.ndarray]
+
+_EPS = 1e-7
+
+
+def _reduce(per_example: jnp.ndarray, mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """per_example: [batch] (already summed over features). Mean over batch,
+    honoring an optional per-example (or broadcastable) mask."""
+    if mask is not None:
+        mask = mask.reshape(per_example.shape)
+        return jnp.sum(per_example * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(per_example)
+
+
+def _masked_reduce(elem: jnp.ndarray, mask: Optional[jnp.ndarray],
+                   mean_over_features: bool = False) -> jnp.ndarray:
+    """Reduce an elementwise loss [batch, ...] to a scalar.
+
+    The mask (if any) covers the leading dims of `elem` — [batch] or
+    [batch, time] — reference semantics: masked units are excluded from both
+    numerator and denominator.  `mean_over_features` divides by the feature
+    count (MSE/MAE-style losses); otherwise features are summed (L1/L2/XENT
+    style)."""
+    if mask is None:
+        per = jnp.sum(elem.reshape(elem.shape[0], -1), axis=-1)
+        if mean_over_features:
+            n = 1
+            for s in elem.shape[1:]:
+                n *= s
+            per = per / max(n, 1)
+        return jnp.mean(per)
+    m = mask
+    feat = 1
+    for s in elem.shape[m.ndim:]:
+        feat *= s
+    m = m.reshape(m.shape + (1,) * (elem.ndim - m.ndim)).astype(elem.dtype)
+    total = jnp.sum(elem * m)
+    denom = jnp.maximum(jnp.sum(m), 1.0)
+    if mean_over_features:
+        denom = denom * max(feat, 1)
+    return total / denom
+
+
+def _sum_features(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sum(x.reshape(x.shape[0], -1), axis=-1)
+
+
+def mcxent(labels, logits, mask=None):
+    """Multi-class cross entropy on logits (reference MCXENT fused with
+    softmax activation — the numerically-stable path libnd4j uses via
+    softmax_cross_entropy custom op)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    per = -jnp.sum(labels * logp, axis=-1)
+    if per.ndim > 1:  # time-series [batch, time]
+        if mask is not None and mask.shape == per.shape:
+            return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        per = jnp.sum(per, axis=tuple(range(1, per.ndim)))
+    return _reduce(per, mask)
+
+
+def negativeloglikelihood(labels, probs, mask=None):
+    """NLL on probabilities (reference NEGATIVELOGLIKELIHOOD; identical to
+    MCXENT-on-probs)."""
+    per = -jnp.sum(labels * jnp.log(jnp.clip(probs, _EPS, 1.0)), axis=-1)
+    if per.ndim > 1:
+        if mask is not None and mask.shape == per.shape:
+            return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        per = jnp.sum(per, axis=tuple(range(1, per.ndim)))
+    return _reduce(per, mask)
+
+
+def xent(labels, logits, mask=None):
+    """Binary cross entropy on logits (reference XENT fused with sigmoid)."""
+    elem = (jnp.maximum(logits, 0) - logits * labels
+            + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+    return _masked_reduce(elem, mask)
+
+
+def mse(labels, preds, mask=None):
+    return _masked_reduce((preds - labels) ** 2, mask, mean_over_features=True)
+
+
+def l2(labels, preds, mask=None):
+    return _masked_reduce((preds - labels) ** 2, mask)
+
+
+def l1(labels, preds, mask=None):
+    return _masked_reduce(jnp.abs(preds - labels), mask)
+
+
+def mae(labels, preds, mask=None):
+    return _masked_reduce(jnp.abs(preds - labels), mask, mean_over_features=True)
+
+
+def hinge(labels, preds, mask=None):
+    """labels in {-1, +1} or {0,1} (converted)."""
+    y = jnp.where(labels > 0, 1.0, -1.0)
+    return _masked_reduce(jnp.maximum(0.0, 1.0 - y * preds), mask)
+
+
+def squared_hinge(labels, preds, mask=None):
+    y = jnp.where(labels > 0, 1.0, -1.0)
+    return _masked_reduce(jnp.maximum(0.0, 1.0 - y * preds) ** 2, mask)
+
+
+def kl_divergence(labels, probs, mask=None):
+    elem = labels * (jnp.log(jnp.clip(labels, _EPS, 1.0))
+                     - jnp.log(jnp.clip(probs, _EPS, 1.0)))
+    return _masked_reduce(elem, mask)
+
+
+def poisson(labels, preds, mask=None):
+    elem = preds - labels * jnp.log(jnp.clip(preds, _EPS, None))
+    return _masked_reduce(elem, mask)
+
+
+def cosine_proximity(labels, preds, mask=None):
+    ln = labels / jnp.maximum(jnp.linalg.norm(labels, axis=-1, keepdims=True), _EPS)
+    pn = preds / jnp.maximum(jnp.linalg.norm(preds, axis=-1, keepdims=True), _EPS)
+    per = -jnp.sum(ln * pn, axis=-1)
+    if mask is not None and per.shape == mask.shape:
+        return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    if per.ndim > 1:
+        per = jnp.sum(per, axis=tuple(range(1, per.ndim)))
+    return _reduce(per, mask)
+
+
+def mape(labels, preds, mask=None):
+    elem = 100.0 * jnp.abs((labels - preds) / jnp.clip(jnp.abs(labels), _EPS, None))
+    return _masked_reduce(elem, mask, mean_over_features=True)
+
+
+def msle(labels, preds, mask=None):
+    elem = (jnp.log1p(jnp.clip(preds, 0, None))
+            - jnp.log1p(jnp.clip(labels, 0, None))) ** 2
+    return _masked_reduce(elem, mask, mean_over_features=True)
+
+
+def sparse_mcxent(labels, logits, mask=None):
+    """Integer-label cross entropy (reference LossSparseMCXENT)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    per = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    if per.ndim > 1:
+        if mask is not None and mask.shape == per.shape:
+            return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        per = jnp.sum(per, axis=tuple(range(1, per.ndim)))
+    return _reduce(per, mask)
+
+
+# Names mirror LossFunctions.LossFunction enum values (lowercased).
+LOSSES: Dict[str, LossFn] = {
+    "mcxent": mcxent,
+    "negativeloglikelihood": negativeloglikelihood,
+    "xent": xent,
+    "mse": mse,
+    "squared_loss": mse,
+    "l1": l1,
+    "l2": l2,
+    "mean_absolute_error": mae,
+    "mean_squared_logarithmic_error": msle,
+    "mean_absolute_percentage_error": mape,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "kl_divergence": kl_divergence,
+    "reconstruction_crossentropy": xent,
+    "poisson": poisson,
+    "cosine_proximity": cosine_proximity,
+    "sparse_mcxent": sparse_mcxent,
+}
+
+# Losses that expect raw logits and fuse the final activation internally.
+LOGIT_LOSSES = {"mcxent", "xent", "sparse_mcxent"}
+
+
+def get_loss(name_or_fn) -> LossFn:
+    if callable(name_or_fn):
+        return name_or_fn
+    key = str(name_or_fn).lower()
+    if key not in LOSSES:
+        raise ValueError(f"Unknown loss '{name_or_fn}'. Known: {sorted(LOSSES)}")
+    return LOSSES[key]

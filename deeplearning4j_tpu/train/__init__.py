@@ -1,0 +1,9 @@
+from deeplearning4j_tpu.train.evaluation import (  # noqa: F401
+    Evaluation, RegressionEvaluation, ROC, ROCMultiClass)
+from deeplearning4j_tpu.train.schedules import (  # noqa: F401
+    CycleSchedule, ExponentialSchedule, FixedSchedule, InverseSchedule,
+    ISchedule, MapSchedule, PolySchedule, RampSchedule, SigmoidSchedule,
+    StepSchedule, WarmupLinearDecaySchedule)
+from deeplearning4j_tpu.train.updaters import (  # noqa: F401
+    AdaDelta, AdaGrad, AdaMax, Adam, AdamW, AMSGrad, IUpdater, Nadam,
+    Nesterovs, NoOp, RmsProp, Sgd, UPDATERS)

@@ -1,0 +1,206 @@
+"""Learning-rate (and generic hyperparameter) schedules.
+
+Covers the reference's `org.nd4j.linalg.schedule.ISchedule` implementations
+(`org/nd4j/linalg/schedule/*.java`): Step, Exponential, Inverse, Poly,
+Sigmoid, Map, Ramp, Cycle, Fixed.  Schedules are pure functions of the
+iteration/epoch counter so they trace cleanly under `jit` (the counter is a
+traced scalar in the train step; no Python-side state).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+
+class ISchedule:
+    """value_at(iteration, epoch) -> scalar. Both args may be traced."""
+
+    def value_at(self, iteration, epoch=0):
+        raise NotImplementedError
+
+    # --- JSON round-trip (model-config contract) ---
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["@schedule"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_json(d: dict) -> "ISchedule":
+        d = dict(d)
+        cls_name = d.pop("@schedule")
+        cls = _SCHEDULES[cls_name]
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class FixedSchedule(ISchedule):
+    value: float
+
+    def value_at(self, iteration, epoch=0):
+        return jnp.asarray(self.value)
+
+
+@dataclasses.dataclass
+class StepSchedule(ISchedule):
+    """value * decay_rate ^ floor(iter / step)"""
+    initial_value: float
+    decay_rate: float
+    step: float
+    schedule_type: str = "ITERATION"  # or EPOCH
+
+    def _t(self, iteration, epoch):
+        return iteration if self.schedule_type == "ITERATION" else epoch
+
+    def value_at(self, iteration, epoch=0):
+        t = self._t(iteration, epoch)
+        return self.initial_value * self.decay_rate ** jnp.floor(t / self.step)
+
+
+@dataclasses.dataclass
+class ExponentialSchedule(ISchedule):
+    """value * gamma ^ iter"""
+    initial_value: float
+    gamma: float
+    schedule_type: str = "ITERATION"
+
+    def value_at(self, iteration, epoch=0):
+        t = iteration if self.schedule_type == "ITERATION" else epoch
+        return self.initial_value * self.gamma ** t
+
+
+@dataclasses.dataclass
+class InverseSchedule(ISchedule):
+    """value / (1 + gamma * iter) ^ power"""
+    initial_value: float
+    gamma: float
+    power: float
+    schedule_type: str = "ITERATION"
+
+    def value_at(self, iteration, epoch=0):
+        t = iteration if self.schedule_type == "ITERATION" else epoch
+        return self.initial_value / (1.0 + self.gamma * t) ** self.power
+
+
+@dataclasses.dataclass
+class PolySchedule(ISchedule):
+    """value * (1 - iter/maxIter) ^ power"""
+    initial_value: float
+    power: float
+    max_iter: int
+    schedule_type: str = "ITERATION"
+
+    def value_at(self, iteration, epoch=0):
+        t = iteration if self.schedule_type == "ITERATION" else epoch
+        frac = jnp.clip(t / self.max_iter, 0.0, 1.0)
+        return self.initial_value * (1.0 - frac) ** self.power
+
+
+@dataclasses.dataclass
+class SigmoidSchedule(ISchedule):
+    """value / (1 + exp(-gamma * (iter - stepSize)))"""
+    initial_value: float
+    gamma: float
+    step_size: int
+    schedule_type: str = "ITERATION"
+
+    def value_at(self, iteration, epoch=0):
+        t = iteration if self.schedule_type == "ITERATION" else epoch
+        return self.initial_value / (1.0 + jnp.exp(-self.gamma * (t - self.step_size)))
+
+
+@dataclasses.dataclass
+class RampSchedule(ISchedule):
+    """Linear warmup from ~0 to the wrapped schedule over num_iter steps."""
+    initial_value: float
+    num_iter: int
+
+    def value_at(self, iteration, epoch=0):
+        frac = jnp.clip((iteration + 1.0) / self.num_iter, 0.0, 1.0)
+        return frac * self.initial_value
+
+
+@dataclasses.dataclass
+class CycleSchedule(ISchedule):
+    """1cycle-style schedule (reference CycleSchedule): ramp up then down,
+    then annihilation phase at the end."""
+    initial_value: float
+    max_value: float
+    cycle_length: int
+    annealing_length: int = 0
+    initial_annealing_value: Optional[float] = None
+
+    def value_at(self, iteration, epoch=0):
+        up = self.cycle_length / 2.0
+        t = jnp.asarray(iteration, jnp.float32)
+        in_cycle = jnp.minimum(t, float(self.cycle_length))
+        tri = jnp.where(
+            in_cycle <= up,
+            self.initial_value + (self.max_value - self.initial_value) * (in_cycle / up),
+            self.max_value - (self.max_value - self.initial_value) * ((in_cycle - up) / up),
+        )
+        if self.annealing_length > 0:
+            ann_start = self.cycle_length
+            ann_frac = jnp.clip((t - ann_start) / self.annealing_length, 0.0, 1.0)
+            ann_init = (
+                self.initial_annealing_value
+                if self.initial_annealing_value is not None
+                else self.initial_value
+            )
+            ann = ann_init * (1.0 - ann_frac)
+            return jnp.where(t >= ann_start, ann, tri)
+        return tri
+
+
+@dataclasses.dataclass
+class MapSchedule(ISchedule):
+    """Explicit {iteration: value} breakpoints (reference MapSchedule)."""
+    values: Dict[int, float]
+    schedule_type: str = "ITERATION"
+
+    def __post_init__(self):
+        # JSON round-trip stringifies int keys — normalize back.
+        self.values = {int(k): float(v) for k, v in self.values.items()}
+
+    def value_at(self, iteration, epoch=0):
+        t = iteration if self.schedule_type == "ITERATION" else epoch
+        keys = sorted(int(k) for k in self.values)
+        out = jnp.asarray(self.values[keys[0]])
+        for k in keys:
+            out = jnp.where(t >= k, self.values[k], out)
+        return out
+
+
+@dataclasses.dataclass
+class WarmupLinearDecaySchedule(ISchedule):
+    """Linear warmup then linear decay to zero (the BERT fine-tune shape;
+    capability addition — the reference approximates this with MapSchedule)."""
+    peak_value: float
+    warmup_iters: int
+    total_iters: int
+
+    def value_at(self, iteration, epoch=0):
+        t = jnp.asarray(iteration, jnp.float32)
+        warm = self.peak_value * (t + 1.0) / max(self.warmup_iters, 1)
+        decay = self.peak_value * jnp.clip(
+            (self.total_iters - t) / max(self.total_iters - self.warmup_iters, 1), 0.0, 1.0
+        )
+        return jnp.where(t < self.warmup_iters, warm, decay)
+
+
+_SCHEDULES = {
+    c.__name__: c
+    for c in [
+        FixedSchedule, StepSchedule, ExponentialSchedule, InverseSchedule,
+        PolySchedule, SigmoidSchedule, RampSchedule, CycleSchedule, MapSchedule,
+        WarmupLinearDecaySchedule,
+    ]
+}
+
+
+def resolve_schedule(lr) -> ISchedule:
+    """Accept a float (fixed LR) or an ISchedule."""
+    if isinstance(lr, ISchedule):
+        return lr
+    return FixedSchedule(float(lr))

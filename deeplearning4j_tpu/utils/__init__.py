@@ -1,0 +1,1 @@
+from deeplearning4j_tpu.utils.config import Config, get_config, set_config  # noqa: F401

@@ -1,0 +1,81 @@
+"""Global framework configuration.
+
+TPU-native replacement for the reference's three config tiers (SURVEY.md §5.6):
+`org/nd4j/config/ND4JSystemProperties.java` / `ND4JEnvironmentVars.java`
+(JVM system properties + env vars) and libnd4j's `Environment` singleton
+(`libnd4j/include/system/Environment.h`).  One typed config object with env
+overrides; model-level config stays JSON (the NeuralNetConfiguration
+equivalent, a public contract used by checkpoints).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+
+_TRUTHY = {"1", "true", "yes", "on"}
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    return default if v is None else v.strip().lower() in _TRUTHY
+
+
+@dataclasses.dataclass
+class Config:
+    """Framework-wide runtime configuration.
+
+    Attributes mirror the reference's env toggles where a TPU equivalent
+    exists: `debug`/`verbose` (libnd4j Environment::setDebug/setVerbose),
+    `nan_panic` (OpExecutioner NAN_PANIC profiling mode), default dtypes
+    (ND4J `Nd4j.setDefaultDataTypes`).
+    """
+
+    # Default floating dtype for parameters (reference default: float32).
+    default_dtype: jnp.dtype = jnp.float32
+    # Compute dtype for matmul/conv-heavy paths; bf16 feeds the MXU natively.
+    compute_dtype: jnp.dtype = jnp.float32
+    # NAN_PANIC / INF_PANIC equivalent: enable jax debug_nans.
+    nan_panic: bool = False
+    debug: bool = False
+    verbose: bool = False
+    # Profiling (OpProfiler equivalent -> jax profiler traces).
+    profiling_enabled: bool = False
+    profile_dir: str = "/tmp/dl4j_tpu_profile"
+
+    @staticmethod
+    def from_env() -> "Config":
+        cfg = Config()
+        cfg.nan_panic = _env_bool("DL4J_TPU_NAN_PANIC", False)
+        cfg.debug = _env_bool("DL4J_TPU_DEBUG", False)
+        cfg.verbose = _env_bool("DL4J_TPU_VERBOSE", False)
+        cfg.profiling_enabled = _env_bool("DL4J_TPU_PROFILE", False)
+        cfg.profile_dir = os.environ.get("DL4J_TPU_PROFILE_DIR", cfg.profile_dir)
+        dt = os.environ.get("DL4J_TPU_DTYPE")
+        if dt:
+            cfg.default_dtype = jnp.dtype(dt)
+        cdt = os.environ.get("DL4J_TPU_COMPUTE_DTYPE")
+        if cdt:
+            cfg.compute_dtype = jnp.dtype(cdt)
+        if cfg.nan_panic:
+            import jax
+
+            jax.config.update("jax_debug_nans", True)
+        return cfg
+
+
+_CONFIG: Optional[Config] = None
+
+
+def get_config() -> Config:
+    global _CONFIG
+    if _CONFIG is None:
+        _CONFIG = Config.from_env()
+    return _CONFIG
+
+
+def set_config(cfg: Config) -> None:
+    global _CONFIG
+    _CONFIG = cfg

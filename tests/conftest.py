@@ -1,0 +1,24 @@
+"""Test config: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's "multi-node without a cluster" strategy (SURVEY.md
+§4: Aeron-on-loopback / Spark local[*]) — sharding/collective tests execute
+on `xla_force_host_platform_device_count=8` CPU devices; real-TPU paths are
+exercised by bench.py / the driver.
+"""
+import os
+
+# Force CPU: the session env pins JAX_PLATFORMS=axon (real TPU) which has no
+# float64 and a slow remote compile path; tests run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")  # gradient checks need f64
+
+import jax  # noqa: E402
+
+# jax may already be imported by a pytest plugin before this conftest runs,
+# in which case the env var alone is too late — set the config directly.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

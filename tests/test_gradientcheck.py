@@ -1,0 +1,105 @@
+"""Finite-difference gradient checks (reference: GradientCheckTests family,
+SURVEY.md §4 — central differences vs backprop in double precision)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (
+    BatchNormalizationLayer, ConvolutionLayer, DenseLayer, InputType,
+    MultiLayerNetwork, NeuralNetConfiguration, OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.train import Sgd
+from deeplearning4j_tpu.train.gradientcheck import check_gradients
+
+
+def build_net(layers, input_type, seed=7):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Sgd(0.1)).weight_init("XAVIER")
+            .dtype("float64")
+            .list(layers).set_input_type(input_type).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def score_fn_for(net, x, y):
+    x = jnp.asarray(x, jnp.float64)
+    y = jnp.asarray(y, jnp.float64)
+
+    def score(params):
+        return net._loss(params, net.state_, x, y, None)[0]
+
+    return score
+
+
+def test_mlp_gradients():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 4))
+    y = np.eye(3)[rng.integers(0, 3, 8)]
+    net = build_net([
+        DenseLayer(n_out=6, activation="tanh"),
+        OutputLayer(n_out=3, loss="mcxent", activation="softmax"),
+    ], InputType.feed_forward(4))
+    assert check_gradients(score_fn_for(net, x, y), net.params_,
+                           max_params_per_leaf=None, verbose=True)
+
+
+def test_mlp_gradients_with_l1_l2():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(8, 4))
+    y = np.eye(2)[rng.integers(0, 2, 8)]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).updater(Sgd(0.1)).weight_init("XAVIER")
+            .l1(0.01).l2(0.02).dtype("float64")
+            .list([DenseLayer(n_out=5, activation="sigmoid"),
+                   OutputLayer(n_out=2, loss="mcxent", activation="softmax")])
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    assert check_gradients(score_fn_for(net, x, y), net.params_,
+                           max_params_per_leaf=None, verbose=True)
+
+
+def test_cnn_gradients():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(4, 6, 6, 2))
+    y = np.eye(2)[rng.integers(0, 2, 4)]
+    net = build_net([
+        ConvolutionLayer(n_out=3, kernel_size=3, activation="tanh",
+                         weight_init="XAVIER"),
+        SubsamplingLayer(kernel_size=2, stride=2),
+        OutputLayer(n_out=2, loss="mcxent", activation="softmax"),
+    ], InputType.convolutional(6, 6, 2))
+    assert check_gradients(score_fn_for(net, x, y), net.params_,
+                           max_params_per_leaf=32, verbose=True)
+
+
+def test_batchnorm_gradients():
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(8, 5))
+    y = np.eye(2)[rng.integers(0, 2, 8)]
+    net = build_net([
+        DenseLayer(n_out=6, activation="identity"),
+        BatchNormalizationLayer(),
+        OutputLayer(n_out=2, loss="mcxent", activation="softmax"),
+    ], InputType.feed_forward(5))
+    assert check_gradients(score_fn_for(net, x, y), net.params_,
+                           max_params_per_leaf=None, verbose=True)
+
+
+@pytest.mark.parametrize("loss,act", [
+    ("mse", "identity"), ("l2", "identity"), ("l1", "tanh"),
+    ("xent", "sigmoid"), ("negativeloglikelihood", "softmax"),
+])
+def test_loss_gradients(loss, act):
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(6, 3))
+    if loss in ("xent",):
+        y = (rng.random((6, 2)) > 0.5).astype(np.float64)
+    elif loss == "negativeloglikelihood":
+        y = np.eye(2)[rng.integers(0, 2, 6)]
+    else:
+        y = rng.normal(size=(6, 2))
+    net = build_net([
+        DenseLayer(n_out=4, activation="tanh"),
+        OutputLayer(n_out=2, loss=loss, activation=act),
+    ], InputType.feed_forward(3))
+    assert check_gradients(score_fn_for(net, x, y), net.params_,
+                           max_params_per_leaf=None, verbose=True)

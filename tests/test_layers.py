@@ -1,0 +1,154 @@
+"""Layer shape/behavior tests (reference: platform-tests layer tests)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn import (
+    ActivationLayer, BatchNormalizationLayer, Convolution1DLayer,
+    ConvolutionLayer, Deconvolution2DLayer, DenseLayer,
+    DepthwiseConvolution2DLayer, DropoutLayer, EmbeddingLayer,
+    EmbeddingSequenceLayer, GlobalPoolingLayer, InputType,
+    LayerNormalizationLayer, LocalResponseNormalizationLayer,
+    SeparableConvolution2DLayer, SubsamplingLayer, Upsampling2DLayer,
+    ZeroPaddingLayer)
+
+KEY = jax.random.PRNGKey(0)
+
+
+def run(layer, input_type, x, train=False, rng=None):
+    params, state, out_type = layer.initialize(KEY, input_type)
+    y, _ = layer.apply(params, state, x, train=train, rng=rng)
+    return y, out_type
+
+
+def test_dense_shapes():
+    x = jnp.ones((4, 10), jnp.float32)
+    y, ot = run(DenseLayer(n_out=7, activation="relu", weight_init="XAVIER"),
+                InputType.feed_forward(10), x)
+    assert y.shape == (4, 7)
+    assert ot.shape == (7,)
+
+
+def test_dense_flattens_conv_input():
+    x = jnp.ones((2, 4, 4, 3), jnp.float32)
+    y, _ = run(DenseLayer(n_out=5, weight_init="XAVIER"),
+               InputType.convolutional(4, 4, 3), x)
+    assert y.shape == (2, 5)
+
+
+def test_conv2d_valid_and_same():
+    x = jnp.ones((2, 8, 8, 3), jnp.float32)
+    y, ot = run(ConvolutionLayer(n_out=6, kernel_size=3, stride=1,
+                                 weight_init="RELU"),
+                InputType.convolutional(8, 8, 3), x)
+    assert y.shape == (2, 6, 6, 6) and ot.shape == (6, 6, 6)
+    y, ot = run(ConvolutionLayer(n_out=6, kernel_size=3, stride=2,
+                                 convolution_mode="Same", weight_init="RELU"),
+                InputType.convolutional(8, 8, 3), x)
+    assert y.shape == (2, 4, 4, 6) and ot.shape == (4, 4, 6)
+
+
+def test_conv1d():
+    x = jnp.ones((2, 16, 4), jnp.float32)
+    y, ot = run(Convolution1DLayer(n_out=8, kernel_size=3, weight_init="RELU"),
+                InputType.recurrent(4, 16), x)
+    assert y.shape == (2, 16, 8)
+    assert ot.shape == (16, 8)
+
+
+def test_depthwise_separable_deconv():
+    x = jnp.ones((2, 8, 8, 4), jnp.float32)
+    y, _ = run(DepthwiseConvolution2DLayer(depth_multiplier=2, kernel_size=3,
+                                           weight_init="RELU"),
+               InputType.convolutional(8, 8, 4), x)
+    assert y.shape == (2, 6, 6, 8)
+    y, _ = run(SeparableConvolution2DLayer(n_out=10, kernel_size=3,
+                                           weight_init="RELU"),
+               InputType.convolutional(8, 8, 4), x)
+    assert y.shape == (2, 6, 6, 10)
+    y, ot = run(Deconvolution2DLayer(n_out=3, kernel_size=2, stride=2,
+                                     weight_init="RELU"),
+                InputType.convolutional(8, 8, 4), x)
+    assert y.shape == (2, 16, 16, 3) and ot.shape == (16, 16, 3)
+
+
+def test_pooling_types():
+    x = jnp.arange(2 * 4 * 4 * 2, dtype=jnp.float32).reshape(2, 4, 4, 2)
+    for pt in ["MAX", "AVG", "SUM", "PNORM"]:
+        y, ot = run(SubsamplingLayer(pooling_type=pt, kernel_size=2, stride=2),
+                    InputType.convolutional(4, 4, 2), x)
+        assert y.shape == (2, 2, 2, 2)
+        assert ot.shape == (2, 2, 2)
+    # max pool correctness on a known block
+    y, _ = run(SubsamplingLayer(pooling_type="MAX", kernel_size=2, stride=2),
+               InputType.convolutional(4, 4, 2), x)
+    np.testing.assert_allclose(np.asarray(y)[0, 0, 0, 0], float(x[0, 1, 1, 0]))
+
+
+def test_global_pooling():
+    x = jnp.ones((2, 5, 5, 3), jnp.float32)
+    y, ot = run(GlobalPoolingLayer(pooling_type="AVG"),
+                InputType.convolutional(5, 5, 3), x)
+    assert y.shape == (2, 3) and ot.shape == (3,)
+    # masked time series
+    layer = GlobalPoolingLayer(pooling_type="AVG")
+    params, state, _ = layer.initialize(KEY, InputType.recurrent(3, 4))
+    xs = jnp.ones((2, 4, 3))
+    mask = jnp.array([[1, 1, 0, 0], [1, 1, 1, 1]], jnp.float32)
+    y, _ = layer.apply(params, state, xs, mask=mask)
+    np.testing.assert_allclose(np.asarray(y), np.ones((2, 3)), rtol=1e-6)
+
+
+def test_batchnorm_train_vs_eval():
+    layer = BatchNormalizationLayer(decay=0.5)
+    params, state, _ = layer.initialize(KEY, InputType.feed_forward(3))
+    x = jnp.array(np.random.default_rng(0).normal(2.0, 3.0, (64, 3)), jnp.float32)
+    y, new_state = layer.apply(params, state, x, train=True)
+    # batch-normalized output ~ zero mean, unit var
+    assert abs(float(jnp.mean(y))) < 1e-4
+    assert abs(float(jnp.var(y)) - 1.0) < 0.05
+    # running stats moved toward batch stats
+    assert float(jnp.max(jnp.abs(new_state["mean"]))) > 0.5
+    # eval mode uses running stats, not batch
+    y_eval, st = layer.apply(params, new_state, x, train=False)
+    assert st is new_state
+
+
+def test_dropout_semantics():
+    layer = DropoutLayer(dropout=0.5)  # retain prob 0.5 (reference semantics)
+    params, state, _ = layer.initialize(KEY, InputType.feed_forward(1000))
+    x = jnp.ones((4, 1000))
+    y_eval, _ = layer.apply(params, state, x, train=False)
+    np.testing.assert_allclose(np.asarray(y_eval), np.asarray(x))
+    y_tr, _ = layer.apply(params, state, x, train=True, rng=jax.random.PRNGKey(1))
+    kept = np.asarray(y_tr) > 0
+    assert 0.4 < kept.mean() < 0.6
+    # inverted scaling: kept entries are 1/p
+    np.testing.assert_allclose(np.asarray(y_tr)[kept], 2.0, rtol=1e-6)
+
+
+def test_embedding():
+    x = jnp.array([1, 3, 2], jnp.int32)
+    y, _ = run(EmbeddingLayer(n_in=10, n_out=4, weight_init="NORMAL"),
+               InputType.feed_forward(1), x)
+    assert y.shape == (3, 4)
+    xs = jnp.array([[1, 2], [3, 4]], jnp.int32)
+    y, ot = run(EmbeddingSequenceLayer(n_in=10, n_out=4, weight_init="NORMAL"),
+                InputType.recurrent(1, 2), xs)
+    assert y.shape == (2, 2, 4) and ot.kind == "recurrent"
+
+
+def test_misc_layers():
+    x = jnp.ones((2, 4, 4, 3))
+    y, ot = run(Upsampling2DLayer(size=2), InputType.convolutional(4, 4, 3), x)
+    assert y.shape == (2, 8, 8, 3)
+    y, ot = run(ZeroPaddingLayer(padding=1), InputType.convolutional(4, 4, 3), x)
+    assert y.shape == (2, 6, 6, 3) and ot.shape == (6, 6, 3)
+    y, _ = run(LocalResponseNormalizationLayer(), InputType.convolutional(4, 4, 3), x)
+    assert y.shape == x.shape
+    y, _ = run(LayerNormalizationLayer(), InputType.feed_forward(3),
+               jnp.ones((2, 3)))
+    assert y.shape == (2, 3)
+    y, _ = run(ActivationLayer(activation="relu"), InputType.feed_forward(3),
+               jnp.array([[-1.0, 0.0, 2.0]]))
+    np.testing.assert_allclose(np.asarray(y), [[0.0, 0.0, 2.0]])

@@ -1,0 +1,82 @@
+"""Regression tests for review findings (round 1)."""
+import json
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn import (
+    DenseLayer, InputType, MultiLayerNetwork, NeuralNetConfiguration,
+    OutputLayer, BatchNormalizationLayer)
+from deeplearning4j_tpu.ops.losses import mse, xent
+from deeplearning4j_tpu.train import Adam, AdamW, MapSchedule
+from deeplearning4j_tpu.train.updaters import IUpdater
+
+
+def test_adamw_applies_weight_decay():
+    params = {"W": jnp.ones((3, 3))}
+    grads = {"W": jnp.zeros((3, 3))}
+    u = AdamW(1e-2, weight_decay=0.1)
+    upd, _ = u.apply(u.init_state(params), grads, 0, params=params)
+    # zero grads -> update is purely lr*wd*p
+    np.testing.assert_allclose(np.asarray(upd["W"]), 1e-2 * 0.1, rtol=1e-6)
+    plain, _ = Adam(1e-2).apply(Adam(1e-2).init_state(params), grads, 0,
+                                params=params)
+    assert not np.allclose(np.asarray(upd["W"]), np.asarray(plain["W"]))
+
+
+def test_score_for_uses_eval_mode_batchnorm():
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+            .list([DenseLayer(n_out=4, activation="identity",
+                              weight_init="XAVIER"),
+                   BatchNormalizationLayer(),
+                   OutputLayer(n_out=2, loss="mcxent", activation="softmax",
+                               weight_init="XAVIER")])
+            .set_input_type(InputType.feed_forward(3)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).normal(5.0, 1.0, (16, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[np.random.default_rng(1).integers(0, 2, 16)]
+    # eval-mode score must agree with loss computed from output() probs
+    # (clip at f32-tiny, not 1e-7 — untrained logits legitimately exceed ±16)
+    probs = np.asarray(net.output(x))
+    manual = -np.mean(np.sum(y * np.log(np.clip(probs, 1e-37, 1)), axis=-1))
+    assert abs(net.score_for(x, y) - manual) < 1e-3
+    # and it must NOT equal the train-mode (batch-stats) loss
+    train_loss = float(net._loss(net.params_, net.state_, jnp.asarray(x),
+                                 jnp.asarray(y), None, train=True)[0])
+    assert abs(net.score_for(x, y) - train_loss) > 0.1
+
+
+def test_masked_timeseries_losses():
+    labels = jnp.ones((2, 4, 3))
+    preds = jnp.zeros((2, 4, 3))
+    mask = jnp.array([[1, 1, 0, 0], [1, 1, 1, 1]], jnp.float32)
+    # mse: masked timesteps excluded; all errors are 1 -> mean = 1
+    assert float(mse(labels, preds, mask)) == 1.0
+    # unmasked differs when preds nonzero in masked region
+    preds2 = preds.at[0, 3].set(100.0)
+    assert float(mse(labels, preds2, mask)) == float(mse(labels, preds, mask))
+    # xent with [batch, time] mask runs without shape errors
+    assert np.isfinite(float(xent(labels, preds, mask)))
+
+
+def test_mapschedule_json_roundtrip():
+    u = Adam(MapSchedule({0: 0.1, 10: 0.01}))
+    u2 = IUpdater.from_json(json.loads(json.dumps(u.to_json())))
+    assert float(u2.lr_at(5)) == 0.1
+    assert float(u2.lr_at(15)) == 0.01
+
+
+def test_labels_mask_threaded_from_dataset():
+    from deeplearning4j_tpu.data import DataSet, ListDataSetIterator
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+            .list([DenseLayer(n_out=4, activation="tanh", weight_init="XAVIER"),
+                   OutputLayer(n_out=2, loss="mse", activation="identity",
+                               weight_init="XAVIER")])
+            .set_input_type(InputType.recurrent(3, 4)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).normal(size=(2, 4, 3)).astype(np.float32)
+    y = np.zeros((2, 4, 2), np.float32)
+    lmask = np.array([[1, 1, 0, 0], [1, 1, 1, 1]], np.float32)
+    ds = DataSet(x, y, labels_mask=lmask)
+    net.fit(ListDataSetIterator([ds]))  # must run with mask threading
+    assert np.isfinite(net.score())

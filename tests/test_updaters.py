@@ -1,0 +1,90 @@
+"""Updater + schedule numerics tests (OpValidation-style, SURVEY.md §4)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.train import (
+    AdaDelta, AdaGrad, AdaMax, Adam, AMSGrad, Nadam, Nesterovs, NoOp,
+    RmsProp, Sgd, UPDATERS)
+from deeplearning4j_tpu.train.schedules import (
+    ExponentialSchedule, FixedSchedule, InverseSchedule, MapSchedule,
+    PolySchedule, SigmoidSchedule, StepSchedule)
+from deeplearning4j_tpu.train.updaters import IUpdater
+
+
+PARAMS = {"W": jnp.array([[1.0, -2.0], [0.5, 3.0]], jnp.float32),
+          "b": jnp.array([0.1, -0.1], jnp.float32)}
+GRADS = {"W": jnp.array([[0.1, -0.2], [0.3, 0.4]], jnp.float32),
+         "b": jnp.array([0.05, -0.05], jnp.float32)}
+
+
+@pytest.mark.parametrize("updater", [
+    Sgd(0.1), NoOp(), Nesterovs(0.1, momentum=0.9), Adam(1e-3),
+    AMSGrad(1e-3), Nadam(1e-3), AdaMax(1e-3), AdaGrad(0.1), RmsProp(0.01),
+    AdaDelta()])
+def test_updater_runs_and_shapes(updater):
+    state = updater.init_state(PARAMS)
+    upd, state2 = updater.apply(state, GRADS, 0)
+    for k in PARAMS:
+        assert upd[k].shape == PARAMS[k].shape
+        assert np.all(np.isfinite(np.asarray(upd[k])))
+    # second step with evolved state
+    upd2, _ = updater.apply(state2, GRADS, 1)
+    assert upd2["W"].shape == PARAMS["W"].shape
+
+
+def test_sgd_exact():
+    upd, _ = Sgd(0.5).apply((), GRADS, 0)
+    np.testing.assert_allclose(upd["W"], 0.5 * np.asarray(GRADS["W"]), rtol=1e-6)
+
+
+def test_adam_first_step_closed_form():
+    # t=1: m=(1-b1)g, v=(1-b2)g^2, alpha=lr*sqrt(1-b2)/(1-b1)
+    # => update = lr * g/|g| ... precisely lr*sign-ish: alpha*m/(sqrt(v)+eps)
+    lr, b1, b2, eps = 1e-3, 0.9, 0.999, 1e-8
+    upd, _ = Adam(lr, beta1=b1, beta2=b2, epsilon=eps).apply(
+        Adam(lr).init_state(PARAMS), GRADS, 0)
+    g = np.asarray(GRADS["W"])
+    alpha = lr * np.sqrt(1 - b2) / (1 - b1)
+    expect = alpha * (1 - b1) * g / (np.sqrt((1 - b2) * g * g) + eps)
+    np.testing.assert_allclose(np.asarray(upd["W"]), expect, rtol=1e-5)
+
+
+def test_nesterovs_cs231n_form():
+    mu, lr = 0.9, 0.1
+    u = Nesterovs(lr, momentum=mu)
+    v0 = u.init_state(PARAMS)
+    upd, v1 = u.apply(v0, GRADS, 0)
+    g = np.asarray(GRADS["W"])
+    v_new = -lr * g  # v0 = 0
+    np.testing.assert_allclose(np.asarray(v1["W"]), v_new, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(upd["W"]), -(1 + mu) * v_new, rtol=1e-6)
+
+
+def test_updater_json_roundtrip():
+    for u in [Sgd(0.1), Adam(StepSchedule(0.01, 0.5, 100)), Nesterovs(0.1, momentum=0.8)]:
+        d = u.to_json()
+        u2 = IUpdater.from_json(d)
+        assert type(u2) is type(u)
+        upd1, _ = u.apply(u.init_state(PARAMS), GRADS, 5)
+        upd2, _ = u2.apply(u2.init_state(PARAMS), GRADS, 5)
+        np.testing.assert_allclose(np.asarray(upd1["W"]), np.asarray(upd2["W"]))
+
+
+def test_schedules():
+    assert float(FixedSchedule(0.1).value_at(100)) == pytest.approx(0.1)
+    s = StepSchedule(1.0, 0.5, 10)
+    assert float(s.value_at(0)) == pytest.approx(1.0)
+    assert float(s.value_at(10)) == pytest.approx(0.5)
+    assert float(s.value_at(25)) == pytest.approx(0.25)
+    e = ExponentialSchedule(1.0, 0.9)
+    assert float(e.value_at(2)) == pytest.approx(0.81)
+    p = PolySchedule(1.0, 2.0, 100)
+    assert float(p.value_at(50)) == pytest.approx(0.25)
+    i = InverseSchedule(1.0, 1.0, 1.0)
+    assert float(i.value_at(1)) == pytest.approx(0.5)
+    m = MapSchedule({0: 0.1, 10: 0.01})
+    assert float(m.value_at(5)) == pytest.approx(0.1)
+    assert float(m.value_at(15)) == pytest.approx(0.01)
+    g = SigmoidSchedule(1.0, 0.5, 10)
+    assert float(g.value_at(10)) == pytest.approx(0.5)
