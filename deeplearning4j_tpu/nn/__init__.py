@@ -8,6 +8,11 @@ from deeplearning4j_tpu.nn.layers import (  # noqa: F401
     LayerNormalizationLayer, LocalResponseNormalizationLayer, LossLayer,
     OutputLayer, SeparableConvolution2DLayer, SubsamplingLayer,
     Upsampling2DLayer, ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.recurrent import (  # noqa: F401
+    Bidirectional, GravesLSTM, LastTimeStep, LSTM, RnnLossLayer,
+    RnnOutputLayer, SimpleRnn)
+from deeplearning4j_tpu.nn.attention import (  # noqa: F401
+    LearnedSelfAttentionLayer, RecurrentAttentionLayer, SelfAttentionLayer)
 from deeplearning4j_tpu.nn.multilayer import (  # noqa: F401
     MultiLayerConfiguration, MultiLayerNetwork, NeuralNetConfiguration)
 
@@ -19,6 +24,9 @@ _LAYER_CLASSES = [
     LayerNormalizationLayer, LocalResponseNormalizationLayer, LossLayer,
     OutputLayer, SeparableConvolution2DLayer, SubsamplingLayer,
     Upsampling2DLayer, ZeroPaddingLayer,
+    Bidirectional, GravesLSTM, LastTimeStep, LSTM, RnnLossLayer,
+    RnnOutputLayer, SimpleRnn,
+    LearnedSelfAttentionLayer, RecurrentAttentionLayer, SelfAttentionLayer,
 ]
 
 # Name -> class registry for config JSON round-trip (the reference's Jackson

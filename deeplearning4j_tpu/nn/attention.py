@@ -1,0 +1,190 @@
+"""Attention layers + fused scaled-dot-product attention op.
+
+Reference: `deeplearning4j-nn/.../nn/conf/layers/{SelfAttentionLayer,
+LearnedSelfAttentionLayer,RecurrentAttentionLayer}.java` (implemented there
+as SameDiff layers over the `dotProductAttention` /
+`multiHeadDotProductAttention` declarable ops,
+`libnd4j/include/ops/declarable/generic/nn/dot_product_attention.cpp`).
+
+TPU re-design: attention is expressed so XLA fuses QK^T → scale/mask →
+softmax → V into an MXU-friendly chain; the long-context path (blockwise /
+ring attention) lives in `parallel/ring_attention.py` (SURVEY.md §5.7 —
+capability-exceeding addition, the reference has no long-context story).
+Layout is `[B, T, F]` with heads split internally to `[B, heads, T, dh]`.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.core import InputType, Layer
+from deeplearning4j_tpu.ops.initializers import init_weights
+
+
+def dot_product_attention(q, k, v, mask=None, scaled: bool = True,
+                          dropout_rate: float = 0.0, rng=None):
+    """Fused scaled dot-product attention (the `dotProductAttention` op).
+
+    q: [..., Tq, dh], k/v: [..., Tk, dh]; mask: broadcastable to
+    [..., Tq, Tk] (1 = keep). Returns [..., Tq, dh].
+    """
+    dh = q.shape[-1]
+    scores = jnp.einsum("...qd,...kd->...qk", q, k)
+    if scaled:
+        scores = scores / jnp.sqrt(jnp.asarray(dh, scores.dtype))
+    if mask is not None:
+        big_neg = jnp.asarray(jnp.finfo(scores.dtype).min, scores.dtype)
+        scores = jnp.where(mask.astype(bool), scores, big_neg)
+    w = jax.nn.softmax(scores, axis=-1)
+    if dropout_rate > 0.0 and rng is not None:
+        keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, w.shape)
+        w = jnp.where(keep, w / (1.0 - dropout_rate), 0.0)
+    return jnp.einsum("...qk,...kd->...qd", w, v)
+
+
+def multi_head_attention(x_q, x_kv, params, n_heads, mask=None, rng=None,
+                         dropout_rate: float = 0.0):
+    """Multi-head attention with packed projections
+    (`multiHeadDotProductAttention`): params holds Wq/Wk/Wv `[F, H*dh]` and
+    Wo `[H*dv, F_out]`."""
+    B, Tq, _ = x_q.shape
+    Tk = x_kv.shape[1]
+
+    def split(y):
+        return y.reshape(B, -1, n_heads, y.shape[-1] // n_heads).transpose(0, 2, 1, 3)
+
+    q = split(x_q @ params["Wq"])
+    k = split(x_kv @ params["Wk"])
+    v = split(x_kv @ params["Wv"])
+    if mask is not None:
+        # [B,Tk] key mask -> [B,1,1,Tk]
+        mask = jnp.asarray(mask)[:, None, None, :]
+    o = dot_product_attention(q, k, v, mask=mask, dropout_rate=dropout_rate,
+                              rng=rng)
+    o = o.transpose(0, 2, 1, 3).reshape(B, Tq, -1)
+    return o @ params["Wo"]
+
+
+@dataclasses.dataclass(kw_only=True)
+class SelfAttentionLayer(Layer):
+    """Multi-head self-attention over a sequence (reference
+    `SelfAttentionLayer.java`): queries = keys = values = input. With
+    `project_input=True` uses learned Q/K/V/O projections."""
+
+    n_out: int = 0          # output size (projected); 0 = n_in
+    n_heads: int = 1
+    head_size: int = 0      # 0 = n_out / n_heads
+    project_input: bool = True
+    REGULARIZABLE: Tuple[str, ...] = ("Wq", "Wk", "Wv", "Wo")
+    STOCHASTIC: bool = True
+
+    def _sizes(self, n_in):
+        n_out = self.n_out or n_in
+        dh = self.head_size or (n_out // self.n_heads)
+        return n_out, dh
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        n_in = int(input_type.shape[-1])
+        n_out, dh = self._sizes(n_in)
+        if not self.project_input:
+            if self.n_heads != 1:
+                raise ValueError("project_input=False requires n_heads=1")
+            return {}, {}, InputType.recurrent(n_in, input_type.shape[0])
+        ks = jax.random.split(rng, 4)
+        H = self.n_heads
+        params = {
+            "Wq": init_weights(ks[0], (n_in, H * dh), self.winit(), dtype),
+            "Wk": init_weights(ks[1], (n_in, H * dh), self.winit(), dtype),
+            "Wv": init_weights(ks[2], (n_in, H * dh), self.winit(), dtype),
+            "Wo": init_weights(ks[3], (H * dh, n_out), self.winit(), dtype),
+        }
+        return params, {}, InputType.recurrent(n_out, input_type.shape[0])
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_input_dropout(x, train, rng)
+        if not self.project_input:
+            m = None if mask is None else jnp.asarray(mask)[:, None, :]
+            return dot_product_attention(x, x, x, mask=m), state
+        y = multi_head_attention(x, x, params, self.n_heads, mask=mask)
+        if mask is not None:
+            y = y * jnp.asarray(mask)[..., None].astype(y.dtype)
+        return y, state
+
+
+@dataclasses.dataclass(kw_only=True)
+class LearnedSelfAttentionLayer(SelfAttentionLayer):
+    """Attention with `n_queries` LEARNED query vectors (reference
+    `LearnedSelfAttentionLayer.java`) — output is a fixed-length sequence
+    `[B, n_queries, n_out]` regardless of input length."""
+
+    n_queries: int = 1
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        if not self.project_input:
+            raise ValueError(
+                "LearnedSelfAttentionLayer requires project_input=True "
+                "(learned queries only exist alongside Q/K/V projections)")
+        n_in = int(input_type.shape[-1])
+        n_out, dh = self._sizes(n_in)
+        kq, rest = jax.random.split(rng)
+        params, state, _ = super().initialize(rest, input_type, dtype)
+        params["Q"] = init_weights(kq, (self.n_queries, n_in), self.winit(), dtype)
+        return params, state, InputType.recurrent(n_out, self.n_queries)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_input_dropout(x, train, rng)
+        q = jnp.broadcast_to(params["Q"], (x.shape[0],) + params["Q"].shape)
+        y = multi_head_attention(q, x, params, self.n_heads, mask=mask)
+        return y, state
+
+
+@dataclasses.dataclass(kw_only=True)
+class RecurrentAttentionLayer(Layer):
+    """Recurrent cell with attention over the full input sequence at each
+    step (reference `RecurrentAttentionLayer.java`): h_t = act(x_t W +
+    h_{t-1} RW + attn(h_{t-1}, x) + b)."""
+
+    n_out: int = 0
+    n_heads: int = 1
+    REGULARIZABLE: Tuple[str, ...] = ("W", "RW", "Wq", "Wk", "Wv", "Wo")
+    STOCHASTIC: bool = True
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        n_in = int(input_type.shape[-1])
+        H = self.n_out
+        ks = jax.random.split(rng, 7)
+        params = {
+            "W": init_weights(ks[0], (n_in, H), self.winit(), dtype),
+            "RW": init_weights(ks[1], (H, H), self.winit(), dtype),
+            "b": jnp.full((H,), self.bias_init, dtype),
+            "Wq": init_weights(ks[2], (H, H), self.winit(), dtype),
+            "Wk": init_weights(ks[3], (n_in, H), self.winit(), dtype),
+            "Wv": init_weights(ks[4], (n_in, H), self.winit(), dtype),
+            "Wo": init_weights(ks[5], (H, H), self.winit(), dtype),
+        }
+        return params, {}, InputType.recurrent(H, input_type.shape[0])
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        from jax import lax
+        x = self.maybe_input_dropout(x, train, rng)
+        act = self.act_fn("tanh")
+        xp = x @ params["W"] + params["b"]               # [B,T,H]
+        keys = x @ params["Wk"]                          # [B,T,H]
+        vals = x @ params["Wv"]
+        kmask = None if mask is None else jnp.asarray(mask)[:, None, :]
+
+        def cell(h, xt):
+            q = (h @ params["Wq"])[:, None, :]           # [B,1,H]
+            a = dot_product_attention(q, keys, vals, mask=kmask)[:, 0, :]
+            h_new = act(xt + h @ params["RW"] + a @ params["Wo"])
+            return h_new, h_new
+
+        h0 = jnp.zeros((x.shape[0], self.n_out), xp.dtype)
+        _, hs = lax.scan(cell, h0, jnp.swapaxes(xp, 0, 1))
+        out = jnp.swapaxes(hs, 0, 1)
+        if mask is not None:
+            out = out * jnp.asarray(mask)[..., None].astype(out.dtype)
+        return out, state

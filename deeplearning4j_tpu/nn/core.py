@@ -115,6 +115,12 @@ class Layer:
         """Returns (output, new_state)."""
         raise NotImplementedError
 
+    def regularizable_mask(self, params: PyTree) -> PyTree:
+        """Bool pytree matching `params`: True where l1/l2/weight-decay apply
+        (the reference's `getRegularizationByParam` per-param dispatch).
+        Wrapper layers override to delegate to their inner layer."""
+        return {k: (k in self.REGULARIZABLE) for k in params}
+
     # ---- config resolution helpers ----
     def act_fn(self, default="identity"):
         return get_activation(self.activation if self.activation is not None else default)
@@ -141,7 +147,9 @@ class Layer:
             v = getattr(self, f.name)
             if isinstance(v, IUpdater):
                 v = v.to_json()
-            if callable(v) and not isinstance(v, str):
+            elif isinstance(v, Layer):      # nested layer (Bidirectional etc.)
+                v = v.to_json()
+            elif callable(v) and not isinstance(v, str):
                 v = getattr(v, "__name__", str(v))
             d[f.name] = v
         d["@layer"] = type(self).__name__
@@ -155,6 +163,9 @@ class Layer:
         cls = LAYER_REGISTRY[d.pop("@layer")]
         if isinstance(d.get("updater"), dict):
             d["updater"] = IUpdater.from_json(d["updater"])
+        for k, v in list(d.items()):
+            if isinstance(v, dict) and "@layer" in v:
+                d[k] = Layer.from_json(v)
         field_names = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in d.items() if k in field_names})
 

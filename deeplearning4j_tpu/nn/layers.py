@@ -85,17 +85,14 @@ class OutputLayer(DenseLayer):
 
     def compute_loss(self, params, state, x, labels, *, train=True, rng=None,
                      mask=None):
-        from deeplearning4j_tpu.ops.losses import LOGIT_LOSSES
+        from deeplearning4j_tpu.ops.losses import apply_loss
         x = self.maybe_input_dropout(x, train, rng)
         if x.ndim > 2 and not self._is_recurrent_input(x):
             x = x.reshape(x.shape[0], -1)
         pre = x @ params["W"]
         if self.has_bias:
             pre = pre + params["b"]
-        name = self.loss if isinstance(self.loss, str) else ""
-        if str(name).lower() in LOGIT_LOSSES:
-            return self.loss_fn()(labels, pre, mask)
-        return self.loss_fn()(labels, self.act_fn()(pre), mask)
+        return apply_loss(self.loss, self.act_fn(), pre, labels, mask)
 
 
 @dataclasses.dataclass(kw_only=True)
@@ -113,11 +110,8 @@ class LossLayer(Layer):
 
     def compute_loss(self, params, state, x, labels, *, train=True, rng=None,
                      mask=None):
-        from deeplearning4j_tpu.ops.losses import LOGIT_LOSSES
-        name = self.loss if isinstance(self.loss, str) else ""
-        if str(name).lower() in LOGIT_LOSSES:
-            return get_loss(self.loss)(labels, x, mask)
-        return get_loss(self.loss)(labels, self.act_fn()(x), mask)
+        from deeplearning4j_tpu.ops.losses import apply_loss
+        return apply_loss(self.loss, self.act_fn(), x, labels, mask)
 
 
 @dataclasses.dataclass(kw_only=True)

@@ -31,6 +31,26 @@ from deeplearning4j_tpu.train.updaters import (
 Params = Dict[str, PyTree]
 
 
+def _masked_leaves(params, mask):
+    """Yield param leaves where the layer's regularizable_mask is True
+    (mask may mark whole subtrees)."""
+    if isinstance(mask, dict):
+        for k, m in mask.items():
+            yield from _masked_leaves(params[k], m)
+    elif mask:
+        yield from jax.tree_util.tree_leaves(params)
+
+
+def _add_scaled_where(upd, params, mask, scale):
+    """upd += scale * params wherever mask is True (decoupled weight decay)."""
+    if isinstance(mask, dict):
+        return {k: _add_scaled_where(upd[k], params[k], mask[k], scale)
+                for k in upd}
+    if mask:
+        return jax.tree_util.tree_map(lambda u, p: u + scale * p, upd, params)
+    return upd
+
+
 # ---------------------------------------------------------------------------
 # Configuration
 # ---------------------------------------------------------------------------
@@ -242,6 +262,16 @@ class MultiLayerNetwork:
             x, s = layer.apply(params[name], state[name], x, train=train,
                                rng=lrng, mask=mask)
             new_state[name] = s
+            if mask is not None and self._layer_types:
+                # Mask propagation (the reference's feedForwardMaskArray):
+                # once a layer leaves sequence space or changes the sequence
+                # length, the [B,T] mask no longer applies downstream.
+                t_in, t_out = self._layer_types[i], self._layer_types[i + 1]
+                if (t_out.kind != "recurrent"
+                        or (t_in.kind == "recurrent"
+                            and t_in.shape[0] is not None
+                            and t_in.shape[0] != t_out.shape[0])):
+                    mask = None
         return x, new_state
 
     def _loss(self, params: Params, state: Params, x, y, rng,
@@ -275,14 +305,13 @@ class MultiLayerNetwork:
             l2 = layer.l2 if layer.l2 is not None else self.conf.l2
             if l1 == 0.0 and l2 == 0.0:
                 continue
-            for k in layer.REGULARIZABLE:
-                if k in params[name]:
-                    w = params[name][k]
-                    if l1:
-                        penalty = penalty + l1 * jnp.sum(jnp.abs(w))
-                    if l2:
-                        # reference L2Regularization: 0.5 * coeff * ||w||^2
-                        penalty = penalty + 0.5 * l2 * jnp.sum(w * w)
+            rmask = layer.regularizable_mask(params[name])
+            for w in _masked_leaves(params[name], rmask):
+                if l1:
+                    penalty = penalty + l1 * jnp.sum(jnp.abs(w))
+                if l2:
+                    # reference L2Regularization: 0.5 * coeff * ||w||^2
+                    penalty = penalty + 0.5 * l2 * jnp.sum(w * w)
         return penalty
 
     # ---- compiled step ----
@@ -326,11 +355,9 @@ class MultiLayerNetwork:
                       else conf.weight_decay)
                 if wd:
                     lr = upd_cfg.lr_at(iteration, epoch)
-                    upd = {
-                        k: (v + lr * wd * params[name][k]
-                            if k in layer.REGULARIZABLE else v)
-                        for k, v in upd.items()
-                    }
+                    upd = _add_scaled_where(
+                        upd, params[name],
+                        layer.regularizable_mask(params[name]), lr * wd)
                 new_params[name] = jax.tree_util.tree_map(
                     lambda p_, u_: p_ - u_, params[name], upd)
             return new_params, new_state, new_opt, loss

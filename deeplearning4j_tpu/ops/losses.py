@@ -63,6 +63,16 @@ def _sum_features(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(x.reshape(x.shape[0], -1), axis=-1)
 
 
+def apply_loss(loss, act_fn, pre, labels, mask=None):
+    """Single dispatch point for the logits-vs-activations split: losses in
+    LOGIT_LOSSES consume raw pre-activations (numerically-stable fused path);
+    everything else gets the configured activation applied first."""
+    name = loss if isinstance(loss, str) else ""
+    if str(name).lower() in LOGIT_LOSSES:
+        return get_loss(loss)(labels, pre, mask)
+    return get_loss(loss)(labels, act_fn(pre), mask)
+
+
 def mcxent(labels, logits, mask=None):
     """Multi-class cross entropy on logits (reference MCXENT fused with
     softmax activation — the numerically-stable path libnd4j uses via
