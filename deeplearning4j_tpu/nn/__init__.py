@@ -15,6 +15,11 @@ from deeplearning4j_tpu.nn.attention import (  # noqa: F401
     LearnedSelfAttentionLayer, RecurrentAttentionLayer, SelfAttentionLayer)
 from deeplearning4j_tpu.nn.multilayer import (  # noqa: F401
     MultiLayerConfiguration, MultiLayerNetwork, NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.graph import (  # noqa: F401
+    ComputationGraph, ComputationGraphConfiguration, ElementWiseVertex,
+    GraphBuilder, GraphVertex, L2NormalizeVertex, LayerVertex, MergeVertex,
+    ReshapeVertex, ScaleVertex, ShiftVertex, StackVertex, SubsetVertex,
+    UnstackVertex, register_vertex)
 
 _LAYER_CLASSES = [
     ActivationLayer, BatchNormalizationLayer, Convolution1DLayer,

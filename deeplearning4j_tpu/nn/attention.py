@@ -121,6 +121,8 @@ class LearnedSelfAttentionLayer(SelfAttentionLayer):
     `[B, n_queries, n_out]` regardless of input length."""
 
     n_queries: int = 1
+    # learned queries are a weight matrix: regularized like the projections
+    REGULARIZABLE: Tuple[str, ...] = ("Wq", "Wk", "Wv", "Wo", "Q")
 
     def initialize(self, rng, input_type, dtype=jnp.float32):
         if not self.project_input:

@@ -267,9 +267,11 @@ class MultiLayerNetwork:
                 # once a layer leaves sequence space or changes the sequence
                 # length, the [B,T] mask no longer applies downstream.
                 t_in, t_out = self._layer_types[i], self._layer_types[i + 1]
+                # None (dynamic T) vs a fixed length counts as a change:
+                # e.g. LearnedSelfAttention emits n_queries steps regardless
+                # of input length, so the [B,T] mask is stale either way.
                 if (t_out.kind != "recurrent"
                         or (t_in.kind == "recurrent"
-                            and t_in.shape[0] is not None
                             and t_in.shape[0] != t_out.shape[0])):
                     mask = None
         return x, new_state

@@ -187,6 +187,9 @@ class GravesLSTM(LSTM):
     2013 formulation)."""
 
     PEEPHOLE: bool = True
+    # peephole vectors are weights (packed with recurrent weights in the
+    # reference's param layout) — regularized alongside W/RW
+    REGULARIZABLE: Tuple[str, ...] = ("W", "RW", "pW")
 
 
 # ---------------------------------------------------------------------------

@@ -78,12 +78,22 @@ def write_model(net, path: str, save_updater: bool = True,
 
 
 def read_model(path: str, load_updater: bool = True):
+    """Restore either model class; dispatch on the config `format` tag (the
+    reference's ModelSerializer likewise restores MultiLayerNetwork or
+    ComputationGraph from one zip format)."""
     from deeplearning4j_tpu.nn.multilayer import (
         MultiLayerConfiguration, MultiLayerNetwork)
+    from deeplearning4j_tpu.nn.graph import (
+        ComputationGraph, ComputationGraphConfiguration)
     with zipfile.ZipFile(path, "r") as z:
-        conf = MultiLayerConfiguration.from_json(z.read(CONFIG_JSON).decode())
+        conf_json = z.read(CONFIG_JSON).decode()
         manifest = json.loads(z.read(MANIFEST_JSON).decode())
-        net = MultiLayerNetwork(conf).init()
+        if "ComputationGraphConfiguration" in json.loads(conf_json).get("format", ""):
+            net = ComputationGraph(
+                ComputationGraphConfiguration.from_json(conf_json)).init()
+        else:
+            net = MultiLayerNetwork(
+                MultiLayerConfiguration.from_json(conf_json)).init()
         net.params_ = _flat_to_tree(net.params_, z.read(COEFFICIENTS_BIN),
                                     manifest["params"])
         net.state_ = _flat_to_tree(net.state_, z.read(STATE_BIN),
