@@ -1,0 +1,115 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Headline metric (BASELINE.json): ResNet-50 training samples/sec/chip on the
+real TPU.  `vs_baseline` is measured-vs-north-star: the reference publishes
+no numbers (BASELINE.md), so the comparison point is the commonly cited
+nd4j-cuda/V100-class ResNet-50 training throughput of ~400 samples/sec/GPU
+(MLPerf-era V100 fp32 figures); >1.0 means we beat it.
+
+Extra per-config results (LeNet, LSTM char-LM) go to stderr so the stdout
+contract stays one line.  Run: `python bench.py [--quick]`.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+V100_RESNET50_SAMPLES_SEC = 400.0   # north-star comparison point (fp32 V100)
+
+
+def _time_steps(fit_fn, n_warmup, n_steps):
+    for _ in range(n_warmup):
+        fit_fn()
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        fit_fn()
+    return time.perf_counter() - t0
+
+
+def bench_resnet50(batch=64, steps=20, image=224, classes=1000):
+    import jax
+    from deeplearning4j_tpu.train.updaters import Nesterovs
+    from deeplearning4j_tpu.zoo import ResNet50
+
+    net = ResNet50(n_classes=classes, input_shape=(image, image, 3),
+                   updater=Nesterovs(0.1, 0.9)).init_model()
+    rng = np.random.RandomState(0)
+    x = rng.rand(batch, image, image, 3).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rng.randint(0, classes, batch)]
+
+    def step():
+        net.fit(x, y)
+        jax.block_until_ready(net.params_)
+
+    dt = _time_steps(step, n_warmup=3, n_steps=steps)
+    return batch * steps / dt
+
+
+def bench_lenet(batch=256, steps=30):
+    import jax
+    from deeplearning4j_tpu.zoo import LeNet
+
+    net = LeNet().init_model()
+    rng = np.random.RandomState(0)
+    x = rng.rand(batch, 28, 28, 1).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, batch)]
+
+    def step():
+        net.fit(x, y)
+        jax.block_until_ready(net.params_)
+
+    dt = _time_steps(step, n_warmup=3, n_steps=steps)
+    return batch * steps / dt
+
+
+def bench_lstm_charlm(batch=64, steps=10, t=64, vocab=77):
+    import jax
+    from deeplearning4j_tpu.zoo import TextGenLSTM
+
+    net = TextGenLSTM(n_classes=vocab, input_shape=(t, vocab)).init_model()
+    rng = np.random.RandomState(0)
+    idx = rng.randint(0, vocab, (batch, t))
+    x = np.eye(vocab, dtype=np.float32)[idx]
+    y = np.eye(vocab, dtype=np.float32)[np.roll(idx, -1, 1)]
+
+    def step():
+        net.fit(x, y)
+        jax.block_until_ready(net.params_)
+
+    dt = _time_steps(step, n_warmup=2, n_steps=steps)
+    return batch * t * steps / dt
+
+
+def main():
+    quick = "--quick" in sys.argv
+    import jax
+    n_chips = max(len(jax.devices()), 1)
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+
+    if quick:
+        sps = bench_resnet50(batch=16, steps=5, image=96, classes=100)
+    else:
+        sps = bench_resnet50()
+    per_chip = sps / n_chips
+
+    extras = {}
+    try:
+        extras["lenet_mnist_samples_sec"] = round(bench_lenet(), 1)
+        extras["lstm_charlm_tokens_sec"] = round(
+            bench_lstm_charlm(steps=3 if quick else 10), 1)
+    except Exception as e:  # extras must never break the headline line
+        print(f"extra benches failed: {e}", file=sys.stderr)
+    if extras:
+        print(json.dumps({"extras": extras}), file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "resnet50_train_samples_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(per_chip / V100_RESNET50_SAMPLES_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

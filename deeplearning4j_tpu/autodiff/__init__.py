@@ -1,0 +1,3 @@
+"""Declare-then-compile graph engine (SameDiff equivalent, reference L3)."""
+from deeplearning4j_tpu.autodiff.samediff import (  # noqa: F401
+    SameDiff, SDVariable, TrainingConfig)

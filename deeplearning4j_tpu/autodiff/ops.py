@@ -1,0 +1,291 @@
+"""Op table for the SameDiff-equivalent graph engine.
+
+Replaces the reference's ~500 libnd4j declarable ops
+(`libnd4j/include/ops/declarable/generic/**` + the codegen'd Java namespaces
+`org/nd4j/autodiff/samediff/ops/SD{Math,NN,CNN,RNN,Loss,...}.java`) with
+jax/lax lowerings: each entry is a pure function over jnp arrays; XLA fuses
+and differentiates them, so there are no hand-written `doDiff` rules.
+
+Only ops touched by the baseline configs + test suite are present (SURVEY.md
+§7 'hard parts (a)'); the registry is open — `register_op` adds more.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+OP_TABLE: Dict[str, Callable] = {}
+
+
+def register_op(name: str, fn: Callable = None):
+    if fn is None:
+        def deco(f):
+            OP_TABLE[name] = f
+            return f
+        return deco
+    OP_TABLE[name] = fn
+    return fn
+
+
+def _axis_tuple(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return (int(axis),)
+
+
+# ---- elementwise arithmetic ----
+register_op("add", lambda a, b: a + b)
+register_op("sub", lambda a, b: a - b)
+register_op("mul", lambda a, b: a * b)
+register_op("div", lambda a, b: a / b)
+register_op("rsub", lambda a, b: b - a)
+register_op("rdiv", lambda a, b: b / a)
+register_op("pow", lambda a, b: a ** b)
+register_op("neg", lambda a: -a)
+register_op("abs", jnp.abs)
+register_op("exp", jnp.exp)
+register_op("log", jnp.log)
+register_op("log1p", jnp.log1p)
+register_op("sqrt", jnp.sqrt)
+register_op("square", lambda a: a * a)
+register_op("reciprocal", lambda a: 1.0 / a)
+register_op("sign", jnp.sign)
+register_op("floor", jnp.floor)
+register_op("ceil", jnp.ceil)
+register_op("round", jnp.round)
+register_op("clip", lambda a, lo=None, hi=None: jnp.clip(a, lo, hi))
+register_op("maximum", jnp.maximum)
+register_op("minimum", jnp.minimum)
+
+# ---- trig / hyperbolic ----
+for n in ["sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh",
+          "tanh", "asinh", "acosh", "atanh"]:
+    register_op(n, getattr(jnp, n))
+
+# ---- comparisons / logic ----
+register_op("eq", lambda a, b: (a == b))
+register_op("neq", lambda a, b: (a != b))
+register_op("gt", lambda a, b: (a > b))
+register_op("gte", lambda a, b: (a >= b))
+register_op("lt", lambda a, b: (a < b))
+register_op("lte", lambda a, b: (a <= b))
+register_op("where", jnp.where)
+register_op("logical_and", jnp.logical_and)
+register_op("logical_or", jnp.logical_or)
+register_op("logical_not", jnp.logical_not)
+register_op("isnan", jnp.isnan)
+register_op("isinf", jnp.isinf)
+
+# ---- reductions ----
+register_op("sum", lambda a, axis=None, keepdims=False:
+            jnp.sum(a, axis=_axis_tuple(axis), keepdims=keepdims))
+register_op("mean", lambda a, axis=None, keepdims=False:
+            jnp.mean(a, axis=_axis_tuple(axis), keepdims=keepdims))
+register_op("max", lambda a, axis=None, keepdims=False:
+            jnp.max(a, axis=_axis_tuple(axis), keepdims=keepdims))
+register_op("min", lambda a, axis=None, keepdims=False:
+            jnp.min(a, axis=_axis_tuple(axis), keepdims=keepdims))
+register_op("prod", lambda a, axis=None, keepdims=False:
+            jnp.prod(a, axis=_axis_tuple(axis), keepdims=keepdims))
+register_op("std", lambda a, axis=None, keepdims=False, ddof=0:
+            jnp.std(a, axis=_axis_tuple(axis), keepdims=keepdims, ddof=ddof))
+register_op("var", lambda a, axis=None, keepdims=False, ddof=0:
+            jnp.var(a, axis=_axis_tuple(axis), keepdims=keepdims, ddof=ddof))
+register_op("norm2", lambda a, axis=None, keepdims=False:
+            jnp.sqrt(jnp.sum(a * a, axis=_axis_tuple(axis), keepdims=keepdims)))
+register_op("argmax", lambda a, axis=-1: jnp.argmax(a, axis=axis))
+register_op("argmin", lambda a, axis=-1: jnp.argmin(a, axis=axis))
+register_op("cumsum", lambda a, axis=0: jnp.cumsum(a, axis=axis))
+register_op("logsumexp", lambda a, axis=None, keepdims=False:
+            jax.scipy.special.logsumexp(a, axis=_axis_tuple(axis),
+                                        keepdims=keepdims))
+
+# ---- linalg / shape ----
+register_op("matmul", jnp.matmul)
+register_op("mmul", jnp.matmul)
+register_op("tensordot", lambda a, b, axes=2: jnp.tensordot(a, b, axes))
+register_op("transpose", lambda a, perm=None: jnp.transpose(a, perm))
+register_op("reshape", lambda a, shape: jnp.reshape(a, tuple(shape)))
+register_op("permute", lambda a, perm: jnp.transpose(a, perm))
+register_op("expand_dims", lambda a, axis=0: jnp.expand_dims(a, axis))
+register_op("squeeze", lambda a, axis=None: jnp.squeeze(a, axis))
+register_op("concat", lambda *xs, axis=0: jnp.concatenate(xs, axis=axis))
+register_op("stack", lambda *xs, axis=0: jnp.stack(xs, axis=axis))
+register_op("unstack_at", lambda a, index=0, axis=0:
+            lax.index_in_dim(a, index, axis, keepdims=False))
+register_op("tile", lambda a, reps: jnp.tile(a, tuple(reps)))
+register_op("slice", lambda a, begin, size:
+            lax.dynamic_slice(a, tuple(begin), tuple(size)))
+register_op("strided_slice", lambda a, begin, end, strides=None:
+            a[tuple(slice(b, e, s) for b, e, s in
+                    zip(begin, end, strides or [1] * len(begin)))])
+register_op("gather", lambda a, idx, axis=0:
+            jnp.take(a, idx.astype(jnp.int32), axis=axis))
+register_op("one_hot", lambda idx, depth, dtype="float32":
+            jax.nn.one_hot(idx, depth, dtype=jnp.dtype(dtype)))
+register_op("cast", lambda a, dtype: a.astype(jnp.dtype(dtype)))
+register_op("shape_of", lambda a: jnp.asarray(a.shape, jnp.int32))
+register_op("zeros_like", jnp.zeros_like)
+register_op("ones_like", jnp.ones_like)
+register_op("pad", lambda a, paddings, value=0.0:
+            jnp.pad(a, tuple(tuple(p) for p in paddings),
+                    constant_values=value))
+register_op("identity", lambda a: a)
+
+# ---- nn ----
+register_op("relu", jax.nn.relu)
+register_op("relu6", jax.nn.relu6)
+register_op("leaky_relu", lambda a, alpha=0.01: jax.nn.leaky_relu(a, alpha))
+register_op("elu", jax.nn.elu)
+register_op("selu", jax.nn.selu)
+register_op("gelu", jax.nn.gelu)
+register_op("sigmoid", jax.nn.sigmoid)
+register_op("softplus", jax.nn.softplus)
+register_op("softsign", jax.nn.soft_sign)
+register_op("swish", jax.nn.swish)
+register_op("hard_sigmoid", jax.nn.hard_sigmoid)
+register_op("softmax", lambda a, axis=-1: jax.nn.softmax(a, axis=axis))
+register_op("log_softmax", lambda a, axis=-1: jax.nn.log_softmax(a, axis=axis))
+register_op("erf", jax.scipy.special.erf)
+
+
+@register_op("linear")
+def _linear(x, w, b=None):
+    y = x @ w
+    return y if b is None else y + b
+
+
+@register_op("layer_norm")
+def _layer_norm(x, gain, bias=None, eps=1e-5, axis=-1):
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + eps) * gain
+    return y if bias is None else y + bias
+
+
+@register_op("batch_norm")
+def _batch_norm(x, mean, var, gamma=None, beta=None, eps=1e-5):
+    y = (x - mean) / jnp.sqrt(var + eps)
+    if gamma is not None:
+        y = y * gamma
+    if beta is not None:
+        y = y + beta
+    return y
+
+
+@register_op("dropout")
+def _dropout(x, rng=None, p=0.5):
+    """p = RETAIN probability (reference semantics).  Identity when no rng
+    is fed (inference)."""
+    if rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, p, x.shape)
+    return jnp.where(keep, x / p, 0.0)
+
+
+@register_op("embedding_lookup")
+def _embedding_lookup(table, idx):
+    return table[idx.astype(jnp.int32)]
+
+
+# ---- cnn (NHWC / HWIO) ----
+@register_op("conv2d")
+def _conv2d(x, w, b=None, stride=(1, 1), padding="SAME", dilation=(1, 1)):
+    y = lax.conv_general_dilated(
+        x, w, window_strides=tuple(stride), padding=padding,
+        rhs_dilation=tuple(dilation),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return y if b is None else y + b
+
+
+@register_op("max_pooling2d")
+def _max_pool(x, kernel=(2, 2), stride=(2, 2), padding="VALID"):
+    return lax.reduce_window(x, -jnp.inf, lax.max,
+                             (1,) + tuple(kernel) + (1,),
+                             (1,) + tuple(stride) + (1,), padding)
+
+
+@register_op("avg_pooling2d")
+def _avg_pool(x, kernel=(2, 2), stride=(2, 2), padding="VALID"):
+    dims = (1,) + tuple(kernel) + (1,)
+    strides = (1,) + tuple(stride) + (1,)
+    s = lax.reduce_window(x, 0.0, lax.add, dims, strides, padding)
+    c = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, dims, strides,
+                          padding)
+    return s / c
+
+
+# ---- attention ----
+@register_op("dot_product_attention")
+def _dpa(q, k, v, mask=None, scaled=True):
+    """[B, T, H] single-head (reference `dotProductAttention` declarable op,
+    `libnd4j .../generic/nn/dot_product_attention.cpp`)."""
+    scores = q @ jnp.swapaxes(k, -1, -2)
+    if scaled:
+        scores = scores / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
+    if mask is not None:
+        scores = jnp.where(mask[..., None, :] > 0, scores, -1e9)
+    return jax.nn.softmax(scores, axis=-1) @ v
+
+
+# ---- losses (label-first signature, reference SDLoss convention) ----
+@register_op("softmax_cross_entropy")
+def _sce(labels, logits, axis=-1):
+    return jnp.mean(-jnp.sum(labels * jax.nn.log_softmax(logits, axis=axis),
+                             axis=axis))
+
+
+@register_op("sparse_softmax_cross_entropy")
+def _ssce(labels, logits):
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(lp, labels[..., None].astype(jnp.int32),
+                                 axis=-1)[..., 0]
+    return -jnp.mean(picked)
+
+
+@register_op("sigmoid_cross_entropy")
+def _sigce(labels, logits):
+    return jnp.mean(jnp.maximum(logits, 0) - logits * labels
+                    + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+
+@register_op("mean_squared_error")
+def _mse(labels, preds):
+    return jnp.mean((labels - preds) ** 2)
+
+
+@register_op("absolute_difference")
+def _mae(labels, preds):
+    return jnp.mean(jnp.abs(labels - preds))
+
+
+@register_op("l2_loss")
+def _l2(a):
+    return 0.5 * jnp.sum(a * a)
+
+
+@register_op("huber_loss")
+def _huber(labels, preds, delta=1.0):
+    err = jnp.abs(labels - preds)
+    quad = jnp.minimum(err, delta)
+    return jnp.mean(0.5 * quad * quad + delta * (err - quad))
+
+
+@register_op("log_loss")
+def _log_loss(labels, probs, eps=1e-7):
+    p = jnp.clip(probs, eps, 1 - eps)
+    return -jnp.mean(labels * jnp.log(p) + (1 - labels) * jnp.log1p(-p))
+
+
+@register_op("cosine_distance")
+def _cos_dist(labels, preds, axis=-1, eps=1e-8):
+    ln = labels / jnp.maximum(jnp.linalg.norm(labels, axis=axis,
+                                              keepdims=True), eps)
+    pn = preds / jnp.maximum(jnp.linalg.norm(preds, axis=axis,
+                                             keepdims=True), eps)
+    return jnp.mean(1.0 - jnp.sum(ln * pn, axis=axis))

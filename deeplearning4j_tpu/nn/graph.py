@@ -383,6 +383,10 @@ class GraphBuilder:
         self._inputs.extend(names); return self
 
     def set_input_types(self, *types: InputType):
+        if len(types) != len(self._inputs):
+            raise ValueError(
+                f"set_input_types got {len(types)} types for "
+                f"{len(self._inputs)} declared inputs (call add_inputs first)")
         for name, t in zip(self._inputs, types):
             self._input_types[name] = t
         return self
@@ -694,6 +698,14 @@ class ComputationGraph:
         return acts
 
     def evaluate(self, iterator, evaluation=None):
+        """Single-output classification eval (the reference likewise rejects
+        multi-output graphs in `evaluate()`); for multi-head graphs run
+        `output()` and feed an Evaluation per head."""
+        if len(self.conf.network_outputs) != 1:
+            raise ValueError(
+                "evaluate() requires a single-output graph; this one has "
+                f"{self.conf.network_outputs} — use output() + Evaluation "
+                "per head")
         from deeplearning4j_tpu.train.evaluation import Evaluation
         ev = evaluation or Evaluation()
         if hasattr(iterator, "reset"):
@@ -726,12 +738,15 @@ class ComputationGraph:
         self.params_ = jax.tree_util.tree_unflatten(treedef, out)
 
     def gradient_for(self, features, labels) -> Params:
-        """Analytic gradients (GradientCheckUtil hook)."""
+        """Analytic gradients (GradientCheckUtil hook).  Eval mode, matching
+        `score_for` — finite differences of score_for are only comparable to
+        gradients taken in the same mode (BN running stats, no dropout)."""
         inputs = self._as_input_dict(features)
         labels = self._as_list(labels)
 
         def loss_fn(p):
-            return self._loss(p, self.state_, inputs, labels, None)[0]
+            return self._loss(p, self.state_, inputs, labels, None,
+                              train=False)[0]
         return jax.grad(loss_fn)(self.params_)
 
     def set_listeners(self, *listeners):

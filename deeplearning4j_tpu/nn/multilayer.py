@@ -477,10 +477,13 @@ class MultiLayerNetwork:
     # ---- gradient-check hook ----
     def gradient_for(self, x, y, features_mask=None, labels_mask=None) -> Params:
         """Analytic gradients of the score wrt params (no update) — the
-        `computeGradientAndScore` half used by GradientCheckUtil."""
+        `computeGradientAndScore` half used by GradientCheckUtil.  Eval mode,
+        consistent with `score_for` finite differences (BN running stats,
+        no dropout)."""
         def loss_fn(p):
             return self._loss(p, self.state_, jnp.asarray(x), jnp.asarray(y),
-                              None, features_mask, labels_mask)[0]
+                              None, features_mask, labels_mask,
+                              train=False)[0]
         return jax.grad(loss_fn)(self.params_)
 
     def set_listeners(self, *listeners):

@@ -1,0 +1,15 @@
+"""Distributed training/inference (reference L6 `deeplearning4j-scaleout/`).
+
+The reference's three distribution mechanisms — `ParallelWrapper` (single-node
+multi-GPU threads + parameter averaging), `SharedTrainingMaster` (async
+threshold-compressed gradient gossip over Aeron UDP), and
+`ParameterAveragingTrainingMaster` (Spark aggregate) — all collapse into ONE
+TPU-native mechanism: shard the batch over a `jax.sharding.Mesh` axis and let
+XLA's SPMD partitioner insert all-reduces over ICI.  See SURVEY.md §2.3/§2.4.
+"""
+from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec, data_sharding, make_mesh, replicated)
+from deeplearning4j_tpu.parallel.wrapper import (  # noqa: F401
+    ParallelInference, ParallelWrapper)
+from deeplearning4j_tpu.parallel.sharding import (  # noqa: F401
+    ShardingRules, shard_model_params)

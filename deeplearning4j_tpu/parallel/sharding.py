@@ -1,0 +1,73 @@
+"""Parameter sharding rules (tensor parallelism).
+
+The reference has NO tensor parallelism (SURVEY.md §2.3) — this is the
+capability-exceeding TPU-native addition: weight matrices annotated with
+`PartitionSpec`s over the `model` mesh axis; XLA inserts the all-gathers /
+reduce-scatters.  Rules are (param-path-suffix -> spec) with a sensible
+default: split the output dim of 2-D kernels over `model` when divisible,
+replicate everything else.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+@dataclasses.dataclass
+class ShardingRules:
+    """Ordered (regex -> PartitionSpec) rules applied to param-tree paths
+    (first match wins).  `None` entries in a spec mean replicate that dim."""
+
+    rules: List[Tuple[str, P]] = dataclasses.field(default_factory=list)
+    model_axis: str = "model"
+
+    def add(self, pattern: str, spec: P) -> "ShardingRules":
+        self.rules.append((pattern, spec))
+        return self
+
+    def spec_for(self, path: str, shape: Tuple[int, ...],
+                 mesh: Mesh) -> P:
+        for pattern, spec in self.rules:
+            if re.search(pattern, path):
+                return spec
+        return self._default_spec(path, shape, mesh)
+
+    def _default_spec(self, path: str, shape, mesh: Mesh) -> P:
+        """Megatron-style default: split 2-D kernel output dim over `model`
+        when the axis exists and divides; biases/scalars replicated."""
+        if self.model_axis not in mesh.axis_names:
+            return P()
+        size = mesh.shape[self.model_axis]
+        if len(shape) >= 2 and shape[-1] % size == 0 and shape[-1] >= size:
+            return P(*([None] * (len(shape) - 1) + [self.model_axis]))
+        return P()
+
+
+def shard_model_params(params: Any, mesh: Mesh,
+                       rules: Optional[ShardingRules] = None) -> Any:
+    """device_put every param leaf with its rule's NamedSharding.  The jitted
+    train step then computes sharded — computation follows data."""
+    rules = rules or ShardingRules()
+
+    def place(path, leaf):
+        spec = rules.spec_for(_path_str(path), np.shape(leaf), mesh)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, params)

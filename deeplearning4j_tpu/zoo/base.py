@@ -1,0 +1,46 @@
+"""ZooModel base + registry (reference `zoo/ZooModel.java`, `ZooType`)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+from deeplearning4j_tpu.train.updaters import Adam, IUpdater
+
+ZOO_REGISTRY: Dict[str, type] = {}
+
+
+def zoo_model(cls):
+    ZOO_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+@dataclasses.dataclass
+class ZooModel:
+    """Common zoo config: class count, input shape (H, W, C) or sequence
+    spec, seed, updater.  `init_model()` returns the initialized network
+    (reference `ZooModel.init()`)."""
+
+    n_classes: int = 1000
+    input_shape: Tuple[int, ...] = (224, 224, 3)
+    seed: int = 123
+    updater: Optional[IUpdater] = None
+
+    def _updater(self) -> IUpdater:
+        return self.updater if self.updater is not None else Adam(1e-3)
+
+    def conf(self):
+        raise NotImplementedError
+
+    def init_model(self):
+        raise NotImplementedError
+
+    def pretrained(self, path: str):
+        """Load externally converted pretrained weights (flat-param .npz or
+        model zip).  The reference downloads from azure blob storage
+        (`ZooModel.initPretrained`); here weights must be local."""
+        import numpy as np
+        net = self.init_model()
+        if path.endswith(".npz"):
+            net.set_params(np.load(path)["params"])
+            return net
+        return type(net).load(path)

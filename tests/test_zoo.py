@@ -1,0 +1,97 @@
+"""Zoo model construction + forward-shape + tiny-train smoke tests
+(reference `deeplearning4j-zoo` tests `TestInstantiation.java`).
+
+Image models instantiate at reduced input sizes to keep CPU CI fast; the
+architectures are size-agnostic (Same-padded convs + global pooling).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.zoo import (AlexNet, Darknet19, LeNet, ResNet50,
+                                    SimpleCNN, SqueezeNet, TextGenLSTM, UNet,
+                                    VGG16, VGG19, ZOO_REGISTRY)
+
+
+def test_registry_contents():
+    for name in ["LeNet", "AlexNet", "VGG16", "VGG19", "ResNet50",
+                 "SqueezeNet", "Darknet19", "UNet", "SimpleCNN",
+                 "TextGenLSTM"]:
+        assert name in ZOO_REGISTRY
+
+
+def test_lenet_trains_mnist_shaped():
+    net = LeNet(n_classes=10).init_model()
+    rng = np.random.RandomState(0)
+    x = rng.rand(16, 28, 28, 1).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 16)]
+    s0 = net.score_for(x, y)
+    for _ in range(10):
+        net.fit(x, y)
+    assert net.score_for(x, y) < s0
+    assert net.output(x).shape == (16, 10)
+
+
+def test_simplecnn_forward():
+    net = SimpleCNN(n_classes=5, input_shape=(32, 32, 3)).init_model()
+    x = np.random.RandomState(0).rand(4, 32, 32, 3).astype(np.float32)
+    assert net.output(x).shape == (4, 5)
+
+
+def test_resnet50_structure_and_forward():
+    m = ResNet50(n_classes=11, input_shape=(64, 64, 3))
+    conf = m.conf()
+    # 16 bottleneck blocks -> 16 add vertices
+    adds = [n for n in conf.vertices if n.endswith("_add")]
+    assert len(adds) == 16
+    net = m.init_model()
+    x = np.random.RandomState(0).rand(2, 64, 64, 3).astype(np.float32)
+    (out,) = net.output(x)
+    assert out.shape == (2, 11)
+    assert np.allclose(np.asarray(out).sum(1), 1.0, atol=1e-4)
+
+
+def test_resnet50_trains():
+    net = ResNet50(n_classes=3, input_shape=(32, 32, 3)).init_model()
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 32, 32, 3).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 8)]
+    s0 = net.score_for(x, y)
+    for _ in range(8):
+        net.fit(x, y)
+    assert net.score_for(x, y) < s0
+
+
+def test_squeezenet_forward():
+    net = SqueezeNet(n_classes=7, input_shape=(64, 64, 3)).init_model()
+    x = np.random.RandomState(0).rand(2, 64, 64, 3).astype(np.float32)
+    (out,) = net.output(x)
+    assert out.shape == (2, 7)
+
+
+def test_unet_forward_shape():
+    net = UNet(input_shape=(64, 64, 3), base_filters=8).init_model()
+    x = np.random.RandomState(0).rand(2, 64, 64, 3).astype(np.float32)
+    (out,) = net.output(x)
+    assert out.shape == (2, 64, 64, 1)
+    assert (np.asarray(out) >= 0).all() and (np.asarray(out) <= 1).all()
+
+
+def test_textgen_lstm_trains():
+    m = TextGenLSTM(n_classes=20, input_shape=(16, 20), lstm_units=32)
+    net = m.init_model()
+    rng = np.random.RandomState(0)
+    idx = rng.randint(0, 20, (8, 16))
+    x = np.eye(20, dtype=np.float32)[idx]
+    y = np.eye(20, dtype=np.float32)[np.roll(idx, -1, axis=1)]
+    s0 = net.score_for(x, y)
+    for _ in range(5):
+        net.fit(x, y)
+    assert net.score_for(x, y) < s0
+    assert net.output(x).shape == (8, 16, 20)
+
+
+@pytest.mark.parametrize("cls", [AlexNet, VGG16, VGG19, Darknet19])
+def test_imagenet_models_construct(cls):
+    # full 224x224 construct-only (init touches every shape-inference path)
+    net = cls(n_classes=10).init_model()
+    assert net.num_params() > 1_000_000
