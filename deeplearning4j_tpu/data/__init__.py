@@ -1,4 +1,17 @@
+"""Data pipeline (DataVec + dataset-iterator equivalents, reference L5)."""
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet  # noqa: F401
 from deeplearning4j_tpu.data.iterators import (  # noqa: F401
     ArrayDataSetIterator, AsyncDataSetIterator, DataSetIterator,
     ListDataSetIterator)
+from deeplearning4j_tpu.data.records import (  # noqa: F401
+    CollectionRecordReader, CSVRecordReader, CSVSequenceRecordReader,
+    ImageRecordReader, LineRecordReader, RecordReader)
+from deeplearning4j_tpu.data.transform import (  # noqa: F401
+    ColumnMeta, Schema, TransformProcess)
+from deeplearning4j_tpu.data.normalizers import (  # noqa: F401
+    ImagePreProcessingScaler, MultiNormalizer, Normalizer,
+    NormalizerMinMaxScaler, NormalizerStandardize)
+from deeplearning4j_tpu.data.rr_iterator import (  # noqa: F401
+    RecordReaderDataSetIterator, SequenceRecordReaderDataSetIterator)
+from deeplearning4j_tpu.data.datasets import (  # noqa: F401
+    IrisDataSetIterator, MnistDataSetIterator, SyntheticMnist, read_idx)

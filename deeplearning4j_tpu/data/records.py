@@ -1,0 +1,150 @@
+"""RecordReader zoo (DataVec equivalent).
+
+Reference: `datavec/datavec-api/.../records/reader/impl/**` —
+`CSVRecordReader`, `LineRecordReader`, `CollectionRecordReader`,
+`CSVSequenceRecordReader`, `datavec-data-image/.../ImageRecordReader`.
+
+A *record* is a list of writable values (here: python scalars/str/ndarray);
+a *sequence record* is a list of records.  Readers are restartable
+iterators over a source (`FileSplit`-style path lists or in-memory
+collections).
+
+`ImageRecordReader` reads `.npy`/`.npz` arrays (no PIL/OpenCV in the image
+— the reference leans on JavaCV; converted datasets must be ndarray files).
+"""
+from __future__ import annotations
+
+import csv
+import io
+import os
+from typing import Any, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+Record = List[Any]
+
+
+class RecordReader:
+    """Iteration + reset protocol (reference `RecordReader`)."""
+
+    def __iter__(self) -> Iterator[Record]:
+        raise NotImplementedError
+
+    def reset(self):
+        self._it = None          # restart the next_record stream too
+
+    def next_record(self):
+        if not hasattr(self, "_it") or self._it is None:
+            self._it = iter(self)
+        try:
+            return next(self._it)
+        except StopIteration:
+            self._it = None
+            raise
+
+
+class CollectionRecordReader(RecordReader):
+    """In-memory records (reference `CollectionRecordReader`)."""
+
+    def __init__(self, records: Sequence[Record]):
+        self._records = [list(r) for r in records]
+
+    def __iter__(self):
+        return iter(self._records)
+
+    def __len__(self):
+        return len(self._records)
+
+
+class LineRecordReader(RecordReader):
+    """One record per line (reference `LineRecordReader`)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def __iter__(self):
+        with open(self.path) as f:
+            for line in f:
+                yield [line.rstrip("\n")]
+
+
+class CSVRecordReader(RecordReader):
+    """CSV rows -> records of strings (reference `CSVRecordReader`;
+    `skip_lines` mirrors its skipNumLines, numeric parsing happens in
+    TransformProcess / the DataSet iterator, as in DataVec)."""
+
+    def __init__(self, path: Optional[str] = None, skip_lines: int = 0,
+                 delimiter: str = ",", text: Optional[str] = None):
+        if (path is None) == (text is None):
+            raise ValueError("Exactly one of path/text required")
+        self.path, self.text = path, text
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+
+    def __iter__(self):
+        f = open(self.path) if self.path else io.StringIO(self.text)
+        try:
+            reader = csv.reader(f, delimiter=self.delimiter)
+            for i, row in enumerate(reader):
+                if i < self.skip_lines or not row:
+                    continue
+                yield list(row)
+        finally:
+            f.close()
+
+
+class CSVSequenceRecordReader(RecordReader):
+    """One CSV file per sequence (reference `CSVSequenceRecordReader`):
+    iterates over files, yielding [timestep-record, ...] lists."""
+
+    def __init__(self, paths: Sequence[str], skip_lines: int = 0,
+                 delimiter: str = ","):
+        self.paths = list(paths)
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+
+    def __iter__(self):
+        for p in self.paths:
+            seq = list(CSVRecordReader(p, self.skip_lines, self.delimiter))
+            yield seq
+
+
+class ImageRecordReader(RecordReader):
+    """Image files -> [HWC float array, label-index] records (reference
+    `ImageRecordReader` + `NativeImageLoader`).  Labels come from the
+    parent directory name (the reference's `ParentPathLabelGenerator`).
+
+    Supports `.npy` (single image) and `.npz` (key 'image').  PNG/JPEG
+    need a converted dataset — no imaging library is available in this
+    environment (documented gate)."""
+
+    def __init__(self, paths: Sequence[str], height: int, width: int,
+                 channels: int = 3, labels: Optional[List[str]] = None):
+        self.paths = list(paths)
+        self.h, self.w, self.c = height, width, channels
+        if labels is None:
+            labels = sorted({os.path.basename(os.path.dirname(p))
+                             for p in self.paths})
+        self.labels = list(labels)
+
+    def _load(self, path: str) -> np.ndarray:
+        if path.endswith(".npy"):
+            arr = np.load(path)
+        elif path.endswith(".npz"):
+            arr = np.load(path)["image"]
+        else:
+            raise ValueError(
+                f"Unsupported image format '{path}': only .npy/.npz — "
+                "no PIL/OpenCV in this environment; convert first")
+        arr = np.asarray(arr, np.float32)
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        if arr.shape != (self.h, self.w, self.c):
+            raise ValueError(f"{path}: shape {arr.shape} != "
+                             f"{(self.h, self.w, self.c)}")
+        return arr
+
+    def __iter__(self):
+        for p in self.paths:
+            label = os.path.basename(os.path.dirname(p))
+            yield [self._load(p), self.labels.index(label)]

@@ -654,6 +654,7 @@ class ComputationGraph:
             lmasks, rng, jnp.asarray(self.iteration, jnp.int32),
             jnp.asarray(self.epoch, jnp.int32))
         self._score = loss
+        self._last_batch_size = int(next(iter(inputs.values())).shape[0])
         self.iteration += 1
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)

@@ -403,6 +403,7 @@ class MultiLayerNetwork:
             rng, jnp.asarray(self.iteration, jnp.int32),
             jnp.asarray(self.epoch, jnp.int32))
         self._score = loss
+        self._last_batch_size = int(x.shape[0])
         self.iteration += 1
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)

@@ -1,0 +1,192 @@
+"""Transfer learning (reference `deeplearning4j-nn/.../nn/transferlearning/
+{TransferLearning,TransferLearningHelper,FineTuneConfiguration}.java`).
+
+`TransferLearning.Builder` edits a trained MultiLayerNetwork's config —
+freeze a feature-extractor prefix, swap the output head, append layers —
+and builds a new network that keeps the retained layers' parameters.
+`TransferLearningHelper` featurizes data through the frozen prefix once so
+repeated fine-tune epochs skip the frozen compute entirely (the reference's
+`featurize`/`fitFeaturized` flow; on TPU this also shrinks the compiled
+step to the trainable suffix).
+
+ComputationGraph transfer learning: freeze + head-swap via the same
+builder pattern is future work (reference `TransferLearning.GraphBuilder`).
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn.core import InputType, Layer
+from deeplearning4j_tpu.nn.multilayer import (MultiLayerConfiguration,
+                                              MultiLayerNetwork)
+from deeplearning4j_tpu.train.updaters import IUpdater
+
+
+@dataclasses.dataclass
+class FineTuneConfiguration:
+    """Global-hyperparameter overrides for the fine-tune phase (reference
+    `FineTuneConfiguration`)."""
+
+    updater: Optional[IUpdater] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    weight_decay: Optional[float] = None
+    seed: Optional[int] = None
+
+    def apply(self, conf: MultiLayerConfiguration):
+        if self.updater is not None:
+            conf.updater = self.updater
+        if self.l1 is not None:
+            conf.l1 = self.l1
+        if self.l2 is not None:
+            conf.l2 = self.l2
+        if self.weight_decay is not None:
+            conf.weight_decay = self.weight_decay
+        if self.seed is not None:
+            conf.seed = self.seed
+
+
+class TransferLearning:
+    class Builder:
+        def __init__(self, net: MultiLayerNetwork):
+            self._net = net
+            self._conf = copy.deepcopy(net.conf)
+            self._freeze_upto: Optional[int] = None
+            self._removed_from: Optional[int] = None  # layers >= idx dropped
+            self._added: List[Layer] = []
+            self._reinit: set = set()                 # layer indices to re-init
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+
+        def fine_tune_configuration(self, ft: FineTuneConfiguration):
+            self._fine_tune = ft
+            return self
+
+        def set_feature_extractor(self, layer_index: int):
+            """Freeze layers [0, layer_index] (reference
+            `setFeatureExtractor`)."""
+            self._freeze_upto = layer_index
+            return self
+
+        def remove_output_layer(self):
+            return self.remove_layers_from_output(1)
+
+        def remove_layers_from_output(self, n: int):
+            keep = len(self._conf.layers) - n
+            if keep < 0:
+                raise ValueError(f"Cannot remove {n} of "
+                                 f"{len(self._conf.layers)} layers")
+            self._removed_from = keep
+            return self
+
+        def n_out_replace(self, layer_index: int, n_out: int,
+                          weight_init: Optional[str] = None):
+            """Resize a layer's output (reference `nOutReplace`): that layer
+            AND the next one re-initialize (the next layer's n_in changes)."""
+            layer = copy.deepcopy(self._conf.layers[layer_index])
+            if not hasattr(layer, "n_out"):
+                raise ValueError(f"Layer {layer_index} has no n_out")
+            layer.n_out = n_out
+            if weight_init:
+                layer.weight_init = weight_init
+            self._conf.layers[layer_index] = layer
+            self._reinit.add(layer_index)
+            if layer_index + 1 < len(self._conf.layers):
+                self._reinit.add(layer_index + 1)
+            return self
+
+        def add_layer(self, layer: Layer):
+            self._added.append(layer)
+            return self
+
+        def build(self) -> MultiLayerNetwork:
+            conf = self._conf
+            old_names = [conf.layer_name(i) for i in range(len(conf.layers))]
+            n_keep = (self._removed_from if self._removed_from is not None
+                      else len(conf.layers))
+            conf.layers = conf.layers[:n_keep] + self._added
+            if self._fine_tune:
+                self._fine_tune.apply(conf)
+            if self._freeze_upto is not None:
+                for i in range(min(self._freeze_upto + 1, len(conf.layers))):
+                    layer = copy.deepcopy(conf.layers[i])
+                    layer.frozen = True
+                    conf.layers[i] = layer
+            net = MultiLayerNetwork(conf).init()
+            # carry over parameters for retained, un-reinitialized layers
+            for i in range(min(n_keep, len(conf.layers))):
+                if i in self._reinit:
+                    continue
+                old = old_names[i]
+                new = conf.layer_name(i)
+                if old in self._net.params_:
+                    net.params_[new] = self._net.params_[old]
+                    net.state_[new] = self._net.state_[old]
+            return net
+
+    @staticmethod
+    def builder(net: MultiLayerNetwork) -> "TransferLearning.Builder":
+        return TransferLearning.Builder(net)
+
+
+class TransferLearningHelper:
+    """Featurize-through-frozen-prefix fine-tuning (reference
+    `TransferLearningHelper`)."""
+
+    def __init__(self, net: MultiLayerNetwork,
+                 frozen_till: Optional[int] = None):
+        if frozen_till is None:
+            frozen = [i for i, l in enumerate(net.conf.layers) if l.frozen]
+            frozen_till = max(frozen) if frozen else -1
+        self.frozen_till = frozen_till
+        self.full_net = net
+        self._boundary = frozen_till + 1
+        # the trainable suffix as its own network (compiled step excludes
+        # the frozen prefix entirely)
+        suffix_conf = copy.deepcopy(net.conf)
+        suffix_conf.layers = [copy.deepcopy(l)
+                              for l in net.conf.layers[self._boundary:]]
+        for l in suffix_conf.layers:
+            l.frozen = False
+        suffix_conf.input_type = net._layer_types[self._boundary]
+        self.unfrozen_net = MultiLayerNetwork(suffix_conf).init()
+        for j in range(len(suffix_conf.layers)):
+            old = net.conf.layer_name(self._boundary + j)
+            new = suffix_conf.layer_name(j)
+            self.unfrozen_net.params_[new] = net.params_[old]
+            self.unfrozen_net.state_[new] = net.state_[old]
+
+    def featurize(self, ds: DataSet) -> DataSet:
+        """Run the frozen prefix once (reference `featurize`)."""
+        h = ds.features
+        state = self.full_net.state_
+        for i in range(self._boundary):
+            name = self.full_net.conf.layer_name(i)
+            h, _ = self.full_net.conf.layers[i].apply(
+                self.full_net.params_[name], state[name], h,
+                train=False, rng=None)
+        return DataSet(np.asarray(h), ds.labels, ds.features_mask,
+                       ds.labels_mask)
+
+    def fit_featurized(self, ds: DataSet):
+        self.unfrozen_net.fit(ds.features, ds.labels)
+        return self
+
+    def output_from_featurized(self, features):
+        return self.unfrozen_net.output(features)
+
+    def unfrozen_mln(self) -> MultiLayerNetwork:
+        return self.unfrozen_net
+
+    def sync_to_full(self):
+        """Copy trained suffix params back into the full network."""
+        for j in range(len(self.unfrozen_net.conf.layers)):
+            old = self.full_net.conf.layer_name(self._boundary + j)
+            new = self.unfrozen_net.conf.layer_name(j)
+            self.full_net.params_[old] = self.unfrozen_net.params_[new]
+            self.full_net.state_[old] = self.unfrozen_net.state_[new]
+        return self.full_net

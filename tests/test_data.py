@@ -1,0 +1,190 @@
+"""Data pipeline tests (reference: datavec-api transform tests,
+RecordReaderDataSetIterator tests, normalizer tests)."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import (
+    ArrayDataSetIterator, CollectionRecordReader, CSVRecordReader,
+    CSVSequenceRecordReader, DataSet, ImagePreProcessingScaler,
+    ImageRecordReader, IrisDataSetIterator, ListDataSetIterator,
+    NormalizerMinMaxScaler, NormalizerStandardize,
+    RecordReaderDataSetIterator, Schema, SequenceRecordReaderDataSetIterator,
+    SyntheticMnist, TransformProcess)
+
+
+CSV_TEXT = """a,b,label
+1.0,2.0,0
+3.0,4.0,1
+5.0,6.0,2
+7.0,8.0,0
+"""
+
+
+def test_csv_record_reader():
+    rr = CSVRecordReader(text=CSV_TEXT, skip_lines=1)
+    recs = list(rr)
+    assert len(recs) == 4
+    assert recs[0] == ["1.0", "2.0", "0"]
+    # restartable
+    assert list(rr) == recs
+
+
+def test_record_reader_dataset_iterator_classification():
+    rr = CSVRecordReader(text=CSV_TEXT, skip_lines=1)
+    it = RecordReaderDataSetIterator(rr, batch_size=2, label_index=2,
+                                     num_classes=3)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].features.shape == (2, 2)
+    assert batches[0].labels.shape == (2, 3)
+    np.testing.assert_array_equal(batches[0].labels[1], [0, 1, 0])
+    # iterator is reusable after reset
+    it.reset()
+    assert len(list(it)) == 2
+
+
+def test_record_reader_dataset_iterator_regression():
+    rr = CSVRecordReader(text=CSV_TEXT, skip_lines=1)
+    it = RecordReaderDataSetIterator(rr, batch_size=4, label_index=2,
+                                     regression=True)
+    (ds,) = list(it)
+    assert ds.labels.shape == (4, 1)
+    np.testing.assert_allclose(ds.labels.ravel(), [0, 1, 2, 0])
+
+
+def test_transform_process():
+    schema = (Schema.builder()
+              .add_column_string("name")
+              .add_column_categorical("color", ["red", "green", "blue"])
+              .add_column_double("x", "y")
+              .build())
+    tp = (TransformProcess.builder(schema)
+          .remove_columns("name")
+          .categorical_to_integer("color")
+          .math_op_double("x", "Multiply", 2.0)
+          .filter_by_condition(lambda s, r: r[s.index_of("y")] > 0)
+          .build())
+    records = [["a", "red", 1.0, 5.0],
+               ["b", "blue", 2.0, -1.0],
+               ["c", "green", 3.0, 2.0]]
+    out = tp.execute(records)
+    assert out == [[0, 2.0, 5.0], [1, 6.0, 2.0]]
+    assert tp.final_schema().names() == ["color", "x", "y"]
+
+
+def test_transform_one_hot():
+    schema = (Schema.builder()
+              .add_column_categorical("c", ["p", "q"])
+              .add_column_double("v").build())
+    tp = (TransformProcess.builder(schema)
+          .categorical_to_one_hot("c").build())
+    out = tp.execute([["q", 3.0]])
+    assert out == [[0.0, 1.0, 3.0]]
+    assert tp.final_schema().names() == ["c[p]", "c[q]", "v"]
+
+
+def test_normalizer_standardize_roundtrip():
+    rng = np.random.RandomState(0)
+    x = rng.randn(100, 5).astype(np.float32) * 3 + 7
+    it = ListDataSetIterator([DataSet(x[i:i + 20], np.zeros((20, 1)))
+                              for i in range(0, 100, 20)])
+    nz = NormalizerStandardize().fit(it)
+    ds = DataSet(x.copy(), np.zeros((100, 1)))
+    nz.transform(ds)
+    assert abs(ds.features.mean()) < 1e-4
+    assert abs(ds.features.std() - 1.0) < 1e-2
+    back = nz.revert_features(ds.features)
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-4)
+    # serde round-trip
+    nz2 = NormalizerStandardize.from_bytes(nz.to_bytes())
+    np.testing.assert_allclose(nz2.mean, nz.mean)
+
+
+def test_normalizer_minmax():
+    x = np.array([[0., 10.], [5., 20.], [10., 30.]], np.float32)
+    it = ListDataSetIterator([DataSet(x, np.zeros((3, 1)))])
+    nz = NormalizerMinMaxScaler().fit(it)
+    ds = DataSet(x.copy(), np.zeros((3, 1)))
+    nz.transform(ds)
+    np.testing.assert_allclose(ds.features.min(0), [0, 0])
+    np.testing.assert_allclose(ds.features.max(0), [1, 1])
+
+
+def test_image_scaler():
+    ds = DataSet(np.full((2, 4, 4, 3), 255.0, np.float32),
+                 np.zeros((2, 1)))
+    ImagePreProcessingScaler().transform(ds)
+    np.testing.assert_allclose(ds.features, 1.0)
+
+
+def test_sequence_iterator_padding(tmp_path):
+    # two csv sequence files with different lengths
+    p1 = tmp_path / "s1.csv"
+    p1.write_text("1.0,2.0,0\n3.0,4.0,1\n5.0,6.0,0\n")
+    p2 = tmp_path / "s2.csv"
+    p2.write_text("7.0,8.0,1\n")
+    rr = CSVSequenceRecordReader([str(p1), str(p2)])
+    it = SequenceRecordReaderDataSetIterator(rr, batch_size=2,
+                                             label_index=2, num_classes=2)
+    (ds,) = list(it)
+    assert ds.features.shape == (2, 3, 2)
+    np.testing.assert_array_equal(ds.features_mask,
+                                  [[1, 1, 1], [1, 0, 0]])
+    assert ds.labels.shape == (2, 3, 2)
+
+
+def test_image_record_reader(tmp_path):
+    for label in ["cat", "dog"]:
+        d = tmp_path / label
+        d.mkdir()
+        np.save(d / "img0.npy",
+                np.random.RandomState(0).rand(8, 8, 3).astype(np.float32))
+    paths = sorted(str(p) for p in tmp_path.rglob("*.npy"))
+    rr = ImageRecordReader(paths, 8, 8, 3)
+    recs = list(rr)
+    assert len(recs) == 2
+    assert recs[0][0].shape == (8, 8, 3)
+    assert rr.labels == ["cat", "dog"]
+    assert [r[1] for r in recs] == [0, 1]
+
+
+def test_synthetic_mnist_trains_lenet():
+    from deeplearning4j_tpu.zoo import LeNet
+    net = LeNet().init_model()
+    it = SyntheticMnist(batch_size=32, n_batches=5)
+    net.fit(it, epochs=3)
+    ev = net.evaluate(SyntheticMnist(batch_size=32, n_batches=3, seed=0))
+    assert ev.accuracy() > 0.5
+
+
+def test_iris_trains():
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.train.updaters import Adam
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+            .list([DenseLayer(n_out=16, activation="relu"),
+                   OutputLayer(n_out=3, loss="mcxent",
+                               activation="softmax")])
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    it = IrisDataSetIterator(batch_size=50)
+    nz = NormalizerStandardize().fit(it)
+    datasets = [nz.transform(ds) for ds in it]
+    net.fit(ListDataSetIterator(datasets), epochs=30)
+    ev = net.evaluate(ListDataSetIterator(datasets))
+    assert ev.accuracy() > 0.9
+
+
+def test_idx_roundtrip(tmp_path):
+    from deeplearning4j_tpu.data import read_idx
+    import struct
+    arr = np.arange(24, dtype=np.uint8).reshape(2, 3, 4)
+    p = tmp_path / "test-idx3-ubyte"
+    with open(p, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 0x08, 3))
+        f.write(struct.pack(">III", 2, 3, 4))
+        f.write(arr.tobytes())
+    np.testing.assert_array_equal(read_idx(str(p)), arr)

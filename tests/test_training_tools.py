@@ -1,0 +1,178 @@
+"""Listeners, early stopping, transfer learning tests (reference:
+`TestEarlyStopping.java`, `TransferLearningMLNTest.java`,
+`TestCheckpointListener.java`)."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn import (DenseLayer, InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.nn.transferlearning import (
+    FineTuneConfiguration, TransferLearning, TransferLearningHelper)
+from deeplearning4j_tpu.train.earlystopping import (
+    DataSetLossCalculator, EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    InMemoryModelSaver, LocalFileModelSaver,
+    MaxEpochsTerminationCondition, MaxScoreIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition)
+from deeplearning4j_tpu.train.listeners import (
+    CheckpointListener, CollectScoresListener, PerformanceListener,
+    ScoreIterationListener)
+from deeplearning4j_tpu.train.updaters import Adam, Sgd
+
+
+def _net(n_in=6, n_out=3, seed=0, updater=None):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(updater or Adam(1e-2))
+            .list([DenseLayer(n_out=12, activation="relu"),
+                   DenseLayer(n_out=8, activation="relu"),
+                   OutputLayer(n_out=n_out, loss="mcxent",
+                               activation="softmax")])
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _iter(n=96, n_in=6, n_out=3, bs=32, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, n_in).astype(np.float32)
+    labels = (x[:, 0] > 0).astype(int) + (x[:, 1] > 0).astype(int)
+    y = np.eye(n_out, dtype=np.float32)[labels]
+    return ListDataSetIterator([DataSet(x[i:i + bs], y[i:i + bs])
+                                for i in range(0, n, bs)])
+
+
+def test_listeners_collect_and_log():
+    net = _net()
+    collect = CollectScoresListener()
+    perf = PerformanceListener(frequency=2)
+    net.set_listeners(ScoreIterationListener(5), collect, perf)
+    net.fit(_iter(), epochs=3)
+    assert len(collect.scores) == 9      # 3 batches * 3 epochs
+    assert collect.scores[-1] < collect.scores[0]
+    assert perf.last_iters_per_sec is not None
+    assert perf.last_samples_per_sec is not None
+
+
+def test_checkpoint_listener_rotation(tmp_path):
+    net = _net()
+    cl = CheckpointListener(str(tmp_path), every_n_iterations=2, keep_last=2)
+    net.set_listeners(cl)
+    net.fit(_iter(), epochs=3)           # 9 iterations -> 4 checkpoints
+    files = [f for f in os.listdir(tmp_path) if f.endswith(".zip")]
+    assert len(files) == 2               # rotation keeps last K
+    restored = MultiLayerNetwork.load(cl.last_checkpoint())
+    assert restored.iteration in (6, 8)
+
+
+def test_early_stopping_max_epochs():
+    net = _net()
+    es = EarlyStoppingTrainer(
+        EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(_iter(seed=1)),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(4)],
+            model_saver=InMemoryModelSaver()),
+        net, _iter())
+    result = es.fit()
+    assert result.total_epochs == 4
+    assert result.termination_reason == "EpochTerminationCondition"
+    assert result.best_model is not None
+    assert result.best_model_score < float("inf")
+
+
+def test_early_stopping_patience_stops_before_max():
+    net = _net(updater=Sgd(1e-6))        # lr too small to improve
+    es = EarlyStoppingTrainer(
+        EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(_iter(seed=1)),
+            epoch_termination_conditions=[
+                MaxEpochsTerminationCondition(50),
+                ScoreImprovementEpochTerminationCondition(
+                    patience=2, min_improvement=1e-3)],
+            model_saver=InMemoryModelSaver()),
+        net, _iter())
+    result = es.fit()
+    assert result.total_epochs < 50
+
+
+def test_early_stopping_divergence_abort():
+    net = _net(updater=Sgd(1e6))         # lr absurd -> divergence
+    es = EarlyStoppingTrainer(
+        EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(_iter(seed=1)),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(10)],
+            iteration_termination_conditions=[
+                MaxScoreIterationTerminationCondition(1e4)],
+            model_saver=InMemoryModelSaver()),
+        net, _iter())
+    result = es.fit()
+    assert result.termination_reason == "IterationTerminationCondition"
+
+
+def test_early_stopping_local_file_saver(tmp_path):
+    net = _net()
+    es = EarlyStoppingTrainer(
+        EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(_iter(seed=1)),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(2)],
+            model_saver=LocalFileModelSaver(str(tmp_path))),
+        net, _iter())
+    result = es.fit()
+    assert os.path.exists(tmp_path / "bestModel.zip")
+    assert isinstance(result.best_model, MultiLayerNetwork)
+
+
+def test_transfer_learning_head_swap():
+    base = _net(n_out=3)
+    base.fit(_iter(), epochs=2)
+    w0_before = np.asarray(base.params_["layer_0"]["W"]).copy()
+    new = (TransferLearning.builder(base)
+           .fine_tune_configuration(FineTuneConfiguration(updater=Adam(5e-3)))
+           .set_feature_extractor(1)        # freeze layers 0..1
+           .remove_output_layer()
+           .add_layer(OutputLayer(n_out=5, loss="mcxent",
+                                  activation="softmax"))
+           .build())
+    # retained layers kept their params
+    np.testing.assert_array_equal(np.asarray(new.params_["layer_0"]["W"]),
+                                  w0_before)
+    assert new.conf.layers[0].frozen and new.conf.layers[1].frozen
+    assert not new.conf.layers[2].frozen
+    # new head: 5 classes
+    it5 = _iter(n_out=5)
+    new.fit(it5, epochs=2)
+    # frozen layer params unchanged by training
+    np.testing.assert_array_equal(np.asarray(new.params_["layer_0"]["W"]),
+                                  w0_before)
+    assert new.output(np.zeros((2, 6), np.float32)).shape == (2, 5)
+
+
+def test_n_out_replace_reinitializes_downstream():
+    base = _net()
+    new = (TransferLearning.builder(base)
+           .n_out_replace(1, 20)
+           .build())
+    assert new.params_["layer_1"]["W"].shape == (12, 20)
+    assert new.params_["layer_2"]["W"].shape == (20, 3)
+
+
+def test_transfer_learning_helper_featurize():
+    base = _net()
+    new = (TransferLearning.builder(base)
+           .set_feature_extractor(0)
+           .build())
+    helper = TransferLearningHelper(new)
+    it = _iter()
+    feat = [helper.featurize(ds) for ds in it]
+    assert feat[0].features.shape == (32, 12)   # after layer_0
+    s0 = helper.unfrozen_mln().score_for(feat[0].features, feat[0].labels)
+    for _ in range(10):
+        for f in feat:
+            helper.fit_featurized(f)
+    s1 = helper.unfrozen_mln().score_for(feat[0].features, feat[0].labels)
+    assert s1 < s0
+    full = helper.sync_to_full()
+    # full-net output consistent with featurized path
+    out_full = np.asarray(full.output(it._list[0].features))
+    out_feat = np.asarray(helper.output_from_featurized(feat[0].features))
+    np.testing.assert_allclose(out_full, out_feat, rtol=1e-5, atol=1e-6)
