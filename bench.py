@@ -63,6 +63,34 @@ def bench_lenet(batch=256, steps=30):
     return batch * steps / dt
 
 
+def bench_bert_base(batch=64, steps=10, t=128, compute_dtype="bfloat16"):
+    """BERT-base masked-LM fine-tune step, tokens/sec (BASELINE config 3).
+    bf16 compute (master params f32) — the TPU-native precision choice."""
+    import jax
+    from deeplearning4j_tpu.train.updaters import Adam
+    from deeplearning4j_tpu.zoo import BertConfig, BertModel
+
+    model = BertModel(BertConfig.base(max_len=t,
+                                      compute_dtype=compute_dtype),
+                      updater=Adam(1e-4))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 30522, (batch, t)).astype(np.int32)
+    mask = np.ones((batch, t), np.float32)
+    sel = rng.rand(batch, t) < 0.15
+    lmask = sel.astype(np.float32)
+
+    from deeplearning4j_tpu.data.dataset import MultiDataSet
+    mds = MultiDataSet(features=[ids, mask], labels=[ids],
+                       labels_masks=[lmask])           # sparse labels
+
+    def step():
+        model.fit_batch(mds)
+        jax.block_until_ready(model.params_)
+
+    dt = _time_steps(step, n_warmup=3, n_steps=steps)
+    return batch * t * steps / dt
+
+
 def bench_lstm_charlm(batch=64, steps=10, t=64, vocab=77):
     import jax
     from deeplearning4j_tpu.zoo import TextGenLSTM
@@ -98,6 +126,8 @@ def main():
         extras["lenet_mnist_samples_sec"] = round(bench_lenet(), 1)
         extras["lstm_charlm_tokens_sec"] = round(
             bench_lstm_charlm(steps=3 if quick else 10), 1)
+        extras["bert_base_mlm_tokens_sec"] = round(
+            bench_bert_base(steps=3 if quick else 10), 1)
     except Exception as e:  # extras must never break the headline line
         print(f"extra benches failed: {e}", file=sys.stderr)
     if extras:

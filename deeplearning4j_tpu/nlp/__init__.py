@@ -1,0 +1,5 @@
+"""NLP (reference `deeplearning4j-nlp-parent/deeplearning4j-nlp/**`)."""
+from deeplearning4j_tpu.nlp.tokenization import (  # noqa: F401
+    BertWordPieceTokenizer, CommonPreprocessor, DefaultTokenizerFactory)
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec  # noqa: F401
+from deeplearning4j_tpu.nlp.bert_iterator import BertIterator  # noqa: F401

@@ -1,0 +1,278 @@
+"""Word2Vec (reference `deeplearning4j-nlp/.../models/word2vec/Word2Vec.java`
++ `SkipGram`/`CBOW` learning algorithms in
+`models/embeddings/learning/impl/elements/`).
+
+TPU-native inversion: the reference trains with custom multi-threaded Java
+workers doing per-pair hierarchical-softmax/negative-sampling updates; here
+pair generation is host-side numpy and the update is ONE jitted step over a
+batch of (center, context, negatives) — an embedding-gather + dot + sigmoid
+kernel XLA fuses; negative sampling only (hierarchical softmax's per-word
+Huffman paths are interpreter-shaped, not accelerator-shaped).
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import (CommonPreprocessor,
+                                                 DefaultTokenizerFactory)
+
+
+class Word2Vec:
+    """Skip-gram / CBOW with negative sampling.
+
+    Builder mirrors the reference:
+        w2v = (Word2Vec.builder()
+               .min_word_frequency(5).layer_size(100).window_size(5)
+               .negative_sample(5).epochs(1).learning_rate(0.025)
+               .seed(42).build())
+        w2v.fit(sentences)          # list[str] or token lists
+        w2v.get_word_vector("day"); w2v.words_nearest("day", 10)
+
+    Note on learning_rate: updates are batch-summed (per-pair semantics,
+    see _make_step), so same-word updates within a batch apply at once —
+    small corpora with few distinct words may need lr below the classic
+    0.025 to stay stable.
+    """
+
+    def __init__(self, layer_size=100, window_size=5, min_word_frequency=5,
+                 negative_sample=5, learning_rate=0.025, epochs=1,
+                 batch_size=1024, seed=42, elements_algo="skipgram",
+                 subsample=0.0):
+        # subsample=0 is the reference default (`sampling(0)`); enable
+        # (e.g. 1e-3) only for large corpora — it decimates toy ones.
+        self.layer_size = layer_size
+        self.window_size = window_size
+        self.min_word_frequency = min_word_frequency
+        self.negative = negative_sample
+        self.learning_rate = learning_rate
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+        self.elements_algo = elements_algo  # "skipgram" | "cbow"
+        self.subsample = subsample
+        self.vocab: Dict[str, int] = {}
+        self.inv_vocab: Dict[int, str] = {}
+        self.counts: Optional[np.ndarray] = None
+        self.syn0: Optional[np.ndarray] = None   # input vectors [V, D]
+        self.syn1: Optional[np.ndarray] = None   # output vectors [V, D]
+        self._tok = DefaultTokenizerFactory(CommonPreprocessor())
+
+    # ---- builder ----
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def __getattr__(self, name):
+            def setter(v):
+                self._kw[name] = v
+                return self
+            return setter
+
+        def build(self) -> "Word2Vec":
+            kw = dict(self._kw)
+            algo = kw.pop("elements_learning_algorithm", None)
+            if algo:
+                kw["elements_algo"] = algo.lower()
+            return Word2Vec(**kw)
+
+    @staticmethod
+    def builder() -> "Word2Vec.Builder":
+        return Word2Vec.Builder()
+
+    # ---- vocab ----
+    def _build_vocab(self, corpus: List[List[str]]):
+        from collections import Counter
+        c = Counter(t for sent in corpus for t in sent)
+        words = [w for w, n in c.most_common()
+                 if n >= self.min_word_frequency]
+        self.vocab = {w: i for i, w in enumerate(words)}
+        self.inv_vocab = {i: w for w, i in self.vocab.items()}
+        self.counts = np.array([c[w] for w in words], np.float64)
+
+    def _neg_table(self) -> np.ndarray:
+        """Unigram^0.75 sampling distribution (reference's negative-sampling
+        table)."""
+        p = self.counts ** 0.75
+        return p / p.sum()
+
+    # ---- pair generation (host-side ETL) ----
+    def _sent_ids(self, corpus, rng):
+        keep_p = None
+        if self.subsample:
+            freq = self.counts / self.counts.sum()
+            keep_p = np.minimum(
+                1.0, np.sqrt(self.subsample / np.maximum(freq, 1e-12))
+                + self.subsample / np.maximum(freq, 1e-12))
+        for sent in corpus:
+            ids = [self.vocab[t] for t in sent if t in self.vocab]
+            if keep_p is not None:
+                ids = [i for i in ids if rng.rand() < keep_p[i]]
+            yield ids
+
+    def _pairs(self, corpus: List[List[str]],
+               rng: np.random.RandomState) -> Tuple[np.ndarray, np.ndarray]:
+        """Skip-gram (center, context) pairs."""
+        centers, contexts = [], []
+        for ids in self._sent_ids(corpus, rng):
+            for pos, center in enumerate(ids):
+                w = rng.randint(1, self.window_size + 1)
+                for off in range(-w, w + 1):
+                    j = pos + off
+                    if off == 0 or j < 0 or j >= len(ids):
+                        continue
+                    centers.append(center)
+                    contexts.append(ids[j])
+        return (np.asarray(centers, np.int32),
+                np.asarray(contexts, np.int32))
+
+    def _cbow_windows(self, corpus, rng):
+        """CBOW examples: (ctx [N, 2w] padded, ctx_mask [N, 2w], center)."""
+        W = 2 * self.window_size
+        ctxs, masks, centers = [], [], []
+        for ids in self._sent_ids(corpus, rng):
+            for pos, center in enumerate(ids):
+                w = rng.randint(1, self.window_size + 1)
+                window = [ids[pos + off] for off in range(-w, w + 1)
+                          if off != 0 and 0 <= pos + off < len(ids)]
+                if not window:
+                    continue
+                row = np.zeros(W, np.int32)
+                msk = np.zeros(W, np.float32)
+                row[:len(window)] = window
+                msk[:len(window)] = 1.0
+                ctxs.append(row)
+                masks.append(msk)
+                centers.append(center)
+        return (np.asarray(ctxs, np.int32), np.asarray(masks, np.float32),
+                np.asarray(centers, np.int32))
+
+    # ---- compiled updates ----
+    def _make_step(self):
+        """Skip-gram: maximize log σ(v_c·u_o) + Σ log σ(-v_c·u_neg)."""
+        lr = self.learning_rate
+
+        def step(syn0, syn1, center, context, negatives):
+            def loss_fn(params):
+                s0, s1 = params
+                v = s0[center]                         # [B, D]
+                u_pos = s1[context]                    # [B, D]
+                u_neg = s1[negatives]                  # [B, neg, D]
+                pos = jnp.sum(v * u_pos, -1)
+                negs = jnp.einsum("bd,bnd->bn", v, u_neg)
+                # SUM over the batch: classic word2vec applies lr per PAIR;
+                # mean-reduction would shrink the step by batch_size
+                return -(jnp.sum(jax.nn.log_sigmoid(pos))
+                         + jnp.sum(jax.nn.log_sigmoid(-negs)))
+
+            loss, grads = jax.value_and_grad(loss_fn)((syn0, syn1))
+            g0, g1 = grads
+            return syn0 - lr * g0, syn1 - lr * g1, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def _make_cbow_step(self):
+        """CBOW: window-mean input embedding predicts the center word."""
+        lr = self.learning_rate
+
+        def step(syn0, syn1, ctx, ctx_mask, center, negatives):
+            def loss_fn(params):
+                s0, s1 = params
+                e = s0[ctx] * ctx_mask[..., None]      # [B, 2w, D]
+                v = jnp.sum(e, 1) / jnp.maximum(
+                    jnp.sum(ctx_mask, 1, keepdims=True), 1.0)
+                u_pos = s1[center]
+                u_neg = s1[negatives]
+                pos = jnp.sum(v * u_pos, -1)
+                negs = jnp.einsum("bd,bnd->bn", v, u_neg)
+                return -(jnp.sum(jax.nn.log_sigmoid(pos))
+                         + jnp.sum(jax.nn.log_sigmoid(-negs)))
+
+            loss, grads = jax.value_and_grad(loss_fn)((syn0, syn1))
+            g0, g1 = grads
+            return syn0 - lr * g0, syn1 - lr * g1, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    # ---- fit ----
+    def fit(self, sentences: Sequence) -> "Word2Vec":
+        corpus = [self._tok.tokenize(s) if isinstance(s, str) else list(s)
+                  for s in sentences]
+        self._build_vocab(corpus)
+        if not self.vocab:
+            raise ValueError("Empty vocabulary: lower min_word_frequency")
+        rng = np.random.RandomState(self.seed)
+        V, D = len(self.vocab), self.layer_size
+        syn0 = jnp.asarray((rng.rand(V, D) - 0.5) / D, jnp.float32)
+        syn1 = jnp.zeros((V, D), jnp.float32)
+        cbow = self.elements_algo == "cbow"
+        step = self._make_cbow_step() if cbow else self._make_step()
+        neg_p = self._neg_table()
+        bs = self.batch_size
+        for _ in range(self.epochs):
+            if cbow:
+                ctxs, masks, centers = self._cbow_windows(corpus, rng)
+            else:
+                centers, contexts = self._pairs(corpus, rng)
+            order = rng.permutation(len(centers))
+            n_full = (len(centers) // bs) * bs   # fixed shape: no recompile
+            for i in range(0, n_full, bs):
+                sel = order[i:i + bs]
+                negs = rng.choice(len(neg_p), size=(bs, self.negative),
+                                  p=neg_p).astype(np.int32)
+                if cbow:
+                    syn0, syn1, loss = step(syn0, syn1, ctxs[sel],
+                                            masks[sel], centers[sel], negs)
+                else:
+                    syn0, syn1, loss = step(syn0, syn1, centers[sel],
+                                            contexts[sel], negs)
+            self._last_loss = float(loss) if n_full else float("nan")
+        self.syn0 = np.asarray(syn0)
+        self.syn1 = np.asarray(syn1)
+        return self
+
+    # ---- lookup API (reference WordVectors interface) ----
+    def has_word(self, word: str) -> bool:
+        return word in self.vocab
+
+    def get_word_vector(self, word: str) -> np.ndarray:
+        return self.syn0[self.vocab[word]]
+
+    def similarity(self, w1: str, w2: str) -> float:
+        a, b = self.get_word_vector(w1), self.get_word_vector(w2)
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+
+    def words_nearest(self, word: str, n: int = 10) -> List[str]:
+        v = self.get_word_vector(word)
+        norms = np.linalg.norm(self.syn0, axis=1) + 1e-12
+        sims = self.syn0 @ v / (norms * np.linalg.norm(v) + 1e-12)
+        idx = np.argsort(-sims)
+        return [self.inv_vocab[i] for i in idx
+                if self.inv_vocab[i] != word][:n]
+
+    # ---- persistence (reference WordVectorSerializer) ----
+    def save(self, path: str):
+        np.savez_compressed(
+            path, syn0=self.syn0, syn1=self.syn1,
+            vocab=json.dumps(self.vocab), counts=self.counts,
+            config=json.dumps({
+                "layer_size": self.layer_size,
+                "window_size": self.window_size,
+                "negative": self.negative}))
+
+    @staticmethod
+    def load(path: str) -> "Word2Vec":
+        with np.load(path, allow_pickle=False) as z:
+            cfg = json.loads(str(z["config"]))
+            w = Word2Vec(layer_size=cfg["layer_size"],
+                         window_size=cfg["window_size"],
+                         negative_sample=cfg["negative"])
+            w.vocab = json.loads(str(z["vocab"]))
+            w.inv_vocab = {i: k for k, i in w.vocab.items()}
+            w.syn0, w.syn1 = z["syn0"], z["syn1"]
+            w.counts = z["counts"]
+        return w

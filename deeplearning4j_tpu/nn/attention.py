@@ -59,11 +59,19 @@ def multi_head_attention(x_q, x_kv, params, n_heads, mask=None, rng=None,
     q = split(x_q @ params["Wq"])
     k = split(x_kv @ params["Wk"])
     v = split(x_kv @ params["Wv"])
-    if mask is not None:
-        # [B,Tk] key mask -> [B,1,1,Tk]
-        mask = jnp.asarray(mask)[:, None, None, :]
-    o = dot_product_attention(q, k, v, mask=mask, dropout_rate=dropout_rate,
-                              rng=rng)
+    if dropout_rate > 0.0 and rng is not None:
+        # attention-weight dropout needs the materialized probabilities —
+        # naive path only (train-time memory, matching the reference)
+        o = dot_product_attention(q, k, v,
+                                  mask=None if mask is None
+                                  else jnp.asarray(mask)[:, None, None, :],
+                                  dropout_rate=dropout_rate, rng=rng)
+    else:
+        # flash/blockwise path: O(T) memory, Pallas kernel on TPU for
+        # cleanly tiling shapes (ops/attention_kernels.py)
+        from deeplearning4j_tpu.ops.attention_kernels import fused_attention
+        o = fused_attention(q, k, v,
+                            mask=None if mask is None else jnp.asarray(mask))
     o = o.transpose(0, 2, 1, 3).reshape(B, Tq, -1)
     return o @ params["Wo"]
 

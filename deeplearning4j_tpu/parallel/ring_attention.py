@@ -1,0 +1,80 @@
+"""Ring attention — sequence/context parallelism over the device mesh.
+
+The reference has NO long-context story (SURVEY.md §5.7: attention exists
+only as single-device ops; sequences are truncated).  This is the
+capability-exceeding TPU-native addition: shard the sequence axis over mesh
+axis `seq`; each step computes blockwise attention against the local KV
+shard, then rotates KV around the ring with `ppermute` over ICI while the
+online-softmax stats (acc, m, l) accumulate.  Communication overlaps the
+next chunk's compute under XLA's scheduler.  (Liu et al. 2023 "Ring
+Attention with Blockwise Transformers" — see PAPERS.md.)
+
+Use inside shard_map:
+
+    mesh = make_mesh({"data": 2, "seq": 4})
+    f = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="seq"),
+        mesh=mesh,
+        in_specs=P("data", None, "seq", None),
+        out_specs=P("data", None, "seq", None))
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None):
+    """[B, H, T_local, D] per device; returns the local output shard.
+
+    Causal masking uses global positions: device i holds sequence chunk i
+    (contiguous layout).  Per ring step the KV chunk's source device index
+    is tracked so query/key global offsets stay correct.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    B, H, T, D = q.shape
+    qs = q * scale
+
+    def chunk_scores(kc, src):
+        s = jnp.einsum("bhqd,bhkd->bhqk", qs, kc)
+        if causal:
+            qpos = my * T + jnp.arange(T)[:, None]
+            kpos = src * T + jnp.arange(kc.shape[2])[None, :]
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        return s
+
+    def accumulate(acc, m, l, kc, vc, src):
+        s = chunk_scores(kc, src)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = corr * l + jnp.sum(p, axis=-1)
+        acc_new = corr[..., None] * acc + jnp.einsum("bhqk,bhkd->bhqd", p,
+                                                     vc)
+        return acc_new, m_new, l_new
+
+    def step(i, carry):
+        acc, m, l, kc, vc = carry
+        # rotate KV around the ring (ICI neighbour exchange), then consume
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        acc, m, l = accumulate(acc, m, l, kc, vc, (my - i) % n)
+        return acc, m, l, kc, vc
+
+    acc = jnp.zeros_like(q)
+    # derive from q so the carries inherit shard_map's varying-axis type
+    m = jnp.full_like(q[..., 0], NEG_INF)
+    l = jnp.zeros_like(q[..., 0])
+    # step 0: local chunk, no communication; n-1 rotations total
+    acc, m, l = accumulate(acc, m, l, k, v, my)
+    acc, m, l, _, _ = jax.lax.fori_loop(1, n, step, (acc, m, l, k, v))
+    return acc / l[..., None]

@@ -10,3 +10,4 @@ from deeplearning4j_tpu.zoo.models import (  # noqa: F401
     AlexNet, Darknet19, LeNet, SimpleCNN, TextGenLSTM, VGG16, VGG19)
 from deeplearning4j_tpu.zoo.graphs import (  # noqa: F401
     ResNet50, SqueezeNet, UNet)
+from deeplearning4j_tpu.zoo.bert import BertConfig, BertModel  # noqa: F401

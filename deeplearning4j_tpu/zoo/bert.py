@@ -1,0 +1,286 @@
+"""BERT encoder (the reference's BERT workload: SameDiff TF-imported
+BERT-base fine-tune — BASELINE.json config 3 — plus `BertIterator` masked-LM
+pretraining, `deeplearning4j-nlp/.../iterator/BertIterator.java`).
+
+TPU-native design choices:
+- One jitted train step for the whole model (vs the reference's op-by-op
+  SameDiff session execution).
+- Transformer blocks have identical shapes -> parameters are STACKED
+  [L, ...] and the encoder is a `lax.scan` over layers: compile time stays
+  flat in depth and XLA pipelines the blocks.
+- Attention runs the fused flash/blockwise path
+  (ops/attention_kernels.py); `compute_dtype="bfloat16"` keeps master
+  params f32 and casts activations/matmuls to bf16 for the MXU.
+- Post-LN residual wiring (original BERT), GELU FFN.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops.attention_kernels import fused_attention
+from deeplearning4j_tpu.train.updaters import Adam, IUpdater
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    intermediate: int = 3072
+    max_len: int = 512
+    type_vocab: int = 2
+    eps: float = 1e-12
+    compute_dtype: str = "float32"     # "bfloat16" for TPU throughput
+    n_classes: int = 2                 # classification head width
+
+    @staticmethod
+    def base(**kw) -> "BertConfig":
+        return BertConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "BertConfig":
+        """Test-sized config."""
+        d = dict(vocab_size=100, hidden=64, n_layers=2, n_heads=4,
+                 intermediate=128, max_len=64)
+        d.update(kw)
+        return BertConfig(**d)
+
+
+def _ln(x, g, b, eps):
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + eps) * g + b
+
+
+class BertModel:
+    """BERT with masked-LM and sequence-classification heads.
+
+    fit(iterator) consumes BertIterator batches (task picked from batch
+    shape); output_hidden/output_mlm/output_cls for inference."""
+
+    def __init__(self, config: BertConfig, seed: int = 0,
+                 updater: Optional[IUpdater] = None):
+        self.config = config
+        self.updater = updater or Adam(1e-4)
+        self.iteration = 0
+        self.epoch = 0
+        self._rng = jax.random.PRNGKey(seed)
+        self.params_ = self._init(jax.random.PRNGKey(seed))
+        self.opt_state_ = self.updater.init_state(self.params_)
+        self._steps: Dict[str, Any] = {}
+
+    # ---- init ----
+    def _init(self, key) -> Dict[str, Any]:
+        c = self.config
+        k = jax.random.split(key, 16)
+        H, I, L = c.hidden, c.intermediate, c.n_layers
+        s = 0.02
+
+        def nrm(kk, *shape):
+            return (jax.random.normal(kk, shape) * s).astype(jnp.float32)
+
+        return {
+            "tok_emb": nrm(k[0], c.vocab_size, H),
+            "pos_emb": nrm(k[1], c.max_len, H),
+            "type_emb": nrm(k[2], c.type_vocab, H),
+            "emb_ln_g": jnp.ones((H,)), "emb_ln_b": jnp.zeros((H,)),
+            "layers": {
+                "Wq": nrm(k[3], L, H, H), "bq": jnp.zeros((L, H)),
+                "Wk": nrm(k[4], L, H, H), "bk": jnp.zeros((L, H)),
+                "Wv": nrm(k[5], L, H, H), "bv": jnp.zeros((L, H)),
+                "Wo": nrm(k[6], L, H, H), "bo": jnp.zeros((L, H)),
+                "ln1_g": jnp.ones((L, H)), "ln1_b": jnp.zeros((L, H)),
+                "Wi": nrm(k[7], L, H, I), "bi": jnp.zeros((L, I)),
+                "Wf": nrm(k[8], L, I, H), "bf": jnp.zeros((L, H)),
+                "ln2_g": jnp.ones((L, H)), "ln2_b": jnp.zeros((L, H)),
+            },
+            "pool_W": nrm(k[9], H, H), "pool_b": jnp.zeros((H,)),
+            "mlm_W": nrm(k[10], H, H), "mlm_b": jnp.zeros((H,)),
+            "mlm_ln_g": jnp.ones((H,)), "mlm_ln_b": jnp.zeros((H,)),
+            "mlm_bias": jnp.zeros((c.vocab_size,)),
+            "cls_W": nrm(k[11], H, c.n_classes),
+            "cls_b": jnp.zeros((c.n_classes,)),
+        }
+
+    # ---- forward ----
+    def _encode(self, params, ids, input_mask, segment_ids=None):
+        c = self.config
+        dt = jnp.dtype(c.compute_dtype)
+        T = ids.shape[1]
+        x = (params["tok_emb"][ids]
+             + params["pos_emb"][:T][None]
+             + (params["type_emb"][segment_ids] if segment_ids is not None
+                else params["type_emb"][0]))
+        x = _ln(x, params["emb_ln_g"], params["emb_ln_b"], c.eps)
+        x = x.astype(dt)
+        mask = input_mask.astype(dt)
+
+        def block(x, lp):
+            lp = jax.tree_util.tree_map(lambda a: a.astype(dt), lp)
+            B, T, H = x.shape
+            nh = c.n_heads
+            dh = H // nh
+
+            def split(y):
+                return y.reshape(B, T, nh, dh).transpose(0, 2, 1, 3)
+
+            q = split(x @ lp["Wq"] + lp["bq"])
+            k = split(x @ lp["Wk"] + lp["bk"])
+            v = split(x @ lp["Wv"] + lp["bv"])
+            a = fused_attention(q, k, v, mask=mask)
+            a = a.transpose(0, 2, 1, 3).reshape(B, T, H)
+            a = a @ lp["Wo"] + lp["bo"]
+            x = _ln(x + a, lp["ln1_g"], lp["ln1_b"], c.eps)
+            h = jax.nn.gelu(x @ lp["Wi"] + lp["bi"])
+            h = h @ lp["Wf"] + lp["bf"]
+            x = _ln(x + h, lp["ln2_g"], lp["ln2_b"], c.eps)
+            return x.astype(dt), None
+
+        x, _ = jax.lax.scan(block, x, params["layers"])
+        return x.astype(jnp.float32)
+
+    def _mlm_logits(self, params, hidden):
+        c = self.config
+        h = jax.nn.gelu(hidden @ params["mlm_W"] + params["mlm_b"])
+        h = _ln(h, params["mlm_ln_g"], params["mlm_ln_b"], c.eps)
+        # tied output embedding (BERT standard)
+        return h @ params["tok_emb"].T + params["mlm_bias"]
+
+    def _cls_logits(self, params, hidden):
+        pooled = jnp.tanh(hidden[:, 0] @ params["pool_W"]
+                          + params["pool_b"])
+        return pooled @ params["cls_W"] + params["cls_b"]
+
+    # ---- losses ----
+    def _mlm_loss(self, params, ids, input_mask, labels, label_mask):
+        """labels: sparse [B, T] int token ids (preferred — a one-hot
+        [B, T, V] labels array is 250MB/step of H2D at BERT-base scale) or
+        dense one-hot [B, T, V]."""
+        h = self._encode(params, ids, input_mask)
+        logits = self._mlm_logits(params, h)
+        lp = jax.nn.log_softmax(logits, -1)
+        if labels.ndim == 2:
+            per_tok = -jnp.take_along_axis(
+                lp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        else:
+            per_tok = -jnp.sum(labels * lp, -1)            # [B, T]
+        denom = jnp.maximum(jnp.sum(label_mask), 1.0)
+        return jnp.sum(per_tok * label_mask) / denom
+
+    def _cls_loss(self, params, ids, input_mask, labels):
+        h = self._encode(params, ids, input_mask)
+        logits = self._cls_logits(params, h)
+        return -jnp.mean(jnp.sum(labels * jax.nn.log_softmax(logits, -1),
+                                 -1))
+
+    # ---- compiled steps ----
+    def _step(self, kind: str):
+        if kind in self._steps:
+            return self._steps[kind]
+
+        loss_fn = self._mlm_loss if kind == "mlm" else self._cls_loss
+
+        def step(params, opt_state, iteration, epoch, *batch):
+            loss, grads = jax.value_and_grad(
+                lambda p: loss_fn(p, *batch))(params)
+            upd, new_opt = self.updater.apply(opt_state, grads, iteration,
+                                              epoch, params=params)
+            new_params = jax.tree_util.tree_map(lambda p, u: p - u,
+                                                params, upd)
+            return new_params, new_opt, loss
+
+        self._steps[kind] = jax.jit(step, donate_argnums=(0, 1))
+        return self._steps[kind]
+
+    # ---- public API ----
+    def fit(self, iterator, epochs: int = 1) -> "BertModel":
+        for _ in range(epochs):
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            for mds in iterator:
+                self.fit_batch(mds)
+            self.epoch += 1
+        return self
+
+    def fit_batch(self, mds):
+        ids, input_mask = [jnp.asarray(f) for f in mds.features]
+        (labels,) = [jnp.asarray(l) for l in mds.labels]
+        it = jnp.asarray(self.iteration, jnp.int32)
+        ep = jnp.asarray(self.epoch, jnp.int32)
+        if mds.labels_masks is not None:                 # masked LM
+            lmask = jnp.asarray(mds.labels_masks[0])
+            step = self._step("mlm")
+            self.params_, self.opt_state_, loss = step(
+                self.params_, self.opt_state_, it, ep,
+                ids.astype(jnp.int32), input_mask, labels, lmask)
+        else:                                            # classification
+            step = self._step("cls")
+            self.params_, self.opt_state_, loss = step(
+                self.params_, self.opt_state_, it, ep,
+                ids.astype(jnp.int32), input_mask, labels)
+        self._score = loss
+        self.iteration += 1
+        return float(loss)
+
+    def score(self) -> float:
+        s = getattr(self, "_score", None)
+        return float(s) if s is not None else float("nan")
+
+    def output_hidden(self, ids, input_mask):
+        return self._encode(self.params_, jnp.asarray(ids, jnp.int32),
+                            jnp.asarray(input_mask))
+
+    def output_mlm(self, ids, input_mask):
+        h = self.output_hidden(ids, input_mask)
+        return self._mlm_logits(self.params_, h)
+
+    def output_cls(self, ids, input_mask):
+        h = self.output_hidden(ids, input_mask)
+        return jax.nn.softmax(self._cls_logits(self.params_, h), -1)
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(self.params_))
+
+    # ---- persistence ----
+    def save(self, path: str):
+        import io, json, zipfile
+        leaves, treedef = jax.tree_util.tree_flatten(self.params_)
+        opt_leaves = jax.tree_util.tree_leaves(self.opt_state_)
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr("config.json", json.dumps(
+                {**dataclasses.asdict(self.config),
+                 "iteration": self.iteration, "epoch": self.epoch}))
+            buf = io.BytesIO()
+            np.savez(buf, *[np.asarray(l) for l in leaves])
+            z.writestr("params.npz", buf.getvalue())
+            buf = io.BytesIO()
+            np.savez(buf, *[np.asarray(l) for l in opt_leaves])
+            z.writestr("opt.npz", buf.getvalue())
+
+    @staticmethod
+    def load(path: str) -> "BertModel":
+        import io, json, zipfile
+        with zipfile.ZipFile(path) as z:
+            meta = json.loads(z.read("config.json").decode())
+            iteration = meta.pop("iteration")
+            epoch = meta.pop("epoch")
+            model = BertModel(BertConfig(**meta))
+            leaves, treedef = jax.tree_util.tree_flatten(model.params_)
+            with np.load(io.BytesIO(z.read("params.npz"))) as d:
+                model.params_ = jax.tree_util.tree_unflatten(
+                    treedef, [jnp.asarray(d[f"arr_{i}"])
+                              for i in range(len(leaves))])
+            oleaves, otreedef = jax.tree_util.tree_flatten(model.opt_state_)
+            with np.load(io.BytesIO(z.read("opt.npz"))) as d:
+                model.opt_state_ = jax.tree_util.tree_unflatten(
+                    otreedef, [jnp.asarray(d[f"arr_{i}"])
+                               for i in range(len(oleaves))])
+            model.iteration, model.epoch = iteration, epoch
+        return model

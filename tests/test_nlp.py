@@ -1,0 +1,133 @@
+"""NLP tests (reference: `BertWordPieceTokenizerTests.java`,
+`Word2VecTests.java`, `TestBertIterator.java`)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (BertIterator, BertWordPieceTokenizer,
+                                    CommonPreprocessor,
+                                    DefaultTokenizerFactory, Word2Vec)
+
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+         "the", "quick", "brown", "fox", "jump", "##ed", "##s", "over",
+         "lazy", "dog", "un", "##able", "."]
+
+
+def test_default_tokenizer():
+    tf = DefaultTokenizerFactory(CommonPreprocessor())
+    assert tf.tokenize("The QUICK, brown fox!") == ["the", "quick", "brown",
+                                                    "fox"]
+
+
+def test_wordpiece_tokenizer():
+    tok = BertWordPieceTokenizer(VOCAB)
+    assert tok.tokenize("the quick fox") == ["the", "quick", "fox"]
+    # continuation pieces
+    assert tok.tokenize("jumped") == ["jump", "##ed"]
+    assert tok.tokenize("jumps") == ["jump", "##s"]
+    assert tok.tokenize("unable") == ["un", "##able"]
+    # unknown word
+    assert tok.tokenize("zebra") == ["[UNK]"]
+    # punctuation split
+    assert tok.tokenize("dog.") == ["dog", "."]
+
+
+def test_wordpiece_encode_decode():
+    tok = BertWordPieceTokenizer(VOCAB)
+    ids = tok.encode("the quick jumped")
+    assert tok.decode(ids) == "the quick jumped"
+
+
+def _corpus():
+    # two topic clusters: animals co-occur, numbers co-occur
+    animal = "cat dog cat dog bird cat dog bird".split()
+    nums = "one two one two three one two three".split()
+    sents = []
+    rng = np.random.RandomState(0)
+    for _ in range(200):
+        base = animal if rng.rand() < 0.5 else nums
+        sents.append(" ".join(rng.permutation(base)))
+    return sents
+
+
+def test_word2vec_learns_cooccurrence():
+    w2v = (Word2Vec.builder()
+           .min_word_frequency(2).layer_size(16).window_size(3)
+           .negative_sample(4).epochs(3).learning_rate(0.01)
+           .batch_size(256).seed(1).build())
+    w2v.fit(_corpus())
+    assert w2v.has_word("cat") and w2v.has_word("one")
+    assert w2v.get_word_vector("cat").shape == (16,)
+    # words from the same cluster are closer than across clusters
+    assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "two")
+    near = w2v.words_nearest("one", 2)
+    assert set(near) <= {"two", "three"}
+
+
+def test_word2vec_save_load(tmp_path):
+    w2v = (Word2Vec.builder().min_word_frequency(1).layer_size(8)
+           .epochs(1).seed(0).build())
+    w2v.fit(["a b c a b c", "c b a c b a"])
+    p = str(tmp_path / "w2v.npz")
+    w2v.save(p)
+    w2 = Word2Vec.load(p)
+    np.testing.assert_array_equal(w2.get_word_vector("a"),
+                                  w2v.get_word_vector("a"))
+
+
+def test_bert_iterator_masked_lm():
+    tok = BertWordPieceTokenizer(VOCAB)
+    sents = ["the quick brown fox jumped over the lazy dog"] * 8
+    it = BertIterator(tok, sents, batch_size=4, max_length=12,
+                      task=BertIterator.TASK_UNSUPERVISED, seed=3)
+    batches = list(it)
+    assert len(batches) == 2
+    mds = batches[0]
+    ids, mask = mds.features
+    assert ids.shape == (4, 12) and mask.shape == (4, 12)
+    (labels,) = mds.labels
+    assert labels.shape == (4, 12, len(VOCAB))
+    (lmask,) = mds.labels_masks
+    # masked positions carry one-hot original tokens
+    b, t = np.nonzero(lmask)
+    assert len(b) > 0
+    orig = tok.encode(sents[0])
+    for bi, ti in zip(b, t):
+        assert labels[bi, ti].sum() == 1.0
+        assert labels[bi, ti].argmax() == orig[ti]
+    # at least some selected positions replaced with [MASK]
+    assert (ids[b, t] == tok.vocab["[MASK]"]).any()
+
+
+def test_bert_iterator_classification():
+    tok = BertWordPieceTokenizer(VOCAB)
+    sents = ["the quick fox", "lazy dog", "the dog", "quick brown fox"]
+    it = BertIterator(tok, sents, batch_size=2, max_length=6,
+                      task=BertIterator.TASK_SEQ_CLASSIFICATION,
+                      labels=[0, 1, 1, 0], n_classes=2)
+    batches = list(it)
+    assert len(batches) == 2
+    (y,) = batches[0].labels
+    np.testing.assert_array_equal(y, [[1, 0], [0, 1]])
+
+
+def test_bert_iterator_requires_mask_token():
+    with pytest.raises(ValueError, match="MASK"):
+        BertIterator(BertWordPieceTokenizer(["[UNK]", "a", "b"]),
+                     ["a"], 1, 4)
+
+
+def test_tokenizer_requires_unk_token():
+    with pytest.raises(ValueError, match="unknown-token"):
+        BertWordPieceTokenizer(["a", "b"])
+
+
+def test_word2vec_cbow_learns():
+    w2v = (Word2Vec.builder()
+           .min_word_frequency(2).layer_size(16).window_size(3)
+           .negative_sample(4).epochs(3).learning_rate(0.01)
+           .batch_size(256).seed(1)
+           .elements_learning_algorithm("CBOW").build())
+    assert w2v.elements_algo == "cbow"
+    w2v.fit(_corpus())
+    assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "two")
