@@ -1,0 +1,6 @@
+"""Model import (reference L7: `deeplearning4j-modelimport` Keras/HDF5 +
+`nd4j/samediff-import` TF/ONNX)."""
+from deeplearning4j_tpu.modelimport.keras import (  # noqa: F401
+    KerasModelImport, UnsupportedKerasConfigurationException)
+from deeplearning4j_tpu.modelimport.tf_import import (  # noqa: F401
+    TFImportRegistry, import_graph_def)

@@ -1,0 +1,423 @@
+"""Keras HDF5 model import.
+
+Reference: `deeplearning4j-modelimport/.../keras/{KerasModelImport,
+KerasModel,KerasLayer}.java` + per-layer mappers in `keras/layers/**`:
+HDF5 -> model_config JSON -> layer-by-layer mapping -> network + weight
+copy.  Same structure here: a LAYER_MAP registry (class_name -> converter),
+unmapped layers fail with a named exception
+(`UnsupportedKerasConfigurationException`, as in the reference).
+
+A TPU-friendly break: NO layout transposes.  Keras convs are channels_last
+(NHWC) and kernels HWIO — exactly our native layout — so weights copy
+straight through (the reference transposes everything into NCHW buffers).
+Only the LSTM needs gate reordering (Keras IFCO -> our IFOG).
+
+Supports Sequential -> MultiLayerNetwork and Functional -> ComputationGraph
+(linear + Add/Concatenate/residual topologies).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nn import (
+    ActivationLayer, BatchNormalizationLayer, ComputationGraph,
+    ConvolutionLayer, DenseLayer, DepthwiseConvolution2DLayer, DropoutLayer,
+    ElementWiseVertex, EmbeddingSequenceLayer, GlobalPoolingLayer,
+    GraphBuilder, InputType, LastTimeStep, Layer, LSTM, MergeVertex,
+    MultiLayerNetwork, NeuralNetConfiguration, OutputLayer,
+    SeparableConvolution2DLayer, SimpleRnn, SubsamplingLayer,
+    Upsampling2DLayer, ZeroPaddingLayer)
+
+
+class UnsupportedKerasConfigurationException(Exception):
+    """Named unmapped-layer error (reference exception of the same name)."""
+
+
+def _act(name) -> str:
+    if not isinstance(name, str):
+        name = name.get("class_name", "linear") if name else "linear"
+    return {"linear": "identity"}.get(name.lower(), name.lower())
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+
+def _conv_mode(padding: str) -> str:
+    return "Same" if padding == "same" else "Truncate"
+
+
+# ---------------------------------------------------------------------------
+# Layer converters: keras config dict -> (Layer | None, needs_lasttimestep)
+# ---------------------------------------------------------------------------
+
+def _dense(cfg, is_output):
+    if is_output and _act(cfg.get("activation")) in ("softmax", "sigmoid"):
+        loss = "mcxent" if _act(cfg["activation"]) == "softmax" else "xent"
+        return OutputLayer(n_out=cfg["units"], loss=loss,
+                           activation=_act(cfg["activation"]),
+                           has_bias=cfg.get("use_bias", True))
+    return DenseLayer(n_out=cfg["units"], activation=_act(cfg.get("activation")),
+                      has_bias=cfg.get("use_bias", True))
+
+
+def _conv2d(cfg, is_output):
+    return ConvolutionLayer(
+        n_out=cfg["filters"], kernel_size=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)),
+        convolution_mode=_conv_mode(cfg.get("padding", "valid")),
+        dilation=_pair(cfg.get("dilation_rate", 1)),
+        activation=_act(cfg.get("activation")),
+        has_bias=cfg.get("use_bias", True))
+
+
+def _sepconv2d(cfg, is_output):
+    return SeparableConvolution2DLayer(
+        n_out=cfg["filters"], kernel_size=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)),
+        convolution_mode=_conv_mode(cfg.get("padding", "valid")),
+        activation=_act(cfg.get("activation")),
+        has_bias=cfg.get("use_bias", True))
+
+
+def _depthconv2d(cfg, is_output):
+    return DepthwiseConvolution2DLayer(
+        kernel_size=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)),
+        convolution_mode=_conv_mode(cfg.get("padding", "valid")),
+        depth_multiplier=cfg.get("depth_multiplier", 1),
+        activation=_act(cfg.get("activation")),
+        has_bias=cfg.get("use_bias", True))
+
+
+def _pool(kind):
+    def conv(cfg, is_output):
+        return SubsamplingLayer(
+            pooling_type=kind, kernel_size=_pair(cfg.get("pool_size", 2)),
+            stride=_pair(cfg.get("strides") or cfg.get("pool_size", 2)),
+            convolution_mode=_conv_mode(cfg.get("padding", "valid")))
+    return conv
+
+
+def _global_pool(kind):
+    def conv(cfg, is_output):
+        return GlobalPoolingLayer(pooling_type=kind)
+    return conv
+
+
+def _bn(cfg, is_output):
+    return BatchNormalizationLayer(eps=cfg.get("epsilon", 1e-3),
+                                   decay=cfg.get("momentum", 0.99))
+
+
+def _dropout(cfg, is_output):
+    # keras rate = DROP prob; our field = RETAIN prob (reference semantics)
+    return DropoutLayer(dropout=1.0 - cfg["rate"])
+
+
+def _activation(cfg, is_output):
+    return ActivationLayer(activation=_act(cfg["activation"]))
+
+
+def _embedding(cfg, is_output):
+    return EmbeddingSequenceLayer(n_in=cfg["input_dim"],
+                                  n_out=cfg["output_dim"])
+
+
+def _lstm(cfg, is_output):
+    layer = LSTM(n_out=cfg["units"], activation=_act(cfg.get("activation",
+                                                             "tanh")),
+                 gate_activation=_act(cfg.get("recurrent_activation",
+                                              "sigmoid")),
+                 forget_gate_bias_init=1.0 if cfg.get("unit_forget_bias",
+                                                      True) else 0.0)
+    if not cfg.get("return_sequences", False):
+        return LastTimeStep(underlying=layer)
+    return layer
+
+
+def _simplernn(cfg, is_output):
+    layer = SimpleRnn(n_out=cfg["units"],
+                      activation=_act(cfg.get("activation", "tanh")))
+    if not cfg.get("return_sequences", False):
+        return LastTimeStep(underlying=layer)
+    return layer
+
+
+def _zeropad(cfg, is_output):
+    return ZeroPaddingLayer(padding=cfg.get("padding", 1))
+
+
+def _upsample(cfg, is_output):
+    return Upsampling2DLayer(size=_pair(cfg.get("size", 2)))
+
+
+def _skip(cfg, is_output):
+    return None     # structural no-op (Flatten: Dense auto-flattens)
+
+
+LAYER_MAP: Dict[str, Callable] = {
+    "Dense": _dense,
+    "Conv2D": _conv2d,
+    "SeparableConv2D": _sepconv2d,
+    "DepthwiseConv2D": _depthconv2d,
+    "MaxPooling2D": _pool("MAX"),
+    "AveragePooling2D": _pool("AVG"),
+    "GlobalAveragePooling2D": _global_pool("AVG"),
+    "GlobalMaxPooling2D": _global_pool("MAX"),
+    "BatchNormalization": _bn,
+    "Dropout": _dropout,
+    "Activation": _activation,
+    "Embedding": _embedding,
+    "LSTM": _lstm,
+    "SimpleRNN": _simplernn,
+    "ZeroPadding2D": _zeropad,
+    "UpSampling2D": _upsample,
+    "Flatten": _skip,
+    "InputLayer": _skip,
+}
+
+
+def register_keras_layer(class_name: str, converter: Callable):
+    """Custom-layer hook (reference `KerasLayer.registerCustomLayer`)."""
+    LAYER_MAP[class_name] = converter
+
+
+# ---------------------------------------------------------------------------
+# Weight copy
+# ---------------------------------------------------------------------------
+
+def _layer_weights(h5, layer_name: str) -> Dict[str, np.ndarray]:
+    """Collect datasets under model_weights/<layer> keyed by trailing path
+    component (handles both Keras-2 `kernel:0` and Keras-3 nested paths)."""
+    import h5py
+    out = {}
+    if layer_name not in h5["model_weights"]:
+        return out
+
+    def visit(name, obj):
+        if isinstance(obj, h5py.Dataset):
+            key = name.split("/")[-1].split(":")[0]
+            out[key] = np.asarray(obj)
+
+    h5["model_weights"][layer_name].visititems(visit)
+    return out
+
+
+def _reorder_lstm_gates(k: np.ndarray, H: int) -> np.ndarray:
+    """Keras gate blocks [i, f, c, o] -> our IFOG [i, f, o, g=c]."""
+    i, f, c, o = (k[..., :H], k[..., H:2*H], k[..., 2*H:3*H], k[..., 3*H:])
+    return np.concatenate([i, f, o, c], axis=-1)
+
+
+def _set_weights(net, name: str, layer: Layer, w: Dict[str, np.ndarray]):
+    params = net.params_[name]
+    state = net.state_[name]
+    inner = layer.underlying if isinstance(layer, LastTimeStep) else layer
+    if isinstance(inner, LSTM):
+        H = inner.n_out
+        # LastTimeStep forwards its underlying layer's params un-nested
+        params["W"] = _reorder_lstm_gates(w["kernel"], H)
+        params["RW"] = _reorder_lstm_gates(w["recurrent_kernel"], H)
+        params["b"] = _reorder_lstm_gates(w["bias"], H)
+    elif isinstance(inner, BatchNormalizationLayer):
+        if "gamma" in w:
+            params["gamma"] = w["gamma"]
+        if "beta" in w:
+            params["beta"] = w["beta"]
+        state["mean"] = w["moving_mean"]
+        state["var"] = w["moving_variance"]
+    elif isinstance(inner, SeparableConvolution2DLayer):
+        params["W_depth"] = w["depthwise_kernel"]
+        params["W_point"] = w["pointwise_kernel"]
+        if "bias" in w:
+            params["b"] = w["bias"]
+    elif isinstance(inner, DepthwiseConvolution2DLayer):
+        params["W"] = w["depthwise_kernel"]
+        if "bias" in w:
+            params["b"] = w["bias"]
+    elif "kernel" in w or "embeddings" in w:
+        params["W"] = w.get("kernel", w.get("embeddings"))
+        if "bias" in w:
+            params["b"] = w["bias"]
+    # convert all to device arrays with expected shapes
+    import jax.numpy as jnp
+    for k2 in list(params):
+        tmpl = params[k2]
+        arr = jnp.asarray(np.asarray(params[k2]))
+        if arr.shape != tmpl.shape:
+            raise UnsupportedKerasConfigurationException(
+                f"{name}/{k2}: weight shape {arr.shape} != expected "
+                f"{tmpl.shape}")
+        params[k2] = arr
+    for k2 in list(state):
+        state[k2] = jnp.asarray(np.asarray(state[k2]))
+
+
+# ---------------------------------------------------------------------------
+# Input-shape extraction + import entry points
+# ---------------------------------------------------------------------------
+
+def _input_type(layers_cfg: List[dict]) -> InputType:
+    shape = None
+    for lc in layers_cfg:
+        c = lc["config"]
+        bis = c.get("batch_input_shape") or c.get("batch_shape")
+        if bis:
+            shape = bis[1:]
+            break
+    if shape is None:
+        raise UnsupportedKerasConfigurationException(
+            "No input shape found (batch_input_shape/batch_shape)")
+    shape = [s for s in shape]
+    if len(shape) == 3:
+        return InputType.convolutional(shape[0], shape[1], shape[2])
+    if len(shape) == 2:
+        return InputType.recurrent(shape[1], shape[0])
+    if len(shape) == 1:
+        return InputType.feed_forward(shape[0])
+    raise UnsupportedKerasConfigurationException(
+        f"Unsupported input rank {len(shape)}")
+
+
+class KerasModelImport:
+    """Entry points (reference `KerasModelImport`):
+    `import_keras_sequential_model_and_weights`,
+    `import_keras_model_and_weights` (functional)."""
+
+    @staticmethod
+    def import_keras_sequential_model_and_weights(
+            path: str) -> MultiLayerNetwork:
+        import h5py
+        with h5py.File(path, "r") as f:
+            cfg = json.loads(f.attrs["model_config"])
+            if cfg["class_name"] != "Sequential":
+                raise UnsupportedKerasConfigurationException(
+                    f"Not a Sequential model: {cfg['class_name']} — use "
+                    "import_keras_model_and_weights")
+            layers_cfg = cfg["config"]["layers"]
+            mapped: List[Layer] = []
+            names: List[Optional[str]] = []
+            for i, lc in enumerate(layers_cfg):
+                cls = lc["class_name"]
+                if cls not in LAYER_MAP:
+                    raise UnsupportedKerasConfigurationException(
+                        f"Unsupported Keras layer '{cls}' — register via "
+                        "register_keras_layer")
+                is_output = i == len(layers_cfg) - 1
+                layer = LAYER_MAP[cls](lc["config"], is_output)
+                if layer is None:
+                    continue
+                layer.name = lc["config"]["name"]
+                mapped.append(layer)
+                names.append(lc["config"]["name"])
+            conf = (NeuralNetConfiguration.builder()
+                    .list(mapped)
+                    .set_input_type(_input_type(layers_cfg))
+                    .build())
+            net = MultiLayerNetwork(conf).init()
+            for layer, name in zip(mapped, names):
+                w = _layer_weights(f, name)
+                if w:
+                    _set_weights(net, name, layer, w)
+        return net
+
+    @staticmethod
+    def import_keras_model_and_weights(path: str) -> ComputationGraph:
+        import h5py
+        with h5py.File(path, "r") as f:
+            cfg = json.loads(f.attrs["model_config"])
+            if cfg["class_name"] == "Sequential":
+                raise UnsupportedKerasConfigurationException(
+                    "Sequential model — use "
+                    "import_keras_sequential_model_and_weights")
+            conf_cfg = cfg["config"]
+            layers_cfg = conf_cfg["layers"]
+            by_name = {lc["config"]["name"]: lc for lc in layers_cfg}
+            b = GraphBuilder()
+            input_names = _node_refs(conf_cfg["input_layers"])
+            b.add_inputs(*input_names)
+            types = []
+            for n in input_names:
+                types.append(_input_type([by_name[n]]))
+            b.set_input_types(*types)
+            output_names = _node_refs(conf_cfg["output_layers"])
+            mapped: Dict[str, Layer] = {}
+            for lc in layers_cfg:
+                name = lc["config"]["name"]
+                cls = lc["class_name"]
+                inbound = _inbound_names(lc)
+                if cls == "InputLayer":
+                    continue
+                if cls in ("Add", "Average", "Maximum", "Subtract",
+                           "Multiply"):
+                    op = {"Add": "Add", "Average": "Average",
+                          "Maximum": "Max", "Subtract": "Subtract",
+                          "Multiply": "Product"}[cls]
+                    b.add_vertex(name, ElementWiseVertex(op=op), *inbound)
+                    continue
+                if cls == "Concatenate":
+                    b.add_vertex(name, MergeVertex(), *inbound)
+                    continue
+                if cls not in LAYER_MAP:
+                    raise UnsupportedKerasConfigurationException(
+                        f"Unsupported Keras layer '{cls}'")
+                layer = LAYER_MAP[cls](lc["config"],
+                                       name in output_names)
+                if layer is None:
+                    # structural no-op: alias by inserting identity
+                    b.add_layer(name, ActivationLayer(activation="identity"),
+                                *inbound)
+                    continue
+                b.add_layer(name, layer, *inbound)
+                mapped[name] = layer
+            b.set_outputs(*output_names)
+            net = ComputationGraph(b.build()).init()
+            for name, layer in mapped.items():
+                w = _layer_weights(f, name)
+                if w:
+                    _set_weights(net, name, layer, w)
+        return net
+
+
+def _node_refs(x) -> List[str]:
+    """Normalize Keras node refs: a single ref is ["name", 0, 0] (or just
+    "name"), multiple are a list of refs."""
+    if isinstance(x, str):
+        return [x]
+    if (len(x) == 3 and isinstance(x[0], str)
+            and not isinstance(x[1], (list, tuple, str))):
+        return [x[0]]
+    out = []
+    for e in x:
+        out.extend(_node_refs(e))
+    return out
+
+
+def _inbound_names(lc: dict) -> List[str]:
+    """Handle both Keras-2 nested-list and Keras-3 args-dict formats."""
+    nodes = lc.get("inbound_nodes", [])
+    if not nodes:
+        return []
+    node = nodes[0]
+    names = []
+    if isinstance(node, dict):          # Keras 3
+        def walk(x):
+            if isinstance(x, dict):
+                hist = x.get("config", {}).get("keras_history")
+                if hist:
+                    names.append(hist[0])
+                    return
+                for v in x.values():
+                    walk(v)
+            elif isinstance(x, (list, tuple)):
+                for v in x:
+                    walk(v)
+        walk(node.get("args", []))
+    else:                               # Keras 2: [[name, idx, t_idx, {}]..]
+        for entry in node:
+            names.append(entry[0])
+    return names

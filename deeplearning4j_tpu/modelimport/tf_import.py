@@ -1,0 +1,210 @@
+"""TensorFlow GraphDef -> SameDiff import.
+
+Reference: `nd4j/samediff-import/samediff-import-{api,tensorflow}`:
+`ImportGraph.importGraph` walks protobuf NodeDefs, an `OpMappingRegistry`
+maps each TF op to graph-engine ops, and unmapped ops fail with a NAMED
+error listing the op.  Same registry pattern here, targeting our
+`autodiff.SameDiff` (whole-graph -> one jitted XLA executable — the
+BASELINE 'BERT-base via TF import, full-graph -> HLO' path).
+
+Parsing uses the tensorflow protobuf bindings only (no TF runtime
+execution).  Supported ops cover the frozen-inference subset (MatMul, conv,
+bias, activations, norm arithmetic, shape ops); `TFImportRegistry.register`
+extends it.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff import SameDiff
+
+
+class UnmappedTFOpException(Exception):
+    pass
+
+
+def _attr_shape(node) -> List[int]:
+    return [d.size for d in node.attr["shape"].shape.dim]
+
+
+def _const_value(node) -> np.ndarray:
+    from tensorflow.python.framework import tensor_util
+    return tensor_util.MakeNdarray(node.attr["value"].tensor)
+
+
+def _perm_from_const(sd, name):
+    raise UnmappedTFOpException("dynamic permutation input unsupported")
+
+
+class TFImportRegistry:
+    """TF op name -> mapper(sd, node, inputs) -> SDVariable."""
+
+    _MAP: Dict[str, Callable] = {}
+
+    @classmethod
+    def register(cls, op_name: str, fn: Callable = None):
+        if fn is None:
+            def deco(f):
+                cls._MAP[op_name] = f
+                return f
+            return deco
+        cls._MAP[op_name] = fn
+        return fn
+
+    @classmethod
+    def get(cls, op_name: str) -> Callable:
+        if op_name not in cls._MAP:
+            raise UnmappedTFOpException(
+                f"Unmapped TF op '{op_name}' — same failure mode as the "
+                "reference's OpMappingRegistry; add via "
+                "TFImportRegistry.register")
+        return cls._MAP[op_name]
+
+
+R = TFImportRegistry.register
+
+R("Identity", lambda sd, n, ins: sd.op("identity", ins[0], name=n.name))
+R("MatMul", lambda sd, n, ins: sd.op("matmul", ins[0], ins[1], name=n.name))
+R("Add", lambda sd, n, ins: sd.op("add", ins[0], ins[1], name=n.name))
+R("AddV2", lambda sd, n, ins: sd.op("add", ins[0], ins[1], name=n.name))
+R("BiasAdd", lambda sd, n, ins: sd.op("add", ins[0], ins[1], name=n.name))
+R("Sub", lambda sd, n, ins: sd.op("sub", ins[0], ins[1], name=n.name))
+R("Mul", lambda sd, n, ins: sd.op("mul", ins[0], ins[1], name=n.name))
+R("RealDiv", lambda sd, n, ins: sd.op("div", ins[0], ins[1], name=n.name))
+R("Maximum", lambda sd, n, ins: sd.op("maximum", ins[0], ins[1],
+                                      name=n.name))
+R("Minimum", lambda sd, n, ins: sd.op("minimum", ins[0], ins[1],
+                                      name=n.name))
+R("Relu", lambda sd, n, ins: sd.op("relu", ins[0], name=n.name))
+R("Relu6", lambda sd, n, ins: sd.op("relu6", ins[0], name=n.name))
+R("Elu", lambda sd, n, ins: sd.op("elu", ins[0], name=n.name))
+R("Sigmoid", lambda sd, n, ins: sd.op("sigmoid", ins[0], name=n.name))
+R("Tanh", lambda sd, n, ins: sd.op("tanh", ins[0], name=n.name))
+R("Softmax", lambda sd, n, ins: sd.op("softmax", ins[0], name=n.name))
+R("Exp", lambda sd, n, ins: sd.op("exp", ins[0], name=n.name))
+R("Log", lambda sd, n, ins: sd.op("log", ins[0], name=n.name))
+R("Sqrt", lambda sd, n, ins: sd.op("sqrt", ins[0], name=n.name))
+R("Rsqrt", lambda sd, n, ins: sd.op("pow", sd.op("sqrt", ins[0]), -1.0,
+                                    name=n.name))
+R("Square", lambda sd, n, ins: sd.op("square", ins[0], name=n.name))
+R("Neg", lambda sd, n, ins: sd.op("neg", ins[0], name=n.name))
+R("Abs", lambda sd, n, ins: sd.op("abs", ins[0], name=n.name))
+R("Erf", lambda sd, n, ins: sd.op("erf", ins[0], name=n.name))
+R("Pow", lambda sd, n, ins: sd.op("pow", ins[0], ins[1], name=n.name))
+
+
+@R("Reshape")
+def _reshape(sd, n, ins):
+    shape = ins[1].get_arr()
+    return sd.op("reshape", ins[0],
+                 shape=[int(s) for s in np.asarray(shape)], name=n.name)
+
+
+@R("Transpose")
+def _transpose(sd, n, ins):
+    perm = [int(p) for p in np.asarray(ins[1].get_arr())]
+    return sd.op("transpose", ins[0], perm=perm, name=n.name)
+
+
+@R("ConcatV2")
+def _concat(sd, n, ins):
+    axis = int(np.asarray(ins[-1].get_arr()))
+    return sd.op("concat", *ins[:-1], axis=axis, name=n.name)
+
+
+@R("Mean")
+def _mean(sd, n, ins):
+    axes = [int(a) for a in np.atleast_1d(np.asarray(ins[1].get_arr()))]
+    keep = bool(n.attr["keep_dims"].b)
+    return sd.op("mean", ins[0], axis=axes, keepdims=keep, name=n.name)
+
+
+@R("Sum")
+def _sum(sd, n, ins):
+    axes = [int(a) for a in np.atleast_1d(np.asarray(ins[1].get_arr()))]
+    keep = bool(n.attr["keep_dims"].b)
+    return sd.op("sum", ins[0], axis=axes, keepdims=keep, name=n.name)
+
+
+@R("Max")
+def _max(sd, n, ins):
+    axes = [int(a) for a in np.atleast_1d(np.asarray(ins[1].get_arr()))]
+    keep = bool(n.attr["keep_dims"].b)
+    return sd.op("max", ins[0], axis=axes, keepdims=keep, name=n.name)
+
+
+@R("Conv2D")
+def _conv2d(sd, n, ins):
+    if n.attr["data_format"].s not in (b"", b"NHWC"):
+        raise UnmappedTFOpException("Conv2D: only NHWC supported "
+                                    "(TPU-native layout)")
+    strides = list(n.attr["strides"].list.i)
+    padding = n.attr["padding"].s.decode()
+    return sd.op("conv2d", ins[0], ins[1],
+                 stride=(int(strides[1]), int(strides[2])),
+                 padding=padding, name=n.name)
+
+
+@R("MaxPool")
+def _maxpool(sd, n, ins):
+    k = list(n.attr["ksize"].list.i)
+    s = list(n.attr["strides"].list.i)
+    return sd.op("max_pooling2d", ins[0], kernel=(int(k[1]), int(k[2])),
+                 stride=(int(s[1]), int(s[2])),
+                 padding=n.attr["padding"].s.decode(), name=n.name)
+
+
+@R("AvgPool")
+def _avgpool(sd, n, ins):
+    k = list(n.attr["ksize"].list.i)
+    s = list(n.attr["strides"].list.i)
+    return sd.op("avg_pooling2d", ins[0], kernel=(int(k[1]), int(k[2])),
+                 stride=(int(s[1]), int(s[2])),
+                 padding=n.attr["padding"].s.decode(), name=n.name)
+
+
+@R("Pack")
+def _pack(sd, n, ins):
+    return sd.op("stack", *ins, axis=int(n.attr["axis"].i), name=n.name)
+
+
+@R("ExpandDims")
+def _expand(sd, n, ins):
+    axis = int(np.asarray(ins[1].get_arr()))
+    return sd.op("expand_dims", ins[0], axis=axis, name=n.name)
+
+
+@R("Cast")
+def _cast(sd, n, ins):
+    from tensorflow.python.framework import dtypes
+    dt = dtypes.as_dtype(n.attr["DstT"].type).as_numpy_dtype
+    return sd.op("cast", ins[0], dtype=np.dtype(dt).name, name=n.name)
+
+
+def import_graph_def(graph_def, input_names: List[str] = None) -> SameDiff:
+    """Walk a (frozen) GraphDef into a SameDiff graph.  Variables must be
+    frozen to Const (the reference likewise imports frozen graphs)."""
+    sd = SameDiff.create()
+    produced = {}
+
+    def clean(inp: str) -> str:
+        inp = inp.split(":")[0]
+        return inp[1:] if inp.startswith("^") else inp
+
+    for node in graph_def.node:
+        if node.op == "Placeholder":
+            shape = _attr_shape(node) or None
+            produced[node.name] = sd.placeholder(
+                node.name, shape=shape if shape else None)
+        elif node.op == "Const":
+            produced[node.name] = sd.constant(node.name, _const_value(node))
+        elif node.op == "NoOp":
+            continue
+        else:
+            ins = [produced[clean(i)] for i in node.input
+                   if not i.startswith("^")]
+            produced[node.name] = TFImportRegistry.get(node.op)(sd, node,
+                                                                ins)
+    return sd

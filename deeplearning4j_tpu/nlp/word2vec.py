@@ -218,9 +218,18 @@ class Word2Vec:
                 ctxs, masks, centers = self._cbow_windows(corpus, rng)
             else:
                 centers, contexts = self._pairs(corpus, rng)
+            if len(centers) == 0:
+                raise ValueError("Corpus produced no training pairs "
+                                 "(vocabulary/window too restrictive)")
             order = rng.permutation(len(centers))
-            n_full = (len(centers) // bs) * bs   # fixed shape: no recompile
-            for i in range(0, n_full, bs):
+            # pad the tail batch by sampling with replacement: every pair
+            # trains, shapes stay fixed (one compile), tiny corpora work
+            pad = (-len(order)) % bs
+            if pad:
+                order = np.concatenate(
+                    [order, rng.choice(len(centers), pad)])
+            loss = None
+            for i in range(0, len(order), bs):
                 sel = order[i:i + bs]
                 negs = rng.choice(len(neg_p), size=(bs, self.negative),
                                   p=neg_p).astype(np.int32)
@@ -230,7 +239,7 @@ class Word2Vec:
                 else:
                     syn0, syn1, loss = step(syn0, syn1, centers[sel],
                                             contexts[sel], negs)
-            self._last_loss = float(loss) if n_full else float("nan")
+            self._last_loss = float(loss)
         self.syn0 = np.asarray(syn0)
         self.syn1 = np.asarray(syn1)
         return self

@@ -208,16 +208,17 @@ def flash_attention_tpu(q, k, v, causal=False, scale=None,
     return out.reshape(B, H, T, D)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_attention_diff(q, k, v, causal, scale):
-    return flash_attention_tpu(q, k, v, causal, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention_diff(q, k, v, causal, scale, block_q=256, block_k=256):
+    return flash_attention_tpu(q, k, v, causal, scale, block_q, block_k)
 
 
-def _fa_fwd(q, k, v, causal, scale):
-    return flash_attention_tpu(q, k, v, causal, scale), (q, k, v)
+def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
+    return (flash_attention_tpu(q, k, v, causal, scale, block_q, block_k),
+            (q, k, v))
 
 
-def _fa_bwd(causal, scale, res, g):
+def _fa_bwd(causal, scale, block_q, block_k, res, g):
     q, k, v = res
 
     def f(q_, k_, v_):
@@ -231,12 +232,20 @@ def _fa_bwd(causal, scale, res, g):
 _flash_attention_diff.defvjp(_fa_fwd, _fa_bwd)
 
 
+def _pick_block(x: int) -> Optional[int]:
+    for b in (256, 128):
+        if x % b == 0:
+            return b
+    return None
+
+
 def fused_attention(q, k, v, mask=None, causal=False, scale=None):
-    """Dispatcher: Pallas kernel on TPU for cleanly tiling unmasked shapes,
+    """Dispatcher: Pallas kernel on TPU for cleanly tiling unmasked shapes
+    (T/S multiples of 128, head dim multiple of 64 — covers BERT's D=64),
     blockwise scan otherwise.  Differentiable everywhere."""
     on_tpu = jax.default_backend() == "tpu"
     T, S, D = q.shape[2], k.shape[2], q.shape[3]
-    tiles = (T % 256 == 0 and S % 256 == 0 and D % 128 == 0)
-    if on_tpu and mask is None and tiles:
-        return _flash_attention_diff(q, k, v, causal, scale)
+    bq, bk = _pick_block(T), _pick_block(S)
+    if on_tpu and mask is None and bq and bk and D % 64 == 0:
+        return _flash_attention_diff(q, k, v, causal, scale, bq, bk)
     return blockwise_attention(q, k, v, mask, causal, scale)

@@ -1,0 +1,75 @@
+"""Compressed gradient exchange for DCN/multi-slice hops.
+
+Reference: `EncodedGradientsAccumulator` + Aeron publish/receive
+(SURVEY.md §3.4): async threshold-quantized deltas between nodes.  On TPU
+the intra-slice path is XLA all-reduce over ICI (never compressed); this
+module keeps the reference's compression capability for the slow
+cross-slice/DCN hop, as a HOST-side exchange: encode locally (C++ codec),
+ship the sparse stream over whatever transport links slices (the launcher's
+job), decode+apply remotely.  Synchronous-apply semantics — the async
+staleness of the reference is deliberately dropped (north star).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.native_ops import ThresholdCodec
+
+
+class CompressedGradientExchange:
+    """Per-leaf threshold codecs over a gradient pytree."""
+
+    def __init__(self, params_template, threshold: float = 1e-3,
+                 adaptive_target_density: float = 1e-2):
+        leaves, self._treedef = jax.tree_util.tree_flatten(params_template)
+        self._shapes = [np.shape(l) for l in leaves]
+        self.codecs: List[ThresholdCodec] = [
+            ThresholdCodec(int(np.prod(s) or 1), threshold) for s in
+            self._shapes]
+        self.target_density = adaptive_target_density
+
+    def encode(self, grads) -> List[np.ndarray]:
+        """Pytree -> list of sparse int32 streams (residuals carried).
+
+        Adaptive threshold (the ResidualPostProcessor role) adjusts AFTER
+        each encode from the emitted stream's density — no second scan of
+        the gradient."""
+        leaves = jax.tree_util.tree_leaves(grads)
+        out = []
+        self._used_thresholds = []
+        for codec, leaf in zip(self.codecs, leaves):
+            self._used_thresholds.append(codec.threshold)
+            stream = codec.encode(np.asarray(leaf))
+            out.append(stream)
+            d = len(stream) / codec.size
+            if d > 2 * self.target_density:
+                codec.threshold *= 1.2
+            elif d < self.target_density / 2 and codec.threshold > 1e-6:
+                codec.threshold /= 1.2
+        return out
+
+    def thresholds(self) -> List[float]:
+        """Thresholds USED by the most recent encode (what decode needs)."""
+        return getattr(self, "_used_thresholds",
+                       [c.threshold for c in self.codecs])
+
+    def decode(self, streams: List[np.ndarray],
+               thresholds: List[float] = None):
+        """Sparse streams -> dense gradient pytree."""
+        thresholds = thresholds or self.thresholds()
+        dense = []
+        for codec, enc, shape, thr in zip(self.codecs, streams,
+                                          self._shapes, thresholds):
+            saved = codec.threshold
+            codec.threshold = thr
+            dense.append(codec.decode(enc).reshape(shape))
+            codec.threshold = saved
+        return jax.tree_util.tree_unflatten(self._treedef, dense)
+
+    def compression_ratio(self, streams: List[np.ndarray]) -> float:
+        dense_bytes = sum(4 * int(np.prod(s) or 1) for s in self._shapes)
+        sparse_bytes = sum(4 * (len(s) + 1) for s in streams)
+        return dense_bytes / max(sparse_bytes, 1)

@@ -44,7 +44,11 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     qs = q * scale
 
     def chunk_scores(kc, src):
-        s = jnp.einsum("bhqd,bhkd->bhqk", qs, kc)
+        # f32 scores/stats regardless of input dtype — same accumulation
+        # invariant as ops/attention_kernels.py (bf16 normalizer drift
+        # grows with ring length, exactly where this path is used)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qs, kc,
+                       preferred_element_type=jnp.float32)
         if causal:
             qpos = my * T + jnp.arange(T)[:, None]
             kpos = src * T + jnp.arange(kc.shape[2])[None, :]
@@ -57,8 +61,9 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         p = jnp.exp(s - m_new[..., None])
         corr = jnp.exp(m - m_new)
         l_new = corr * l + jnp.sum(p, axis=-1)
-        acc_new = corr[..., None] * acc + jnp.einsum("bhqk,bhkd->bhqd", p,
-                                                     vc)
+        acc_new = corr[..., None] * acc + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(vc.dtype), vc,
+            preferred_element_type=jnp.float32)
         return acc_new, m_new, l_new
 
     def step(i, carry):
@@ -70,11 +75,12 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         acc, m, l = accumulate(acc, m, l, kc, vc, (my - i) % n)
         return acc, m, l, kc, vc
 
-    acc = jnp.zeros_like(q)
-    # derive from q so the carries inherit shard_map's varying-axis type
-    m = jnp.full_like(q[..., 0], NEG_INF)
-    l = jnp.zeros_like(q[..., 0])
+    # derive from q so the carries inherit shard_map's varying-axis type,
+    # then promote to f32 accumulation
+    acc = jnp.zeros_like(q, dtype=jnp.float32)
+    m = jnp.full_like(q[..., 0], NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros_like(q[..., 0], dtype=jnp.float32)
     # step 0: local chunk, no communication; n-1 rotations total
     acc, m, l = accumulate(acc, m, l, k, v, my)
     acc, m, l, _, _ = jax.lax.fori_loop(1, n, step, (acc, m, l, k, v))
-    return acc / l[..., None]
+    return (acc / l[..., None]).astype(q.dtype)

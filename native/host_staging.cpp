@@ -1,0 +1,77 @@
+// Host-side staging buffer pool + parallel batch assembly.
+//
+// Reference role: the JVM side's pinned-memory staging + AsyncDataSetIterator
+// prefetch thread (`nd4j-cuda` AtomicAllocator pinned buffers,
+// `deeplearning4j-core/.../AsyncDataSetIterator.java`): get training batches
+// assembled into contiguous, aligned host buffers off the training thread so
+// the device-feed path never waits on Python-side ETL.
+//
+// TPU shape of the problem: PJRT H2D wants one contiguous aligned buffer per
+// array; Python-side np.stack of many sample rows is single-threaded and
+// copies twice.  This module does the gather-into-aligned-buffer step in
+// C++ with OpenMP across samples.
+//
+// C ABI for ctypes.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// Allocate a 64-byte-aligned buffer (TPU-friendly host alignment).
+void* staging_alloc(int64_t bytes) {
+    void* p = nullptr;
+    if (posix_memalign(&p, 64, static_cast<size_t>(bytes)) != 0) return nullptr;
+    return p;
+}
+
+void staging_free(void* p) { free(p); }
+
+// Gather: copy `n_samples` rows of `row_bytes` each from arbitrary source
+// pointers into one contiguous destination (parallel across samples).
+// srcs: array of n_samples pointers.
+void staging_gather(const void** srcs, int64_t n_samples, int64_t row_bytes,
+                    void* dst) {
+    char* out = static_cast<char*>(dst);
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+    for (int64_t i = 0; i < n_samples; ++i) {
+        memcpy(out + i * row_bytes, srcs[i], static_cast<size_t>(row_bytes));
+    }
+}
+
+// Gather with index selection: dst[i] = base[indices[i]] (the shuffled
+// minibatch assembly path — one pass, no Python loop).
+void staging_gather_indexed(const void* base, const int64_t* indices,
+                            int64_t n_samples, int64_t row_bytes,
+                            void* dst) {
+    const char* src = static_cast<const char*>(base);
+    char* out = static_cast<char*>(dst);
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+    for (int64_t i = 0; i < n_samples; ++i) {
+        memcpy(out + i * row_bytes, src + indices[i] * row_bytes,
+               static_cast<size_t>(row_bytes));
+    }
+}
+
+// uint8 -> float32 with scale (image pipelines: decode+normalize fused,
+// the NativeImageLoader role), parallel across rows.
+void staging_u8_to_f32(const uint8_t* src, float* dst, int64_t n,
+                       float scale) {
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+    for (int64_t i = 0; i < n; ++i) {
+        dst[i] = static_cast<float>(src[i]) * scale;
+    }
+}
+
+}  // extern "C"

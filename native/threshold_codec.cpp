@@ -1,0 +1,81 @@
+// Threshold gradient codec — C++ core.
+//
+// Reference: libnd4j's threshold encoding op
+// (`libnd4j/include/ops/declarable/generic/compression/threshold_encoding
+// .cpp` + `TrainingDriver`/`EncodedGradientsAccumulator` Java side): values
+// with |g| >= threshold are flattened to a sparse (index, sign) stream and
+// SUBTRACTED from the residual so un-sent magnitude carries to the next
+// step (1-bit-SGD-style delta compression for slow interconnects).
+//
+// TPU role: the ICI data plane uses XLA all-reduce (no compression), but
+// the optional DCN/multi-slice hop keeps this codec (SURVEY.md §2.4).
+// Encoded format: int32 array [n, idx0, idx1, ...] where sign is carried
+// in the index's sign bit (idx+1 for +threshold, -(idx+1) for -threshold)
+// — the reference's flat-threshold format.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image).
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Encode: scan grad, emit up to max_elements sparse entries, subtract
+// emitted magnitude from residual (residual updated in place).
+// Returns number of encoded elements.
+int64_t threshold_encode(const float* grad, float* residual, int64_t n,
+                         float threshold, int32_t* out,
+                         int64_t max_elements) {
+    int64_t count = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        float v = grad[i] + residual[i];
+        if (v >= threshold) {
+            if (count < max_elements) {
+                out[count++] = static_cast<int32_t>(i + 1);
+                residual[i] = v - threshold;
+            } else {
+                residual[i] = v;   // didn't fit: carry everything
+            }
+        } else if (v <= -threshold) {
+            if (count < max_elements) {
+                out[count++] = static_cast<int32_t>(-(i + 1));
+                residual[i] = v + threshold;
+            } else {
+                residual[i] = v;
+            }
+        } else {
+            residual[i] = v;       // below threshold: carry
+        }
+    }
+    return count;
+}
+
+// Decode: scatter +/- threshold into a dense float buffer (accumulating —
+// callers zero it or apply on top of params, reference semantics).
+void threshold_decode(const int32_t* encoded, int64_t count,
+                      float threshold, float* dense, int64_t n) {
+    for (int64_t j = 0; j < count; ++j) {
+        int32_t e = encoded[j];
+        if (e > 0 && e <= n) {
+            dense[e - 1] += threshold;
+        } else if (e < 0 && -e <= n) {
+            dense[-e - 1] -= threshold;
+        }
+    }
+}
+
+// Fraction of entries that were >= threshold — used for the reference's
+// adaptive-threshold logic (ResidualPostProcessor bumps the threshold when
+// the update is too dense).
+double threshold_density(const float* grad, const float* residual,
+                         int64_t n, float threshold) {
+    int64_t count = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        float v = grad[i] + residual[i];
+        if (v >= threshold || v <= -threshold) ++count;
+    }
+    return static_cast<double>(count) / static_cast<double>(n);
+}
+
+}  // extern "C"

@@ -1,0 +1,207 @@
+"""Model-import conformance tests — the reference pattern (`Keras import
+conformance`: golden h5 -> import -> predict -> compare; `TFGraphTestAll
+SameDiff`: graph -> import -> execute -> compare within tolerance).
+
+TF/Keras only builds the golden files; our framework does the inference.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.modelimport import (  # noqa: E402
+    KerasModelImport, TFImportRegistry, import_graph_def)
+from deeplearning4j_tpu.modelimport.keras import (  # noqa: E402
+    UnsupportedKerasConfigurationException)
+from deeplearning4j_tpu.modelimport.tf_import import (  # noqa: E402
+    UnmappedTFOpException)
+
+
+def _save(model, tmp_path, name="m.h5"):
+    p = str(tmp_path / name)
+    model.save(p)
+    return p
+
+
+def test_sequential_dense_import(tmp_path):
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((6,)),
+        tf.keras.layers.Dense(16, activation="relu"),
+        tf.keras.layers.Dense(8, activation="tanh"),
+        tf.keras.layers.Dense(3, activation="softmax")])
+    p = _save(km, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    x = np.random.RandomState(0).randn(5, 6).astype(np.float32)
+    expected = km.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_sequential_cnn_import(tmp_path):
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((12, 12, 3)),
+        tf.keras.layers.Conv2D(8, 3, activation="relu", padding="same"),
+        tf.keras.layers.MaxPooling2D(),
+        tf.keras.layers.Conv2D(16, 3, activation="relu", padding="valid"),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(10, activation="softmax")])
+    p = _save(km, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    x = np.random.RandomState(1).rand(3, 12, 12, 3).astype(np.float32)
+    expected = km.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_sequential_bn_dropout_import(tmp_path):
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((8, 8, 2)),
+        tf.keras.layers.Conv2D(4, 3, padding="same"),
+        tf.keras.layers.BatchNormalization(),
+        tf.keras.layers.Activation("relu"),
+        tf.keras.layers.Dropout(0.4),
+        tf.keras.layers.GlobalAveragePooling2D(),
+        tf.keras.layers.Dense(2, activation="softmax")])
+    p = _save(km, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    x = np.random.RandomState(2).rand(4, 8, 8, 2).astype(np.float32)
+    expected = km.predict(x, verbose=0)         # inference: dropout off
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-4)
+
+
+def test_sequential_lstm_import(tmp_path):
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((7, 5)),
+        tf.keras.layers.LSTM(12, return_sequences=True),
+        tf.keras.layers.LSTM(6),                    # last step only
+        tf.keras.layers.Dense(2, activation="softmax")])
+    p = _save(km, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    x = np.random.RandomState(3).randn(4, 7, 5).astype(np.float32)
+    expected = km.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-4)
+
+
+def test_functional_residual_import(tmp_path):
+    inp = tf.keras.layers.Input((10,), name="inp")
+    d1 = tf.keras.layers.Dense(10, activation="relu")(inp)
+    d2 = tf.keras.layers.Dense(10, activation="relu")(d1)
+    added = tf.keras.layers.Add()([d1, d2])
+    out = tf.keras.layers.Dense(4, activation="softmax")(added)
+    km = tf.keras.Model(inp, out)
+    p = _save(km, tmp_path)
+    net = KerasModelImport.import_keras_model_and_weights(p)
+    x = np.random.RandomState(4).randn(6, 10).astype(np.float32)
+    expected = km.predict(x, verbose=0)
+    (got,) = net.output(x)
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_functional_concat_import(tmp_path):
+    a = tf.keras.layers.Input((4,), name="a")
+    b = tf.keras.layers.Input((6,), name="b")
+    da = tf.keras.layers.Dense(5, activation="tanh")(a)
+    db = tf.keras.layers.Dense(7, activation="tanh")(b)
+    merged = tf.keras.layers.Concatenate()([da, db])
+    out = tf.keras.layers.Dense(2, activation="softmax")(merged)
+    km = tf.keras.Model([a, b], out)
+    p = _save(km, tmp_path)
+    net = KerasModelImport.import_keras_model_and_weights(p)
+    xa = np.random.RandomState(5).randn(3, 4).astype(np.float32)
+    xb = np.random.RandomState(6).randn(3, 6).astype(np.float32)
+    expected = km.predict([xa, xb], verbose=0)
+    (got,) = net.output(xa, xb)
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_unsupported_layer_named_error(tmp_path):
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((4, 4)),
+        tf.keras.layers.GRU(3)])
+    p = _save(km, tmp_path)
+    with pytest.raises(UnsupportedKerasConfigurationException, match="GRU"):
+        KerasModelImport.import_keras_sequential_model_and_weights(p)
+
+
+def test_imported_model_can_finetune(tmp_path):
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((6,)),
+        tf.keras.layers.Dense(16, activation="relu"),
+        tf.keras.layers.Dense(3, activation="softmax")])
+    p = _save(km, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 6).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 32)]
+    s0 = net.score_for(x, y)
+    for _ in range(10):
+        net.fit(x, y)
+    assert net.score_for(x, y) < s0
+
+
+# ---------------------------------------------------------------------------
+# TF GraphDef import
+# ---------------------------------------------------------------------------
+
+def _freeze(fn, *specs):
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+    cf = tf.function(fn).get_concrete_function(*specs)
+    frozen = convert_variables_to_constants_v2(cf)
+    return frozen.graph.as_graph_def(), frozen
+
+
+def test_tf_mlp_graph_import():
+    w1 = tf.constant(np.random.RandomState(0).randn(5, 8).astype(np.float32))
+    b1 = tf.constant(np.zeros(8, np.float32))
+    w2 = tf.constant(np.random.RandomState(1).randn(8, 3).astype(np.float32))
+
+    def f(x):
+        h = tf.nn.relu(tf.matmul(x, w1) + b1)
+        return tf.nn.softmax(tf.matmul(h, w2))
+
+    gd, frozen = _freeze(f, tf.TensorSpec((None, 5), tf.float32))
+    sd = import_graph_def(gd)
+    x = np.random.RandomState(2).randn(4, 5).astype(np.float32)
+    expected = frozen(tf.constant(x))[0].numpy()
+    out_name = gd.node[-1].name
+    got = np.asarray(sd.output({"x": x}, out_name)[out_name])
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_tf_conv_graph_import():
+    k = tf.constant(np.random.RandomState(0).randn(3, 3, 2, 4)
+                    .astype(np.float32) * 0.1)
+
+    def f(x):
+        y = tf.nn.conv2d(x, k, strides=[1, 1, 1, 1], padding="SAME")
+        y = tf.nn.relu(y)
+        y = tf.nn.max_pool2d(y, 2, 2, padding="VALID")
+        return tf.reduce_mean(y, axis=[1, 2])
+
+    gd, frozen = _freeze(f, tf.TensorSpec((None, 8, 8, 2), tf.float32))
+    sd = import_graph_def(gd)
+    x = np.random.RandomState(1).rand(2, 8, 8, 2).astype(np.float32)
+    expected = frozen(tf.constant(x))[0].numpy()
+    out_name = gd.node[-1].name
+    got = np.asarray(sd.output({"x": x}, out_name)[out_name])
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_tf_unmapped_op_named_error():
+    def f(x):
+        return tf.nn.depth_to_space(x, 2)
+
+    gd, _ = _freeze(f, tf.TensorSpec((1, 4, 4, 4), tf.float32))
+    with pytest.raises(UnmappedTFOpException, match="DepthToSpace"):
+        import_graph_def(gd)
