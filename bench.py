@@ -27,13 +27,17 @@ def _time_steps(fit_fn, n_warmup, n_steps):
     return time.perf_counter() - t0
 
 
-def bench_resnet50(batch=64, steps=20, image=224, classes=1000):
+def bench_resnet50(batch=64, steps=20, image=224, classes=1000,
+                   compute_dtype="bfloat16"):
+    """bf16 compute / f32 master params — the TPU-native precision choice
+    (f32: ~375 samples/sec on v5e; bf16: ~1636)."""
     import jax
     from deeplearning4j_tpu.train.updaters import Nesterovs
     from deeplearning4j_tpu.zoo import ResNet50
 
     net = ResNet50(n_classes=classes, input_shape=(image, image, 3),
-                   updater=Nesterovs(0.1, 0.9)).init_model()
+                   updater=Nesterovs(0.1, 0.9),
+                   compute_dtype=compute_dtype).init_model()
     rng = np.random.RandomState(0)
     x = rng.rand(batch, image, image, 3).astype(np.float32)
     y = np.eye(classes, dtype=np.float32)[rng.randint(0, classes, batch)]

@@ -270,6 +270,7 @@ class ComputationGraphConfiguration:
     l2: float = 0.0
     weight_decay: float = 0.0
     dtype: str = "float32"
+    compute_dtype: Optional[str] = None   # bf16 compute path (see multilayer)
     gradient_normalization: Optional[str] = None
     gradient_normalization_threshold: float = 1.0
 
@@ -313,6 +314,7 @@ class ComputationGraphConfiguration:
                           else getattr(self.activation, "__name__", "identity"),
             "l1": self.l1, "l2": self.l2, "weight_decay": self.weight_decay,
             "dtype": self.dtype,
+            "compute_dtype": self.compute_dtype,
             "gradient_normalization": self.gradient_normalization,
             "gradient_normalization_threshold": self.gradient_normalization_threshold,
         }, indent=2)
@@ -338,6 +340,7 @@ class ComputationGraphConfiguration:
             weight_init=d["weight_init"], activation=d["activation"],
             l1=d["l1"], l2=d["l2"], weight_decay=d.get("weight_decay", 0.0),
             dtype=d.get("dtype", "float32"),
+            compute_dtype=d.get("compute_dtype"),
             gradient_normalization=d.get("gradient_normalization"),
             gradient_normalization_threshold=d.get(
                 "gradient_normalization_threshold", 1.0),
@@ -362,6 +365,7 @@ class GraphBuilder:
         self._l2 = 0.0
         self._weight_decay = 0.0
         self._dtype = "float32"
+        self._compute_dtype = None
         self._grad_norm = None
         self._grad_norm_threshold = 1.0
 
@@ -374,6 +378,7 @@ class GraphBuilder:
     def l2(self, v): self._l2 = float(v); return self
     def weight_decay(self, v): self._weight_decay = float(v); return self
     def dtype(self, dt): self._dtype = dt; return self
+    def compute_dtype(self, dt): self._compute_dtype = dt; return self
 
     def gradient_normalization(self, mode, threshold=1.0):
         self._grad_norm = mode; self._grad_norm_threshold = threshold; return self
@@ -420,6 +425,7 @@ class GraphBuilder:
             updater=self._updater, weight_init=self._weight_init,
             activation=self._activation, l1=self._l1, l2=self._l2,
             weight_decay=self._weight_decay, dtype=self._dtype,
+            compute_dtype=self._compute_dtype,
             gradient_normalization=self._grad_norm,
             gradient_normalization_threshold=self._grad_norm_threshold)
 
@@ -497,6 +503,13 @@ class ComputationGraph:
         `compute_loss` — heads still produce their normal activation so
         downstream consumers see real outputs; XLA dead-code-eliminates an
         unused head forward)."""
+        cd = self.conf.compute_dtype
+        if cd is not None:
+            dt = jnp.dtype(cd)
+            cast = (lambda a: a.astype(dt)
+                    if jnp.issubdtype(a.dtype, jnp.floating) else a)
+            params = jax.tree_util.tree_map(cast, params)
+            inputs = {k: cast(jnp.asarray(v)) for k, v in inputs.items()}
         acts: Dict[str, jnp.ndarray] = dict(inputs)
         head_inputs: Dict[str, jnp.ndarray] = {}
         new_state = dict(state)

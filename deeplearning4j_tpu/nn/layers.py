@@ -92,6 +92,9 @@ class OutputLayer(DenseLayer):
         pre = x @ params["W"]
         if self.has_bias:
             pre = pre + params["b"]
+        # loss math (softmax/log) in >= f32: upcasts bf16 logits, leaves
+        # f64 gradient-check nets untouched
+        pre = pre.astype(jnp.promote_types(pre.dtype, jnp.float32))
         return apply_loss(self.loss, self.act_fn(), pre, labels, mask)
 
 
@@ -611,17 +614,25 @@ class BatchNormalizationLayer(Layer):
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         axes = tuple(range(x.ndim - 1))
+        # statistics in >= f32 even under a bf16 compute_dtype
+        # (mixed-precision invariant: normalizer math accumulates f32;
+        # f64 nets keep f64); running stats keep their stored dtype so
+        # state shapes/dtypes are step-stable
+        xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
         if train:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
             new_state = {
-                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
-                "var": self.decay * state["var"] + (1 - self.decay) * var,
+                "mean": (self.decay * state["mean"]
+                         + (1 - self.decay) * mean.astype(state["mean"].dtype)),
+                "var": (self.decay * state["var"]
+                        + (1 - self.decay) * var.astype(state["var"].dtype)),
             }
         else:
-            mean, var = state["mean"], state["var"]
+            mean, var = (state["mean"].astype(jnp.float32),
+                         state["var"].astype(jnp.float32))
             new_state = state
-        y = (x - mean) / jnp.sqrt(var + self.eps)
+        y = ((xf - mean) / jnp.sqrt(var + self.eps)).astype(x.dtype)
         if not self.lock_gamma_beta:
             y = y * params["gamma"] + params["beta"]
         return self.act_fn()(y), new_state

@@ -71,6 +71,11 @@ class MultiLayerConfiguration:
     l2: float = 0.0
     weight_decay: float = 0.0
     dtype: str = "float32"
+    # bf16 compute path: master params/updater state stay `dtype` (f32);
+    # activations + layer params are cast to compute_dtype inside the
+    # forward, losses/BN-statistics compute in f32 (the TPU mixed-precision
+    # recipe — MXU runs bf16, accumulation stays f32)
+    compute_dtype: Optional[str] = None
     gradient_normalization: Optional[str] = None
     gradient_normalization_threshold: float = 1.0
 
@@ -89,6 +94,7 @@ class MultiLayerConfiguration:
                           else getattr(self.activation, "__name__", "identity"),
             "l1": self.l1, "l2": self.l2, "weight_decay": self.weight_decay,
             "dtype": self.dtype,
+            "compute_dtype": self.compute_dtype,
             "gradient_normalization": self.gradient_normalization,
             "gradient_normalization_threshold": self.gradient_normalization_threshold,
         }, indent=2)
@@ -105,6 +111,7 @@ class MultiLayerConfiguration:
             activation=d["activation"],
             l1=d["l1"], l2=d["l2"], weight_decay=d.get("weight_decay", 0.0),
             dtype=d.get("dtype", "float32"),
+            compute_dtype=d.get("compute_dtype"),
             gradient_normalization=d.get("gradient_normalization"),
             gradient_normalization_threshold=d.get("gradient_normalization_threshold", 1.0),
         )
@@ -124,6 +131,7 @@ class NeuralNetConfiguration:
             self._l2 = 0.0
             self._weight_decay = 0.0
             self._dtype = "float32"
+            self._compute_dtype = None
             self._grad_norm = None
             self._grad_norm_threshold = 1.0
             self._input_type: Optional[InputType] = None
@@ -152,6 +160,9 @@ class NeuralNetConfiguration:
         def dtype(self, dt: str):
             self._dtype = dt; return self
 
+        def compute_dtype(self, dt: str):
+            self._compute_dtype = dt; return self
+
         def gradient_normalization(self, mode: str, threshold: float = 1.0):
             self._grad_norm = mode; self._grad_norm_threshold = threshold; return self
 
@@ -178,6 +189,7 @@ class NeuralNetConfiguration:
                 updater=p._updater, weight_init=p._weight_init,
                 activation=p._activation, l1=p._l1, l2=p._l2,
                 weight_decay=p._weight_decay, dtype=p._dtype,
+                compute_dtype=p._compute_dtype,
                 gradient_normalization=p._grad_norm,
                 gradient_normalization_threshold=p._grad_norm_threshold,
             )
@@ -248,9 +260,22 @@ class MultiLayerNetwork:
         }
 
     # ---- forward ----
+    def _cast_compute(self, params: Params, x):
+        """Mixed precision: cast activations + params to compute_dtype;
+        gradients flow back through the casts to f32 master params."""
+        cd = self.conf.compute_dtype
+        if cd is None:
+            return params, x
+        dt = jnp.dtype(cd)
+        cast = lambda a: a.astype(dt) if jnp.issubdtype(a.dtype,
+                                                        jnp.floating) else a
+        return (jax.tree_util.tree_map(cast, params),
+                x.astype(dt) if jnp.issubdtype(x.dtype, jnp.floating) else x)
+
     def _forward(self, params: Params, state: Params, x, *, train: bool,
                  rng: Optional[jax.Array], mask=None,
                  upto: Optional[int] = None) -> Tuple[jnp.ndarray, Params]:
+        params, x = self._cast_compute(params, x)
         new_state = dict(state)
         n = len(self.conf.layers) if upto is None else upto
         for i in range(n):
@@ -294,7 +319,8 @@ class MultiLayerNetwork:
                                      mask=features_mask, upto=out_idx)
         name = self.conf.layer_name(out_idx)
         hrng = None if rng is None else jax.random.fold_in(rng, out_idx)
-        loss = head.compute_loss(params[name], state[name], h, y, train=train,
+        hp, h = self._cast_compute(params[name], h)  # head matmul bf16 too
+        loss = head.compute_loss(hp, state[name], h, y, train=train,
                                  rng=hrng, mask=labels_mask)
         loss = loss + self._reg_penalty(params)
         return loss, new_state

@@ -1,0 +1,7 @@
+"""Reinforcement learning (reference `rl4j/rl4j-core/.../rl4j/**`)."""
+from deeplearning4j_tpu.rl.mdp import MDP, CartPole, LineWorld  # noqa: F401
+from deeplearning4j_tpu.rl.replay import ExpReplay, Transition  # noqa: F401
+from deeplearning4j_tpu.rl.policy import (  # noqa: F401
+    EpsGreedy, GreedyPolicy)
+from deeplearning4j_tpu.rl.qlearning import (  # noqa: F401
+    QLearningConfiguration, QLearningDiscrete)

@@ -1,0 +1,124 @@
+"""MDP interface + built-in toy environments.
+
+Reference: `rl4j-api/.../mdp/MDP.java` (reset/step/isDone/close, gym-style)
+and the gym/malmo/ale bindings.  No gym in this image; CartPole ships
+in-tree (standard physics) plus a fast deterministic LineWorld for tests.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import numpy as np
+
+
+class MDP:
+    """reset() -> obs; step(action) -> (obs, reward, done, info)."""
+
+    observation_size: int
+    n_actions: int
+
+    def reset(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, action: int) -> Tuple[np.ndarray, float, bool, dict]:
+        raise NotImplementedError
+
+    def is_done(self) -> bool:
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class LineWorld(MDP):
+    """Deterministic corridor: positions 0..n-1, actions {left, right};
+    reward 1 at the right end, -0.01 per step; episode cap 4n.  Optimal
+    return is learnable in a handful of episodes — the convergence test
+    environment."""
+
+    def __init__(self, n: int = 8):
+        self.n = n
+        self.observation_size = n
+        self.n_actions = 2
+        self._pos = 0
+        self._steps = 0
+        self._done = False
+
+    def _obs(self) -> np.ndarray:
+        o = np.zeros(self.n, np.float32)
+        o[self._pos] = 1.0
+        return o
+
+    def reset(self) -> np.ndarray:
+        self._pos = 0
+        self._steps = 0
+        self._done = False
+        return self._obs()
+
+    def step(self, action: int):
+        self._steps += 1
+        self._pos = min(self.n - 1, max(0, self._pos + (1 if action else -1)))
+        reward = -0.01
+        if self._pos == self.n - 1:
+            reward = 1.0
+            self._done = True
+        elif self._steps >= 4 * self.n:
+            self._done = True
+        return self._obs(), reward, self._done, {}
+
+    def is_done(self) -> bool:
+        return self._done
+
+
+class CartPole(MDP):
+    """Classic cart-pole balancing (standard equations of motion; the rl4j
+    gym-binding workload without gym)."""
+
+    def __init__(self, seed: int = 0):
+        self.observation_size = 4
+        self.n_actions = 2
+        self._rng = np.random.RandomState(seed)
+        self.gravity = 9.8
+        self.masscart = 1.0
+        self.masspole = 0.1
+        self.length = 0.5
+        self.force_mag = 10.0
+        self.tau = 0.02
+        self.theta_threshold = 12 * 2 * np.pi / 360
+        self.x_threshold = 2.4
+        self._state = None
+        self._done = True
+        self._steps = 0
+
+    def reset(self) -> np.ndarray:
+        self._state = self._rng.uniform(-0.05, 0.05, 4).astype(np.float32)
+        self._done = False
+        self._steps = 0
+        return self._state.copy()
+
+    def step(self, action: int):
+        x, x_dot, theta, theta_dot = self._state
+        force = self.force_mag if action == 1 else -self.force_mag
+        costheta, sintheta = np.cos(theta), np.sin(theta)
+        total_mass = self.masscart + self.masspole
+        polemass_length = self.masspole * self.length
+        temp = (force + polemass_length * theta_dot ** 2 * sintheta) \
+            / total_mass
+        thetaacc = (self.gravity * sintheta - costheta * temp) / (
+            self.length * (4.0 / 3.0
+                           - self.masspole * costheta ** 2 / total_mass))
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+        x += self.tau * x_dot
+        x_dot += self.tau * xacc
+        theta += self.tau * theta_dot
+        theta_dot += self.tau * thetaacc
+        self._state = np.array([x, x_dot, theta, theta_dot], np.float32)
+        self._steps += 1
+        self._done = bool(abs(x) > self.x_threshold
+                          or abs(theta) > self.theta_threshold
+                          or self._steps >= 500)
+        return self._state.copy(), 1.0, self._done, {}
+
+    def is_done(self) -> bool:
+        return self._done

@@ -1,0 +1,52 @@
+"""Policies (reference `rl4j-core/.../policy/{EpsGreedy,DQNPolicy}.java`)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class GreedyPolicy:
+    """argmax-Q policy (reference `DQNPolicy`)."""
+
+    def __init__(self, q_fn):
+        self._q = q_fn
+
+    def next_action(self, obs: np.ndarray) -> int:
+        return int(np.argmax(self._q(obs[None])[0]))
+
+    def play(self, mdp, max_steps: int = 10_000) -> float:
+        """Run one greedy episode; returns total reward (reference
+        `Policy.play`)."""
+        obs = mdp.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            obs, r, done, _ = mdp.step(self.next_action(obs))
+            total += r
+            if done:
+                break
+        return total
+
+
+class EpsGreedy:
+    """Annealed epsilon-greedy exploration (reference `EpsGreedy`):
+    linearly decays from eps_init to eps_min over `anneal_steps`."""
+
+    def __init__(self, q_fn, n_actions: int, eps_init: float = 1.0,
+                 eps_min: float = 0.1, anneal_steps: int = 10_000,
+                 seed: int = 0):
+        self._q = q_fn
+        self.n_actions = n_actions
+        self.eps_init = eps_init
+        self.eps_min = eps_min
+        self.anneal_steps = anneal_steps
+        self.step_count = 0
+        self._rng = np.random.RandomState(seed)
+
+    def epsilon(self) -> float:
+        frac = min(1.0, self.step_count / max(1, self.anneal_steps))
+        return self.eps_init + frac * (self.eps_min - self.eps_init)
+
+    def next_action(self, obs: np.ndarray) -> int:
+        self.step_count += 1
+        if self._rng.rand() < self.epsilon():
+            return int(self._rng.randint(self.n_actions))
+        return int(np.argmax(self._q(obs[None])[0]))

@@ -1,0 +1,136 @@
+"""Deep Q-learning (reference `rl4j-core/.../learning/sync/qlearning/
+discrete/QLearningDiscrete.java` + `QLearningConfiguration`).
+
+Same training scheme as the reference: the Q-network is a regression net
+over actions; each update computes Q(s) for a replay batch, substitutes the
+TD target at the taken action (Double-DQN option: argmax from the online
+net, value from the target net), and fits the network on (s, y) — which
+maps directly onto MultiLayerNetwork.fit's compiled step.  Target network
+syncs every `target_update` steps.
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+import logging
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nn import (DenseLayer, InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.rl.mdp import MDP
+from deeplearning4j_tpu.rl.policy import EpsGreedy, GreedyPolicy
+from deeplearning4j_tpu.rl.replay import ExpReplay, Transition
+from deeplearning4j_tpu.train.updaters import Adam
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+@dataclasses.dataclass
+class QLearningConfiguration:
+    """Reference `QLearningConfiguration` fields."""
+
+    seed: int = 0
+    max_step: int = 20_000
+    max_epoch_step: int = 1_000
+    exp_repeat: int = 1                  # updates per env step
+    batch_size: int = 32
+    target_update: int = 500             # target-net sync interval
+    update_start: int = 100              # warmup before learning
+    gamma: float = 0.99
+    eps_init: float = 1.0
+    eps_min: float = 0.05
+    anneal_steps: int = 3_000
+    double_dqn: bool = True
+    replay_size: int = 10_000
+
+
+def default_q_network(obs_size: int, n_actions: int, hidden=(64, 64),
+                      seed: int = 0, lr: float = 1e-3) -> MultiLayerNetwork:
+    """The reference's DQNFactoryStdDense equivalent."""
+    layers = [DenseLayer(n_out=h, activation="relu") for h in hidden]
+    layers.append(OutputLayer(n_out=n_actions, loss="mse",
+                              activation="identity"))
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(lr))
+            .weight_init("XAVIER")
+            .list(layers)
+            .set_input_type(InputType.feed_forward(obs_size)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+class QLearningDiscrete:
+    """Synchronous DQN trainer over an MDP."""
+
+    def __init__(self, mdp: MDP, config: QLearningConfiguration = None,
+                 network: Optional[MultiLayerNetwork] = None):
+        self.mdp = mdp
+        self.cfg = config or QLearningConfiguration()
+        self.net = network or default_q_network(
+            mdp.observation_size, mdp.n_actions, seed=self.cfg.seed)
+        self.target_params = copy.deepcopy(self.net.params_)
+        self.replay = ExpReplay(self.cfg.replay_size, self.cfg.batch_size,
+                                self.cfg.seed)
+        self.policy = EpsGreedy(self._q_online, mdp.n_actions,
+                                self.cfg.eps_init, self.cfg.eps_min,
+                                self.cfg.anneal_steps, self.cfg.seed)
+        self.step_count = 0
+        self.episode_rewards: List[float] = []
+
+    # ---- Q functions ----
+    def _q_online(self, obs: np.ndarray) -> np.ndarray:
+        return np.asarray(self.net.output(obs))
+
+    def _q_target(self, obs: np.ndarray) -> np.ndarray:
+        saved = self.net.params_
+        self.net.params_ = self.target_params
+        try:
+            return np.asarray(self.net.output(obs))
+        finally:
+            self.net.params_ = saved
+
+    # ---- learning ----
+    def _learn_batch(self):
+        obs, actions, rewards, next_obs, dones = self.replay.sample()
+        q_next_target = self._q_target(next_obs)
+        if self.cfg.double_dqn:
+            best = self._q_online(next_obs).argmax(1)
+            q_next = q_next_target[np.arange(len(best)), best]
+        else:
+            q_next = q_next_target.max(1)
+        targets = rewards + self.cfg.gamma * q_next * (1.0 - dones)
+        y = np.array(self._q_online(obs))    # writable copy (device arrays
+        y[np.arange(len(actions)), actions] = targets  # view is read-only)
+        self.net.fit(obs, y)
+
+    def train(self, max_steps: Optional[int] = None) -> List[float]:
+        """Run environment steps + learning until max_step; returns episode
+        rewards (reference `Learning.train`)."""
+        limit = max_steps or self.cfg.max_step
+        obs = self.mdp.reset()
+        ep_reward = 0.0
+        ep_steps = 0
+        while self.step_count < limit:
+            action = self.policy.next_action(obs)
+            next_obs, reward, done, _ = self.mdp.step(action)
+            self.replay.store(Transition(obs, action, reward, next_obs,
+                                         done))
+            obs = next_obs
+            ep_reward += reward
+            ep_steps += 1
+            self.step_count += 1
+            if (self.step_count >= self.cfg.update_start
+                    and len(self.replay) >= self.cfg.batch_size):
+                for _ in range(self.cfg.exp_repeat):
+                    self._learn_batch()
+            if self.step_count % self.cfg.target_update == 0:
+                self.target_params = copy.deepcopy(self.net.params_)
+            if done or ep_steps >= self.cfg.max_epoch_step:
+                self.episode_rewards.append(ep_reward)
+                obs = self.mdp.reset()
+                ep_reward = 0.0
+                ep_steps = 0
+        return self.episode_rewards
+
+    def get_policy(self) -> GreedyPolicy:
+        return GreedyPolicy(self._q_online)

@@ -1,0 +1,48 @@
+"""Experience replay (reference `rl4j-core/.../experience/
+{ExpReplay,StateActionRewardState}.java`): fixed-capacity ring buffer +
+uniform batch sampling."""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Transition:
+    obs: np.ndarray
+    action: int
+    reward: float
+    next_obs: np.ndarray
+    done: bool
+
+
+class ExpReplay:
+    def __init__(self, max_size: int = 10000, batch_size: int = 32,
+                 seed: int = 0):
+        self.max_size = max_size
+        self.batch_size = batch_size
+        self._buf: List[Transition] = []
+        self._pos = 0
+        self._rng = np.random.RandomState(seed)
+
+    def store(self, t: Transition):
+        if len(self._buf) < self.max_size:
+            self._buf.append(t)
+        else:
+            self._buf[self._pos] = t
+        self._pos = (self._pos + 1) % self.max_size
+
+    def __len__(self):
+        return len(self._buf)
+
+    def sample(self) -> Tuple[np.ndarray, ...]:
+        """Batch of (obs, actions, rewards, next_obs, dones) arrays."""
+        idx = self._rng.randint(0, len(self._buf), self.batch_size)
+        ts = [self._buf[i] for i in idx]
+        return (np.stack([t.obs for t in ts]),
+                np.asarray([t.action for t in ts], np.int32),
+                np.asarray([t.reward for t in ts], np.float32),
+                np.stack([t.next_obs for t in ts]),
+                np.asarray([t.done for t in ts], np.float32))

@@ -1,0 +1,3 @@
+"""Training UI / metrics (reference `deeplearning4j-ui-parent/**`)."""
+from deeplearning4j_tpu.ui.stats import (  # noqa: F401
+    FileStatsStorage, InMemoryStatsStorage, StatsListener, render_html)

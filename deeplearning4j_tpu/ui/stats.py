@@ -1,0 +1,190 @@
+"""StatsListener -> StatsStorage -> report (reference
+`deeplearning4j-ui/.../stats/StatsListener.java`, `StatsStorage` (in-mem /
+MapDB), and the Vert.x websocket dashboard).
+
+TPU re-shape: the reference streams per-iteration stats to a live web
+server; here stats collect host-side (norms computed on device, one scalar
+pulled per series) into a storage that renders a STATIC html report —
+no server dependency, same signature charts: score curve, per-layer
+param/gradient-update norms, and the update:param ratio chart (the DL4J
+diagnostic: healthy training sits near 1e-3).
+"""
+from __future__ import annotations
+
+import json
+import math
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.train.listeners import TrainingListener
+
+
+class InMemoryStatsStorage:
+    """Reference `InMemoryStatsStorage`."""
+
+    def __init__(self):
+        self.score: List[tuple] = []                 # (iter, score)
+        self.param_norms: Dict[str, List[tuple]] = {}
+        self.update_norms: Dict[str, List[tuple]] = {}
+        self.ratios: Dict[str, List[tuple]] = {}     # update:param ratio
+        self.meta: Dict[str, object] = {}
+
+    def put_score(self, iteration: int, score: float):
+        self.score.append((iteration, score))
+
+    def put_layer(self, iteration: int, layer: str, p_norm: float,
+                  u_norm: float):
+        self.param_norms.setdefault(layer, []).append((iteration, p_norm))
+        self.update_norms.setdefault(layer, []).append((iteration, u_norm))
+        ratio = u_norm / p_norm if p_norm > 0 else float("nan")
+        self.ratios.setdefault(layer, []).append((iteration, ratio))
+
+    def to_json(self) -> str:
+        return json.dumps({"score": self.score,
+                           "param_norms": self.param_norms,
+                           "update_norms": self.update_norms,
+                           "ratios": self.ratios, "meta": self.meta})
+
+
+class FileStatsStorage(InMemoryStatsStorage):
+    """JSON-lines persistence (the MapDB `FileStatsStorage` role)."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        self._f = open(path, "a")
+
+    def put_score(self, iteration, score):
+        super().put_score(iteration, score)
+        self._f.write(json.dumps({"t": "score", "i": iteration,
+                                  "v": score}) + "\n")
+        self._f.flush()
+
+    def put_layer(self, iteration, layer, p_norm, u_norm):
+        super().put_layer(iteration, layer, p_norm, u_norm)
+        self._f.write(json.dumps({"t": "layer", "i": iteration, "l": layer,
+                                  "p": p_norm, "u": u_norm}) + "\n")
+
+    def close(self):
+        self._f.close()
+
+    @staticmethod
+    def load(path: str) -> "InMemoryStatsStorage":
+        st = InMemoryStatsStorage()
+        with open(path) as f:
+            for line in f:
+                d = json.loads(line)
+                if d["t"] == "score":
+                    st.put_score(d["i"], d["v"])
+                else:
+                    st.put_layer(d["i"], d["l"], d["p"], d["u"])
+        return st
+
+
+class StatsListener(TrainingListener):
+    """Collects score + per-layer param/update L2 norms every `frequency`
+    iterations.  Update norms come from param deltas between collections
+    (captures the applied update incl. lr — what the reference's ratio
+    chart actually plots)."""
+
+    def __init__(self, storage: InMemoryStatsStorage,
+                 frequency: int = 10):
+        self.storage = storage
+        self.frequency = max(1, frequency)
+        self._prev_params = None
+
+    @staticmethod
+    def _norms(tree) -> Dict[str, float]:
+        out = {}
+        for layer, sub in tree.items():
+            leaves = jax.tree_util.tree_leaves(sub)
+            if not leaves:
+                continue
+            sq = sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                     for l in leaves)
+            out[layer] = float(jnp.sqrt(sq))
+        return out
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.frequency:
+            return
+        self.storage.put_score(iteration, model.score())
+        params = model.params_
+        p_norms = self._norms(params)
+        if self._prev_params is not None:
+            diff = jax.tree_util.tree_map(
+                lambda a, b: a.astype(jnp.float32) - b.astype(jnp.float32),
+                params, self._prev_params)
+            u_norms = self._norms(diff)
+            for layer, pn in p_norms.items():
+                self.storage.put_layer(iteration, layer, pn,
+                                       u_norms.get(layer, 0.0))
+        # deep-copy on device: the compiled step DONATES param buffers, so
+        # holding a bare reference would be use-after-donation next step
+        self._prev_params = jax.tree_util.tree_map(lambda a: a.copy(),
+                                                   params)
+
+
+# ---------------------------------------------------------------------------
+# Static HTML report
+# ---------------------------------------------------------------------------
+
+def _svg_polyline(series: List[tuple], width=640, height=180,
+                  color="#2a6fdb", logy=False) -> str:
+    if len(series) < 2:
+        return "<svg></svg>"
+    xs = [p[0] for p in series]
+    ys = [p[1] for p in series]
+    if logy:
+        ys = [math.log10(max(y, 1e-12)) for y in ys]
+    ys = [y if math.isfinite(y) else 0.0 for y in ys]
+    x0, x1 = min(xs), max(xs) or 1
+    y0, y1 = min(ys), max(ys)
+    if y1 == y0:
+        y1 = y0 + 1
+    pts = " ".join(
+        f"{(x - x0) / (x1 - x0 or 1) * width:.1f},"
+        f"{height - (y - y0) / (y1 - y0) * height:.1f}"
+        for x, y in zip(xs, ys))
+    return (f'<svg width="{width}" height="{height}" '
+            f'style="background:#fafafa;border:1px solid #ddd">'
+            f'<polyline fill="none" stroke="{color}" stroke-width="1.5" '
+            f'points="{pts}"/></svg>')
+
+
+def render_html(storage: InMemoryStatsStorage, path: Optional[str] = None
+                ) -> str:
+    """Static dashboard: score curve + update:param ratio per layer (log10;
+    the reference's signature chart — healthy values near 1e-3)."""
+    colors = ["#2a6fdb", "#db2a55", "#2adb8c", "#db9a2a", "#8c2adb",
+              "#2adbd5"]
+    parts = ["<html><head><title>deeplearning4j_tpu training</title>",
+             "<style>body{font-family:sans-serif;margin:24px}</style>",
+             "</head><body><h1>Training report</h1>",
+             f"<p>Generated {time.strftime('%Y-%m-%d %H:%M:%S')}</p>",
+             "<h2>Score vs iteration</h2>",
+             _svg_polyline(storage.score)]
+    parts.append("<h2>Update : parameter ratio (log10)</h2><ul>")
+    for i, (layer, series) in enumerate(sorted(storage.ratios.items())):
+        c = colors[i % len(colors)]
+        parts.append(f'<li style="color:{c}">{layer}</li>')
+    parts.append("</ul>")
+    for i, (layer, series) in enumerate(sorted(storage.ratios.items())):
+        parts.append(_svg_polyline(series, height=90,
+                                   color=colors[i % len(colors)],
+                                   logy=True))
+    parts.append("<h2>Parameter norms</h2>")
+    for i, (layer, series) in enumerate(sorted(storage.param_norms.items())):
+        parts.append(f"<h4>{layer}</h4>")
+        parts.append(_svg_polyline(series, height=80,
+                                   color=colors[i % len(colors)]))
+    parts.append("</body></html>")
+    html = "\n".join(parts)
+    if path:
+        with open(path, "w") as f:
+            f.write(html)
+    return html

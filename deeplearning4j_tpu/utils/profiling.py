@@ -1,0 +1,100 @@
+"""Profiling / tracing / panic modes.
+
+Reference (SURVEY.md §5.1): `OpProfiler` (per-op timing), `ProfilerConfig`
+NAN_PANIC/INF_PANIC modes checking outputs after every op,
+`PerformanceTracker`, libnd4j `Environment::setDebug/Verbose`.
+
+TPU translation: per-op host timing is meaningless under whole-graph XLA
+compilation — the equivalents are (a) the XLA/XProf device trace
+(`trace()` -> TensorBoard), (b) `jax_debug_nans` which re-runs the failing
+jitted computation op-by-op and reports the exact primitive (strictly
+better than the reference's post-op scan), (c) jaxpr-level op statistics
+(`op_profile`) replacing OpProfiler's op-census role, and (d) a host-side
+`PerformanceTracker` for step timing/throughput.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import Counter
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+
+def set_nan_panic(enabled: bool = True):
+    """Reference `ProfilerConfig.nanPanic`: fail loudly on NaN (jax re-runs
+    the jitted fn un-jitted to localize the op)."""
+    jax.config.update("jax_debug_nans", enabled)
+
+
+def set_inf_panic(enabled: bool = True):
+    jax.config.update("jax_debug_infs", enabled)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Device trace for TensorBoard/XProf (the OpProfiler timing role,
+    measured on-device where the time actually goes)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def op_profile(fn: Callable, *args, **kwargs) -> Dict[str, int]:
+    """Primitive census of a traced function (OpProfiler's op-count role):
+    returns {primitive_name: count} from the closed jaxpr."""
+    jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
+    counts: Counter = Counter()
+
+    def walk(jxp):
+        for eqn in jxp.eqns:
+            counts[eqn.primitive.name] += 1
+            for v in eqn.params.values():
+                if hasattr(v, "eqns"):          # sub-jaxpr
+                    walk(v)
+                elif hasattr(v, "jaxpr"):
+                    walk(v.jaxpr)
+
+    walk(jaxpr.jaxpr)
+    return dict(counts)
+
+
+class PerformanceTracker:
+    """Step timing + throughput aggregation (reference
+    `PerformanceTracker`/`PerformanceListener` role for ad-hoc loops)."""
+
+    def __init__(self):
+        self.steps: List[float] = []
+        self._t0: Optional[float] = None
+
+    @contextlib.contextmanager
+    def step(self, result: Any = None):
+        """Times one step; pass the step's output pytree so the timer
+        blocks on device completion (dispatch is async)."""
+        t0 = time.perf_counter()
+        holder = {}
+
+        def done(r):
+            holder["r"] = r
+        yield done
+        if "r" in holder:
+            jax.block_until_ready(holder["r"])
+        self.steps.append(time.perf_counter() - t0)
+
+    def mean_step_time(self) -> float:
+        return sum(self.steps) / max(len(self.steps), 1)
+
+    def throughput(self, items_per_step: int) -> float:
+        mt = self.mean_step_time()
+        return items_per_step / mt if mt else float("nan")
+
+    def summary(self) -> str:
+        n = len(self.steps)
+        if not n:
+            return "no steps recorded"
+        return (f"{n} steps, mean {1000 * self.mean_step_time():.2f}ms, "
+                f"min {1000 * min(self.steps):.2f}ms, "
+                f"max {1000 * max(self.steps):.2f}ms")

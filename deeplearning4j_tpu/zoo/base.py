@@ -24,9 +24,15 @@ class ZooModel:
     input_shape: Tuple[int, ...] = (224, 224, 3)
     seed: int = 123
     updater: Optional[IUpdater] = None
+    compute_dtype: Optional[str] = None   # "bfloat16" for TPU throughput
 
     def _updater(self) -> IUpdater:
         return self.updater if self.updater is not None else Adam(1e-3)
+
+    def _net(self, net_cls, conf):
+        if self.compute_dtype:
+            conf.compute_dtype = self.compute_dtype
+        return net_cls(conf).init()
 
     def conf(self):
         raise NotImplementedError

@@ -76,7 +76,7 @@ class ResNet50(ZooModel):
         return b.build()
 
     def init_model(self) -> ComputationGraph:
-        return ComputationGraph(self.conf()).init()
+        return self._net(ComputationGraph, self.conf())
 
 
 @zoo_model
@@ -134,7 +134,7 @@ class SqueezeNet(ZooModel):
         return b.build()
 
     def init_model(self) -> ComputationGraph:
-        return ComputationGraph(self.conf()).init()
+        return self._net(ComputationGraph, self.conf())
 
 
 @zoo_model
@@ -182,4 +182,4 @@ class UNet(ZooModel):
         return b.build()
 
     def init_model(self) -> ComputationGraph:
-        return ComputationGraph(self.conf()).init()
+        return self._net(ComputationGraph, self.conf())

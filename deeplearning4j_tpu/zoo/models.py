@@ -58,7 +58,7 @@ class LeNet(ZooModel):
                 .build())
 
     def init_model(self) -> MultiLayerNetwork:
-        return MultiLayerNetwork(self.conf()).init()
+        return self._net(MultiLayerNetwork, self.conf())
 
 
 @zoo_model
@@ -90,7 +90,7 @@ class SimpleCNN(ZooModel):
                 .build())
 
     def init_model(self) -> MultiLayerNetwork:
-        return MultiLayerNetwork(self.conf()).init()
+        return self._net(MultiLayerNetwork, self.conf())
 
 
 @zoo_model
@@ -125,7 +125,7 @@ class AlexNet(ZooModel):
                 .build())
 
     def init_model(self) -> MultiLayerNetwork:
-        return MultiLayerNetwork(self.conf()).init()
+        return self._net(MultiLayerNetwork, self.conf())
 
 
 def _vgg_blocks(spec: List[Tuple[int, int]]) -> List[Layer]:
@@ -158,7 +158,7 @@ class VGG16(ZooModel):
                 .build())
 
     def init_model(self) -> MultiLayerNetwork:
-        return MultiLayerNetwork(self.conf()).init()
+        return self._net(MultiLayerNetwork, self.conf())
 
 
 @zoo_model
@@ -208,7 +208,7 @@ class Darknet19(ZooModel):
                 .build())
 
     def init_model(self) -> MultiLayerNetwork:
-        return MultiLayerNetwork(self.conf()).init()
+        return self._net(MultiLayerNetwork, self.conf())
 
 
 @zoo_model
@@ -237,4 +237,4 @@ class TextGenLSTM(ZooModel):
                 .build())
 
     def init_model(self) -> MultiLayerNetwork:
-        return MultiLayerNetwork(self.conf()).init()
+        return self._net(MultiLayerNetwork, self.conf())

@@ -1,0 +1,60 @@
+"""Mixed-precision (bf16 compute / f32 master params) tests."""
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn import (DenseLayer, InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.train.updaters import Adam
+from deeplearning4j_tpu.zoo import LeNet, ResNet50
+from deeplearning4j_tpu.data import SyntheticMnist
+
+
+def test_mln_bf16_trains_with_f32_master_params():
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+            .compute_dtype("bfloat16")
+            .list([DenseLayer(n_out=32, activation="relu"),
+                   OutputLayer(n_out=3, loss="mcxent",
+                               activation="softmax")])
+            .set_input_type(InputType.feed_forward(8)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 8).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[(x[:, 0] > 0).astype(int)
+                                    + (x[:, 1] > 0).astype(int)]
+    s0 = net.score_for(x, y)
+    for _ in range(40):
+        net.fit(x, y)
+    assert net.score_for(x, y) < s0 * 0.5
+    # master params remain f32
+    assert net.params_["layer_0"]["W"].dtype == jnp.float32
+    # json round-trip keeps the setting
+    assert '"compute_dtype": "bfloat16"' in conf.to_json()
+
+
+def test_lenet_bf16_convergence_close_to_f32():
+    f32 = LeNet(seed=1).init_model()
+    bf16 = LeNet(seed=1, compute_dtype="bfloat16").init_model()
+    it = SyntheticMnist(batch_size=64, n_batches=4)
+    for _ in range(3):
+        f32.fit(it)
+        bf16.fit(it)
+    val = SyntheticMnist(batch_size=64, n_batches=2, seed=5)
+    a32 = f32.evaluate(val).accuracy()
+    a16 = bf16.evaluate(val).accuracy()
+    assert a16 > 0.8
+    assert abs(a32 - a16) < 0.1
+
+
+def test_resnet_bf16_graph_trains():
+    net = ResNet50(n_classes=3, input_shape=(32, 32, 3),
+                   compute_dtype="bfloat16").init_model()
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 32, 32, 3).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 8)]
+    s0 = net.score_for(x, y)
+    for _ in range(8):
+        net.fit(x, y)
+    s1 = net.score_for(x, y)
+    assert np.isfinite(s1) and s1 < s0
+    # BN running stats stayed f32 (step-stable state dtypes)
+    assert net.state_["stem_bn"]["mean"].dtype == jnp.float32

@@ -1,0 +1,95 @@
+"""UI stats + profiling tests."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (DenseLayer, InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.train.updaters import Adam
+from deeplearning4j_tpu.ui import (FileStatsStorage, InMemoryStatsStorage,
+                                   StatsListener, render_html)
+from deeplearning4j_tpu.utils.profiling import (PerformanceTracker,
+                                                op_profile)
+
+
+def _net():
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-2))
+            .list([DenseLayer(n_out=16, activation="relu"),
+                   OutputLayer(n_out=3, loss="mcxent",
+                               activation="softmax")])
+            .set_input_type(InputType.feed_forward(6)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64):
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 6).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, n)]
+    return x, y
+
+
+def test_stats_listener_collects_norms_and_ratios():
+    st = InMemoryStatsStorage()
+    net = _net().set_listeners(StatsListener(st, frequency=1))
+    x, y = _data()
+    for _ in range(10):
+        net.fit(x, y)
+    assert len(st.score) == 10
+    assert "layer_0" in st.param_norms and "layer_1" in st.param_norms
+    # ratios recorded from the 2nd collection on; healthy magnitude
+    ratios = [r for _, r in st.ratios["layer_0"]]
+    assert len(ratios) == 9
+    assert all(np.isfinite(ratios))
+    assert all(1e-6 < r < 1.0 for r in ratios)
+
+
+def test_file_stats_storage_roundtrip(tmp_path):
+    p = str(tmp_path / "stats.jsonl")
+    st = FileStatsStorage(p)
+    net = _net().set_listeners(StatsListener(st, frequency=2))
+    x, y = _data()
+    for _ in range(6):
+        net.fit(x, y)
+    st.close()
+    loaded = FileStatsStorage.load(p)
+    assert loaded.score == st.score
+    assert loaded.ratios.keys() == st.ratios.keys()
+
+
+def test_render_html(tmp_path):
+    st = InMemoryStatsStorage()
+    net = _net().set_listeners(StatsListener(st, frequency=1))
+    x, y = _data()
+    for _ in range(8):
+        net.fit(x, y)
+    out = str(tmp_path / "report.html")
+    html = render_html(st, out)
+    assert os.path.exists(out)
+    assert "<svg" in html and "Score vs iteration" in html
+    assert "layer_0" in html
+
+
+def test_op_profile_counts_primitives():
+    import jax.numpy as jnp
+
+    def f(x, w):
+        return jnp.tanh(x @ w).sum()
+
+    counts = op_profile(f, np.ones((4, 5), np.float32),
+                        np.ones((5, 3), np.float32))
+    assert counts.get("dot_general", 0) >= 1
+    assert counts.get("tanh", 0) == 1
+
+
+def test_performance_tracker():
+    import jax.numpy as jnp
+    tr = PerformanceTracker()
+    x = jnp.ones((128, 128))
+    for _ in range(3):
+        with tr.step() as done:
+            done(x @ x)
+    assert len(tr.steps) == 3
+    assert tr.mean_step_time() > 0
+    assert tr.throughput(128) > 0
+    assert "3 steps" in tr.summary()
