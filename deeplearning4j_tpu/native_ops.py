@@ -152,6 +152,11 @@ def gather_indexed(base: np.ndarray, indices: np.ndarray) -> np.ndarray:
     when available — the staging-buffer role)."""
     base = np.ascontiguousarray(base)
     indices = np.ascontiguousarray(np.asarray(indices, np.int64))
+    # validate before touching the native path: the C kernel memcpys blindly,
+    # so an out-of-range index would be UB there (the numpy fallback raises)
+    if indices.size and (indices.min() < 0 or indices.max() >= base.shape[0]):
+        raise IndexError(
+            f"gather_indexed: indices out of range [0, {base.shape[0]})")
     out = np.empty((indices.size,) + base.shape[1:], base.dtype)
     lib = _load()
     if lib is not None and base.ndim >= 1:

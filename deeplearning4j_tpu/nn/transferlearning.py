@@ -18,6 +18,8 @@ import copy
 import dataclasses
 from typing import List, Optional
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet
@@ -124,8 +126,14 @@ class TransferLearning:
                 old = old_names[i]
                 new = conf.layer_name(i)
                 if old in self._net.params_:
-                    net.params_[new] = self._net.params_[old]
-                    net.state_[new] = self._net.state_[old]
+                    # deep-copy leaves: the jitted train step donates its
+                    # param buffers, so sharing arrays between the source and
+                    # derived networks would delete the source's buffers on
+                    # the derived net's first fit()
+                    net.params_[new] = jax.tree_util.tree_map(
+                        jnp.copy, self._net.params_[old])
+                    net.state_[new] = jax.tree_util.tree_map(
+                        jnp.copy, self._net.state_[old])
             return net
 
     @staticmethod
@@ -157,8 +165,11 @@ class TransferLearningHelper:
         for j in range(len(suffix_conf.layers)):
             old = net.conf.layer_name(self._boundary + j)
             new = suffix_conf.layer_name(j)
-            self.unfrozen_net.params_[new] = net.params_[old]
-            self.unfrozen_net.state_[new] = net.state_[old]
+            # copy leaves — donated buffers must not be shared across nets
+            self.unfrozen_net.params_[new] = jax.tree_util.tree_map(
+                jnp.copy, net.params_[old])
+            self.unfrozen_net.state_[new] = jax.tree_util.tree_map(
+                jnp.copy, net.state_[old])
 
     def featurize(self, ds: DataSet) -> DataSet:
         """Run the frozen prefix once (reference `featurize`)."""
@@ -187,6 +198,8 @@ class TransferLearningHelper:
         for j in range(len(self.unfrozen_net.conf.layers)):
             old = self.full_net.conf.layer_name(self._boundary + j)
             new = self.unfrozen_net.conf.layer_name(j)
-            self.full_net.params_[old] = self.unfrozen_net.params_[new]
-            self.full_net.state_[old] = self.unfrozen_net.state_[new]
+            self.full_net.params_[old] = jax.tree_util.tree_map(
+                jnp.copy, self.unfrozen_net.params_[new])
+            self.full_net.state_[old] = jax.tree_util.tree_map(
+                jnp.copy, self.unfrozen_net.state_[new])
         return self.full_net

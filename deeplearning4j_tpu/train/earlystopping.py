@@ -159,7 +159,10 @@ class InMemoryModelSaver:
         if self._best is None:
             return None
         model = self._best_model_ref
-        model.params_, model.state_, model.opt_state_ = self._best
+        # install a copy: a later fit() on the returned model donates its
+        # buffers, which would otherwise destroy the stored best snapshot
+        model.params_, model.state_, model.opt_state_ = copy.deepcopy(
+            self._best)
         return model
 
 

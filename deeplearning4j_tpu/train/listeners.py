@@ -137,7 +137,9 @@ class CheckpointListener(TrainingListener):
             self._save(model, f"iter_{iteration}")
 
     def on_epoch_end(self, model):
-        if self.every_n_epochs and (model.epoch + 1) % self.every_n_epochs == 0:
+        # model.epoch is already the count of completed epochs here (fit()
+        # increments it before firing on_epoch_end)
+        if self.every_n_epochs and model.epoch % self.every_n_epochs == 0:
             self._save(model, f"epoch_{model.epoch}")
 
     def last_checkpoint(self) -> Optional[str]:

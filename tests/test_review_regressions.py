@@ -80,3 +80,96 @@ def test_labels_mask_threaded_from_dataset():
     ds = DataSet(x, y, labels_mask=lmask)
     net.fit(ListDataSetIterator([ds]))  # must run with mask threading
     assert np.isfinite(net.score())
+
+
+# ---- round 2: ADVICE.md findings ----
+
+def _tiny_net(seed=0):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .list([DenseLayer(n_out=8, activation="relu"),
+                   OutputLayer(n_out=2, loss="mcxent", activation="softmax")])
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _xy(n=16, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)]
+    return x, y
+
+
+def test_transfer_learning_does_not_alias_donated_buffers():
+    """ADVICE r1 (medium): fit() on the derived net must not delete the
+    source net's buffers via donation."""
+    from deeplearning4j_tpu.nn.transferlearning import (TransferLearning,
+                                                        TransferLearningHelper)
+    x, y = _xy()
+    src = _tiny_net()
+    src.fit(x, y)
+    derived = TransferLearning.builder(src).set_feature_extractor(0).build()
+    derived.fit(x, y)
+    out = np.asarray(src.output(x))          # must not raise "deleted"
+    assert np.all(np.isfinite(out))
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    helper = TransferLearningHelper(src, frozen_till=0)
+    feat = helper.featurize(DataSet(x, y))
+    helper.fit_featurized(feat)              # donates unfrozen-net buffers
+    out2 = np.asarray(src.output(x))         # source must stay intact
+    assert np.all(np.isfinite(out2))
+
+
+def test_inmemory_saver_best_survives_later_fit():
+    """ADVICE r1: restoring best then fitting must not destroy the stored
+    snapshot for subsequent restores."""
+    from deeplearning4j_tpu.train.earlystopping import InMemoryModelSaver
+    x, y = _xy()
+    net = _tiny_net()
+    net.fit(x, y)
+    saver = InMemoryModelSaver()
+    saver.save_best_model(net)
+    best_params = np.asarray(saver._best[0]["layer_0"]["W"]).copy()
+    m = saver.get_best_model()
+    m.fit(x, y)                               # donates the restored buffers
+    m2 = saver.get_best_model()               # must still restore cleanly
+    np.testing.assert_allclose(
+        np.asarray(m2.params_["layer_0"]["W"]), best_params)
+
+
+def test_checkpoint_listener_epoch_cadence(tmp_path):
+    """ADVICE r1: every_n_epochs=2 fires after epochs 2,4,... not 1,3."""
+    from deeplearning4j_tpu.train.listeners import CheckpointListener
+
+    class FakeModel:
+        epoch = 0
+        iteration = 0
+
+        def save(self, path):
+            with open(path, "w") as f:
+                f.write("x")
+
+    lst = CheckpointListener(str(tmp_path), every_n_epochs=2)
+    m = FakeModel()
+    fired = []
+    for ep in range(1, 5):
+        m.epoch = ep                          # completed epochs count
+        before = len(lst._saved)
+        lst.on_epoch_end(m)
+        if len(lst._saved) > before:
+            fired.append(ep)
+    assert fired == [2, 4]
+
+
+def test_gather_indexed_rejects_out_of_range():
+    """ADVICE r1: native path must validate indices, not memcpy OOB."""
+    from deeplearning4j_tpu.native_ops import gather_indexed
+    base = np.arange(12, dtype=np.float32).reshape(4, 3)
+    np.testing.assert_array_equal(gather_indexed(base, [2, 0]),
+                                  base[[2, 0]])
+    for bad in ([-1], [4], [0, 100]):
+        try:
+            gather_indexed(base, bad)
+            assert False, f"expected IndexError for {bad}"
+        except IndexError:
+            pass
