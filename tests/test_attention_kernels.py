@@ -143,3 +143,37 @@ def test_ring_attention_differentiable():
     for a, b in zip(grads, ref_grads):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-4)
+
+
+def test_flash_bwd_kernel_interpret_matches_reference():
+    """Pallas backward kernels (dq/dkv) vs jax.grad of the naive reference."""
+    from deeplearning4j_tpu.ops.attention_kernels import flash_attention_bwd_tpu
+    for causal in (False, True):
+        q, k, v = _qkv(B=1, H=2, T=256, D=64)
+        g = jnp.asarray(np.random.RandomState(7).randn(*q.shape)
+                        .astype(np.float32) * 0.3)
+        out, lse = flash_attention_tpu(q, k, v, causal=causal, block_q=128,
+                                       block_k=128, interpret=True,
+                                       return_lse=True)
+        dq, dk, dv = flash_attention_bwd_tpu(q, k, v, out, lse, g,
+                                             causal=causal, block_q=128,
+                                             block_k=128, interpret=True)
+
+        def loss(q_, k_, v_):
+            return jnp.sum(mha_reference(q_, k_, v_, causal=causal) * g)
+
+        rdq, rdk, rdv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        for a, b in ((dq, rdq), (dk, rdk), (dv, rdv)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
+
+def test_flash_lse_matches_reference():
+    q, k, v = _qkv(B=1, H=1, T=256, D=64)
+    _, lse = flash_attention_tpu(q, k, v, block_q=128, block_k=128,
+                                 interpret=True, return_lse=True)
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    ref_lse = jax.scipy.special.logsumexp(scores, axis=-1).reshape(1, 256)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                               rtol=1e-5, atol=1e-5)
