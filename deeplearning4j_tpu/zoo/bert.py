@@ -226,7 +226,10 @@ class BertModel:
                 ids.astype(jnp.int32), input_mask, labels)
         self._score = loss
         self.iteration += 1
-        return float(loss)
+        # return the device-side loss WITHOUT forcing a D2H sync: a per-step
+        # float() round-trip stalls the dispatch pipeline (measured 2x step
+        # time on v5e via the remote tunnel); score() materializes lazily
+        return loss
 
     def score(self) -> float:
         s = getattr(self, "_score", None)
