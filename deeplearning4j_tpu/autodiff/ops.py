@@ -61,6 +61,15 @@ register_op("round", jnp.round)
 register_op("clip", lambda a, lo=None, hi=None: jnp.clip(a, lo, hi))
 register_op("maximum", jnp.maximum)
 register_op("minimum", jnp.minimum)
+register_op("less", lambda a, b: a < b)
+register_op("less_equal", lambda a, b: a <= b)
+register_op("greater", lambda a, b: a > b)
+register_op("greater_equal", lambda a, b: a >= b)
+register_op("equal", lambda a, b: a == b)
+register_op("not_equal", lambda a, b: a != b)
+register_op("logical_and", jnp.logical_and)
+register_op("logical_or", jnp.logical_or)
+register_op("logical_not", jnp.logical_not)
 
 # ---- trig / hyperbolic ----
 for n in ["sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh",
@@ -289,3 +298,9 @@ def _cos_dist(labels, preds, axis=-1, eps=1e-8):
     pn = preds / jnp.maximum(jnp.linalg.norm(preds, axis=axis,
                                              keepdims=True), eps)
     return jnp.mean(1.0 - jnp.sum(ln * pn, axis=axis))
+
+
+# ---- control-flow support ----
+# Multi-output control-flow nodes (cond/while_loop/scan) cache a Python
+# tuple; tuple_get projects one element out at trace time (free under XLA).
+register_op("tuple_get", lambda t, index: t[index])

@@ -9,8 +9,12 @@ op-by-op in Java with a JNI crossing per op and hand-built `doDiff` gradient
 graphs; here the declared graph is *traced into one jax function*, `jax.jit`
 compiles the entire training step to a single XLA executable, and autodiff is
 `jax.grad` — no per-op gradient rules, no interpreter.  Control-flow ops
-(Enter/Exit/Switch/Merge frames) are replaced by `lax.cond`/`lax.scan` via
-`SameDiff.cond`/`SameDiff.scan`.
+(Enter/Exit/Switch/Merge/NextIteration frames) are replaced by structured
+`lax.cond`/`lax.while_loop`/`lax.scan` via `SameDiff.cond`/
+`SameDiff.while_loop`/`SameDiff.scan`: each body is traced into a
+serializable child graph (`_SubGraph`), so control flow survives save/load
+and differentiates through `jax.grad` (cond and scan; while is fwd-only,
+as lax defines).
 
 Serialization replaces FlatBuffers with a zip of graph-JSON + raw tensors
 (same zip discipline as utils.serialization).
@@ -259,6 +263,131 @@ class TrainingConfig:
 
 
 # ---------------------------------------------------------------------------
+# Control flow (reference: Switch/Merge/Enter/Exit/NextIteration frames in
+# `org/nd4j/autodiff/samediff/internal/AbstractSession.java`; here each body
+# is traced into a child graph and lowered to lax.cond/while_loop/scan —
+# SURVEY.md §3.2's "frames → structured lax control flow" inversion)
+# ---------------------------------------------------------------------------
+
+_CONTROL_FLOW_OPS = ("cond", "while_loop", "scan")
+
+
+class _SubGraph:
+    """A traced sub-function: its own node set + constants, positional
+    placeholder args, named outputs.  Serializes to plain JSON so
+    control-flow nodes survive SameDiff.save/load."""
+
+    def __init__(self, sd: "SameDiff", arg_names: List[str],
+                 out_names: List[str]):
+        self.sd = sd
+        self.arg_names = arg_names
+        self.out_names = out_names
+
+    @staticmethod
+    def trace(fn: Callable, n_args: int) -> "_SubGraph":
+        child = SameDiff()
+        phs = [child.placeholder(f"__arg{i}__") for i in range(n_args)]
+        outs = fn(child, *phs)
+        if isinstance(outs, SDVariable):
+            outs = (outs,)
+        out_names = []
+        for o in outs:
+            if not isinstance(o, SDVariable) or o.sd is not child:
+                raise ValueError(
+                    "control-flow body must return SDVariable(s) built in "
+                    "the scope it was handed (fn(scope, *args) -> vars)")
+            out_names.append(o.name)
+        if child.variables_:
+            raise ValueError(
+                "control-flow bodies cannot declare trainable variables — "
+                "declare them in the outer graph and pass as operands")
+        return _SubGraph(child, [p.name for p in phs], out_names)
+
+    def call(self, args: Sequence[Any]) -> Tuple[Any, ...]:
+        feeds = dict(zip(self.arg_names, args))
+        outs = self.sd._eval_graph(feeds, {}, self.out_names)
+        return tuple(outs[n] for n in self.out_names)
+
+    def to_json(self) -> dict:
+        consts = {}
+        for k, v in self.sd._constants.items():
+            a = np.asarray(v)
+            consts[k] = {"data": a.tolist(), "dtype": str(a.dtype),
+                         "shape": list(a.shape)}
+        return {"nodes": [dataclasses.asdict(n)
+                          for n in self.sd._nodes.values()],
+                "constants": consts,
+                "args": self.arg_names, "outputs": self.out_names}
+
+    @staticmethod
+    def from_json(d: dict) -> "_SubGraph":
+        child = SameDiff()
+        for nd in d["nodes"]:
+            node = Node(name=nd["name"], kind=nd["kind"], op=nd.get("op"),
+                        inputs=tuple(nd["inputs"]),
+                        attrs=_detuple_attrs(nd.get("attrs", {})),
+                        shape=None if nd.get("shape") is None
+                        else tuple(nd["shape"]),
+                        dtype=nd.get("dtype", "float32"))
+            child._nodes[node.name] = node
+        child._constants = {
+            k: jnp.asarray(np.array(v["data"], dtype=v["dtype"])
+                           .reshape(v["shape"]))
+            for k, v in d["constants"].items()}
+        return _SubGraph(child, list(d["args"]), list(d["outputs"]))
+
+
+def _eval_control_flow(node: "Node", args: List[Any]) -> Any:
+    """Lower a control-flow node to the matching lax primitive.  Runs at
+    trace time only (inside jit), so re-hydrating subgraphs from their JSON
+    attrs costs nothing at execution time."""
+    a = node.attrs
+    if node.op == "cond":
+        tg = _SubGraph.from_json(a["true_graph"])
+        fg = _SubGraph.from_json(a["false_graph"])
+        pred, operands = args[0], tuple(args[1:])
+        pred = jnp.reshape(jnp.asarray(pred), ()).astype(bool)
+        # lax.cond requires identical output types; promote pairwise so a
+        # weakly-typed constant in one branch doesn't poison the node.
+        t_shape = jax.eval_shape(tg.call, operands)
+        f_shape = jax.eval_shape(fg.call, operands)
+        dts = [jnp.promote_types(t.dtype, f.dtype)
+               for t, f in zip(t_shape, f_shape)]
+        out = jax.lax.cond(
+            pred,
+            lambda ops: tuple(o.astype(d)
+                              for o, d in zip(tg.call(ops), dts)),
+            lambda ops: tuple(o.astype(d)
+                              for o, d in zip(fg.call(ops), dts)),
+            operands)
+        return out[0] if len(out) == 1 else tuple(out)
+    if node.op == "while_loop":
+        cg = _SubGraph.from_json(a["cond_graph"])
+        bg = _SubGraph.from_json(a["body_graph"])
+        init = tuple(jnp.asarray(x) for x in args)
+        dts = [x.dtype for x in init]     # body must preserve state types
+        state = jax.lax.while_loop(
+            lambda s: jnp.reshape(cg.call(s)[0], ()).astype(bool),
+            lambda s: tuple(o.astype(d) for o, d in zip(bg.call(s), dts)),
+            init)
+        return state[0] if len(state) == 1 else tuple(state)
+    if node.op == "scan":
+        bg = _SubGraph.from_json(a["body_graph"])
+        n_carry = int(a["n_carry"])
+        consts = tuple(args[n_carry + 1:])
+
+        def body(carry, x):
+            outs = bg.call(tuple(carry) + (x,) + consts)
+            new_carry = tuple(o.astype(c.dtype)
+                              for o, c in zip(outs[:n_carry], carry))
+            return new_carry, tuple(outs[n_carry:])
+
+        carry, ys = jax.lax.scan(body, tuple(args[:n_carry]), args[n_carry])
+        return tuple(carry) + tuple(ys)
+    raise KeyError(node.op)
+
+
+# ---------------------------------------------------------------------------
 # SameDiff
 # ---------------------------------------------------------------------------
 
@@ -351,7 +480,7 @@ class SameDiff:
 
     def op(self, opname: str, *inputs, name: Optional[str] = None,
            **attrs) -> SDVariable:
-        if opname not in OP_TABLE:
+        if opname not in OP_TABLE and opname not in _CONTROL_FLOW_OPS:
             raise KeyError(
                 f"Unmapped op '{opname}' — the reference raises the same "
                 "named error from ImportGraph/OpMappingRegistry; register "
@@ -359,6 +488,11 @@ class SameDiff:
         ins = []
         for x in inputs:
             if isinstance(x, SDVariable):
+                if x.sd is not self:
+                    raise ValueError(
+                        f"'{x.name}' belongs to a different SameDiff scope "
+                        "(reference: cross-frame use needs Enter; here, pass "
+                        "it as an operand to the control-flow op instead)")
                 ins.append(x.name)
             else:
                 ins.append(self.constant(None, x).name)
@@ -390,6 +524,81 @@ class SameDiff:
         if RNG_FEED not in self._nodes:
             self._add(Node(RNG_FEED, "placeholder", dtype="uint32"))
         return SDVariable(self, RNG_FEED)
+
+    # ---- control flow (reference Switch/Merge/Enter/Exit → lax) ----
+    def _split_outputs(self, v: SDVariable, n_out: int):
+        if n_out == 1:
+            return v
+        return tuple(self.op("tuple_get", v, index=i) for i in range(n_out))
+
+    def cond(self, pred, true_fn: Callable, false_fn: Callable,
+             *operands, name: Optional[str] = None):
+        """`sd.cond(pred, lambda s, x: ..., lambda s, x: ..., x)` →
+        lax.cond.  Each branch fn receives a fresh scope plus one SDVariable
+        per operand and returns the same number of outputs as the other
+        branch.  Differentiable (reference: Switch/Merge frames in
+        AbstractSession.java had no gradient support at all)."""
+        n = len(operands)
+        tg = _SubGraph.trace(true_fn, n)
+        fg = _SubGraph.trace(false_fn, n)
+        if len(tg.out_names) != len(fg.out_names):
+            raise ValueError(
+                f"cond branches disagree on output arity "
+                f"({len(tg.out_names)} vs {len(fg.out_names)})")
+        v = self.op("cond", pred, *operands, name=name,
+                    true_graph=tg.to_json(), false_graph=fg.to_json(),
+                    n_out=len(tg.out_names))
+        return self._split_outputs(v, len(tg.out_names))
+
+    def while_loop(self, cond_fn: Callable, body_fn: Callable,
+                   *init, name: Optional[str] = None):
+        """`sd.while_loop(lambda s, i, acc: ..., lambda s, i, acc: (...), i0,
+        acc0)` → lax.while_loop.  `cond_fn` returns one scalar-bool output;
+        `body_fn` returns one output per loop-state operand.  Forward-only
+        (lax.while_loop is not reverse-differentiable; use scan for trainable
+        recurrences — same restriction the reference's While frames had in
+        practice)."""
+        n = len(init)
+        cg = _SubGraph.trace(cond_fn, n)
+        if len(cg.out_names) != 1:
+            raise ValueError("while_loop cond_fn must return exactly one "
+                             "(scalar bool) output")
+        bg = _SubGraph.trace(body_fn, n)
+        if len(bg.out_names) != n:
+            raise ValueError(
+                f"while_loop body_fn must return {n} outputs (one per loop "
+                f"state operand), got {len(bg.out_names)}")
+        v = self.op("while_loop", *init, name=name,
+                    cond_graph=cg.to_json(), body_graph=bg.to_json())
+        return self._split_outputs(v, n)
+
+    def scan(self, body_fn: Callable, init, xs, *, consts=(),
+             name: Optional[str] = None):
+        """`sd.scan(lambda s, carry..., x, *consts: (new_carry..., y...),
+        init, xs, consts=(w, ...))` → lax.scan over the leading axis of
+        `xs`.  `consts` are loop-invariant operands (weights etc.) handed to
+        every step — the closure-free substitute for the reference frames'
+        Enter-as-constant edges.  Returns `(final_carry, ys)` where `ys` are
+        the per-step outputs stacked on a new leading axis.  Fully
+        differentiable — this is the structured replacement for the
+        reference's NextIteration/loop frames."""
+        carry = tuple(init) if isinstance(init, (tuple, list)) else (init,)
+        n_carry = len(carry)
+        consts = tuple(consts)
+        bg = _SubGraph.trace(body_fn, n_carry + 1 + len(consts))
+        n_ys = len(bg.out_names) - n_carry
+        if n_ys < 1:
+            raise ValueError(
+                f"scan body_fn must return the {n_carry} new carry value(s) "
+                "plus at least one per-step output")
+        v = self.op("scan", *carry, xs, *consts, name=name,
+                    body_graph=bg.to_json(), n_carry=n_carry,
+                    n_consts=len(consts))
+        parts = self._split_outputs(v, n_carry + n_ys)
+        fc = parts[:n_carry]
+        ys = parts[n_carry:]
+        final_carry = fc if isinstance(init, (tuple, list)) else fc[0]
+        return final_carry, (ys[0] if n_ys == 1 else ys)
 
     def set_loss_variables(self, *names):
         self._loss_names = [n.name if isinstance(n, SDVariable) else n
@@ -436,7 +645,10 @@ class SameDiff:
                     stack.extend(pending)
                     continue
                 args = [cache[i] for i in node.inputs]
-                cache[n] = OP_TABLE[node.op](*args, **node.attrs)
+                if node.op in _CONTROL_FLOW_OPS:
+                    cache[n] = _eval_control_flow(node, args)
+                else:
+                    cache[n] = OP_TABLE[node.op](*args, **node.attrs)
                 stack.pop()
 
         return {n: cache[n] for n in names}

@@ -193,3 +193,152 @@ def test_where_and_comparisons():
     r = sd.math.where(sd.math.gt(a, 0.0), a, sd.math.zeros_like(a))
     out = sd.output({}, r)[r.name]
     np.testing.assert_allclose(out, [0., 2., 0.])
+
+
+# ---------------------------------------------------------------------------
+# Control flow (reference: Switch/Merge/Enter/Exit/While frames in
+# internal/AbstractSession.java → sd.cond / sd.while_loop / sd.scan)
+# ---------------------------------------------------------------------------
+
+def test_cond_both_branches():
+    sd = SameDiff.create()
+    x = sd.placeholder("x", (2, 3))
+    p = sd.placeholder("p", ())
+    y = sd.cond(p, lambda s, a: s.op("mul", a, 2.0),
+                lambda s, a: s.op("neg", a), x)
+    xs = np.ones((2, 3), np.float32)
+    np.testing.assert_allclose(y.eval({"x": xs, "p": 1.0}), 2 * xs)
+    np.testing.assert_allclose(y.eval({"x": xs, "p": 0.0}), -xs)
+
+
+def test_cond_multi_output_and_gradient():
+    sd = SameDiff.create()
+    x = sd.placeholder("x", (4,))
+    w = sd.var("w", np.array([1., 2., 3., 4.], np.float32))
+    p = sd.placeholder("p", ())
+    a, b = sd.cond(
+        p,
+        lambda s, xx, ww: (s.op("mul", xx, ww), s.op("add", xx, ww)),
+        lambda s, xx, ww: (s.op("add", xx, ww), s.op("mul", xx, ww)),
+        x, w)
+    loss = sd.op("sum", a, name="loss")
+    sd.set_loss_variables("loss")
+    xs = np.full(4, 2.0, np.float32)
+    # true branch: d(sum(x*w))/dw = x
+    g = sd.calculate_gradients({"x": xs, "p": 1.0}, "w")
+    np.testing.assert_allclose(g["w"], xs)
+    # false branch: d(sum(x+w))/dw = 1
+    g = sd.calculate_gradients({"x": xs, "p": 0.0}, "w")
+    np.testing.assert_allclose(g["w"], np.ones(4))
+
+
+def test_while_loop_accumulates():
+    sd = SameDiff.create()
+    i0 = sd.placeholder("i0", ())
+    acc0 = sd.placeholder("acc0", ())
+    _, acc = sd.while_loop(
+        lambda s, i, acc: s.op("less", i, 5.0),
+        lambda s, i, acc: (s.op("add", i, 1.0), s.op("add", acc, i)),
+        i0, acc0)
+    r = acc.eval({"i0": np.float32(0), "acc0": np.float32(0)})
+    assert float(r) == 10.0          # 0+1+2+3+4
+
+
+def test_scan_rnn_matches_unrolled():
+    """The VERDICT #5 acceptance test: a scan-built RNN agrees (values and
+    gradients) with the same recurrence unrolled op-by-op."""
+    rng = np.random.default_rng(0)
+    B, T, F, H = 3, 4, 5, 2
+    xs_np = rng.standard_normal((T, B, F)).astype(np.float32)
+    w_np = rng.standard_normal((F, H)).astype(np.float32) * 0.3
+    rw_np = rng.standard_normal((H, H)).astype(np.float32) * 0.3
+
+    def build(scan: bool):
+        sd = SameDiff.create()
+        xs = sd.placeholder("xs", (T, B, F))
+        w = sd.var("w", w_np)
+        rw = sd.var("rw", rw_np)
+        h0 = sd.constant("h0", np.zeros((B, H), np.float32))
+        if scan:
+            h, _ = sd.scan(
+                lambda s, h, x, wv, rwv: (
+                    s.op("tanh", s.op("add", s.op("matmul", x, wv),
+                                      s.op("matmul", h, rwv))),) * 2,
+                h0, xs, consts=(w, rw))
+        else:
+            h = h0
+            for t in range(T):
+                xt = sd.op("squeeze", sd.op("slice", xs, begin=[t, 0, 0],
+                                            size=[1, B, F]), axis=0)
+                h = sd.op("tanh", sd.op("add", sd.op("matmul", xt, w),
+                                        sd.op("matmul", h, rw)))
+        sd.op("sum", sd.op("square", h), name="loss")
+        sd.set_loss_variables("loss")
+        return sd
+
+    sd_scan, sd_unroll = build(True), build(False)
+    feeds = {"xs": xs_np}
+    v1 = sd_scan.output(feeds, "loss")["loss"]
+    v2 = sd_unroll.output(feeds, "loss")["loss"]
+    np.testing.assert_allclose(v1, v2, rtol=1e-5)
+    g1 = sd_scan.calculate_gradients(feeds, "w", "rw")
+    g2 = sd_unroll.calculate_gradients(feeds, "w", "rw")
+    np.testing.assert_allclose(g1["w"], g2["w"], rtol=1e-4)
+    np.testing.assert_allclose(g1["rw"], g2["rw"], rtol=1e-4)
+
+
+def test_control_flow_save_load_roundtrip(tmp_path):
+    sd = SameDiff.create()
+    x = sd.placeholder("x", (3,))
+    p = sd.placeholder("p", ())
+    c = sd.cond(p, lambda s, a: s.op("mul", a, 3.0),
+                lambda s, a: s.op("sub", a, 1.0), x, name="branch")
+    cf, ys = sd.scan(lambda s, carry, step: (s.op("add", carry, step),) * 2,
+                     sd.constant("z", np.float32(0)), x)
+    path = str(tmp_path / "cf.sdz")
+    sd.save(path)
+    sd2 = SameDiff.load(path)
+    xs = np.array([1., 2., 3.], np.float32)
+    for feeds in ({"x": xs, "p": 1.0}, {"x": xs, "p": 0.0}):
+        a = sd.output(feeds, "branch")["branch"]
+        b = sd2.output(feeds, "branch")["branch"]
+        np.testing.assert_allclose(a, b)
+    np.testing.assert_allclose(sd2.output({"x": xs}, cf.name)[cf.name], 6.0)
+
+
+def test_scan_training_decreases_loss():
+    """Train the scan-RNN end-to-end: gradients flow through lax.scan."""
+    rng = np.random.default_rng(1)
+    B, T, F, H = 8, 6, 4, 3
+    xs_np = rng.standard_normal((T, B, F)).astype(np.float32)
+    y_np = rng.standard_normal((B, H)).astype(np.float32)
+    sd = SameDiff.create()
+    xs = sd.placeholder("xs", (T, B, F))
+    lab = sd.placeholder("lab", (B, H))
+    w = sd.var("w", "XAVIER", F, H)
+    rw = sd.var("rw", "XAVIER", H, H)
+    h0 = sd.constant("h0", np.zeros((B, H), np.float32))
+    h, _ = sd.scan(
+        lambda s, h, x, wv, rwv: (
+            s.op("tanh", s.op("add", s.op("matmul", x, wv),
+                              s.op("matmul", h, rwv))),) * 2,
+        h0, xs, consts=(w, rw))
+    sd.loss.mean_squared_error(lab, h, name="loss")
+    sd.set_loss_variables("loss")
+    sd.set_training_config(TrainingConfig(
+        updater=Adam(0.05), data_set_feature_mapping=["xs"],
+        data_set_label_mapping=["lab"]))
+    sd.fit(xs_np, y_np)
+    first = sd.score()
+    for _ in range(30):
+        sd.fit(xs_np, y_np)
+    assert sd.score() < first * 0.5
+
+
+def test_cross_scope_variable_rejected():
+    sd = SameDiff.create()
+    x = sd.placeholder("x", (3,))
+    w = sd.var("w", np.ones(3, np.float32))
+    with pytest.raises(ValueError, match="different SameDiff scope"):
+        sd.cond(1.0, lambda s, a: s.op("mul", a, w),
+                lambda s, a: a, x)
