@@ -304,3 +304,115 @@ def _cos_dist(labels, preds, axis=-1, eps=1e-8):
 # Multi-output control-flow nodes (cond/while_loop/scan) cache a Python
 # tuple; tuple_get projects one element out at trace time (free under XLA).
 register_op("tuple_get", lambda t, index: t[index])
+
+
+# ---------------------------------------------------------------------------
+# ONNX-layout ops (NCHW / OIHW — used by modelimport.onnx_import; the
+# reference's equivalent lives in samediff-import-onnx's op mappers).
+# XLA is layout-agnostic on TPU, so keeping the imported graph in its
+# native NCHW avoids transpose chatter at every boundary.
+# ---------------------------------------------------------------------------
+
+@register_op("conv2d_nchw")
+def _conv2d_nchw(x, w, b=None, stride=(1, 1), pads=(0, 0, 0, 0),
+                 dilation=(1, 1), groups=1):
+    out = lax.conv_general_dilated(
+        x, w, window_strides=tuple(stride),
+        padding=((pads[0], pads[2]), (pads[1], pads[3])),
+        rhs_dilation=tuple(dilation),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups)
+    if b is not None:
+        out = out + b.reshape(1, -1, 1, 1)
+    return out
+
+
+@register_op("max_pool2d_nchw")
+def _max_pool2d_nchw(x, kernel=(2, 2), stride=(2, 2), pads=(0, 0, 0, 0)):
+    return lax.reduce_window(
+        x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+        else jnp.iinfo(x.dtype).min,
+        lax.max, (1, 1) + tuple(kernel), (1, 1) + tuple(stride),
+        ((0, 0), (0, 0), (pads[0], pads[2]), (pads[1], pads[3])))
+
+
+@register_op("avg_pool2d_nchw")
+def _avg_pool2d_nchw(x, kernel=(2, 2), stride=(2, 2), pads=(0, 0, 0, 0),
+                     count_include_pad=False):
+    dims = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    padding = ((0, 0), (0, 0), (pads[0], pads[2]), (pads[1], pads[3]))
+    s = lax.reduce_window(x, jnp.zeros((), x.dtype), lax.add, dims, strides,
+                          padding)
+    if count_include_pad or not any(pads):
+        return s / (kernel[0] * kernel[1])
+    cnt = lax.reduce_window(jnp.ones_like(x), jnp.zeros((), x.dtype),
+                            lax.add, dims, strides, padding)
+    return s / cnt
+
+
+register_op("global_avg_pool_nchw",
+            lambda x: jnp.mean(x, axis=(2, 3), keepdims=True))
+
+
+@register_op("reshape_onnx")
+def _reshape_onnx(x, shape):
+    # ONNX Reshape: 0 = copy the input dim at that position, -1 = infer.
+    shp = [x.shape[i] if s == 0 else int(s) for i, s in enumerate(shape)]
+    return jnp.reshape(x, shp)
+
+
+@register_op("flatten2d")
+def _flatten2d(x, axis=1):
+    lead = 1
+    for d in x.shape[:axis]:
+        lead *= d
+    return jnp.reshape(x, (lead, -1))
+
+
+@register_op("gemm")
+def _gemm(a, b, c=None, alpha=1.0, beta=1.0, trans_a=0, trans_b=0):
+    if trans_a:
+        a = a.T
+    if trans_b:
+        b = b.T
+    out = alpha * (a @ b)
+    if c is not None:
+        out = out + beta * c
+    return out
+
+
+@register_op("batch_norm_nchw")
+def _batch_norm_nchw(x, scale, b, mean, var, eps=1e-5):
+    shp = (1, -1) + (1,) * (x.ndim - 2)
+    inv = scale.reshape(shp) * lax.rsqrt(var.reshape(shp) + eps)
+    return (x - mean.reshape(shp)) * inv + b.reshape(shp)
+
+
+@register_op("split_axis")
+def _split_axis(x, sizes, axis=0):
+    points = []
+    acc = 0
+    for s in sizes[:-1]:
+        acc += int(s)
+        points.append(acc)
+    return tuple(jnp.split(x, points, axis=axis))
+
+
+@register_op("slice_onnx")
+def _slice_onnx(x, starts, ends, axes=None, steps=None):
+    axes = list(range(len(starts))) if axes is None else [
+        int(a) % x.ndim for a in axes]
+    steps = [1] * len(starts) if steps is None else [int(s) for s in steps]
+    idx = [slice(None)] * x.ndim
+    for st, en, ax, sp in zip(starts, ends, axes, steps):
+        st, en = int(st), int(en)
+        dim = x.shape[ax]
+        # ONNX clamps INT64_MIN/MAX sentinels to the dim bounds
+        st = max(st + dim, 0) if st < 0 else min(st, dim)
+        if en < 0:
+            en = max(en + dim, -1 if sp < 0 else 0)
+        else:
+            en = min(en, dim)
+        idx[ax] = slice(st, en if en != -1 else None, sp)
+    return x[tuple(idx)]

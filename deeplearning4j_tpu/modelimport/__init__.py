@@ -4,3 +4,5 @@ from deeplearning4j_tpu.modelimport.keras import (  # noqa: F401
     KerasModelImport, UnsupportedKerasConfigurationException)
 from deeplearning4j_tpu.modelimport.tf_import import (  # noqa: F401
     TFImportRegistry, import_graph_def)
+from deeplearning4j_tpu.modelimport.onnx_import import (  # noqa: F401
+    OnnxImportRegistry, UnmappedOnnxOpException, import_onnx_model)

@@ -1,0 +1,468 @@
+"""ONNX -> SameDiff import.
+
+Reference: `nd4j/samediff-import/samediff-import-onnx` — `ImportGraph`
+walks ONNX NodeProtos, an `OpMappingRegistry` maps each op_type to graph
+ops, unmapped ops fail with a NAMED error.  Same registry pattern here,
+targeting `autodiff.SameDiff` (whole imported graph -> one jitted XLA
+executable).  Parsing uses the in-repo `onnx_proto` codec — no `onnx`
+package needed.
+
+Layout policy: imported graphs stay in ONNX's native NCHW/OIHW (the
+`*_nchw` ops in `autodiff.ops`); XLA re-lays-out for the MXU itself, so
+there is no transpose tax and the imported graph remains comparable
+node-for-node with the source model.
+
+Float initializers become *trainable* variables by default, so an imported
+model can be fine-tuned directly via `sd.fit(...)` (the reference's
+import-then-train story).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff import SameDiff
+from deeplearning4j_tpu.modelimport.onnx_proto import (
+    ModelProto, NodeProto, load_model, _np_dtype)
+
+
+class UnmappedOnnxOpException(Exception):
+    pass
+
+
+class OnnxImportRegistry:
+    """ONNX op_type -> mapper(sd, node, ins) -> SDVariable | tuple."""
+
+    _MAP: Dict[str, Callable] = {}
+
+    @classmethod
+    def register(cls, op_type: str, fn: Callable = None):
+        if fn is None:
+            def deco(f):
+                cls._MAP[op_type] = f
+                return f
+            return deco
+        cls._MAP[op_type] = fn
+        return fn
+
+    @classmethod
+    def get(cls, op_type: str) -> Callable:
+        if op_type not in cls._MAP:
+            raise UnmappedOnnxOpException(
+                f"Unmapped ONNX op '{op_type}' — same failure mode as the "
+                "reference's OpMappingRegistry; add via "
+                "OnnxImportRegistry.register")
+        return cls._MAP[op_type]
+
+
+# -- attribute helpers ------------------------------------------------------
+
+def _attrs(node: NodeProto) -> Dict[str, object]:
+    return {a.name: a for a in node.attribute}
+
+
+def _ai(node, name, default=None):
+    a = _attrs(node).get(name)
+    return default if a is None else int(a.i)
+
+
+def _af(node, name, default=None):
+    a = _attrs(node).get(name)
+    return default if a is None else float(a.f)
+
+
+def _aints(node, name, default=None):
+    a = _attrs(node).get(name)
+    return default if a is None else [int(v) for v in a.ints]
+
+
+def _astr(node, name, default=""):
+    a = _attrs(node).get(name)
+    return default if a is None else a.s.decode()
+
+
+def _const_ints(v) -> List[int]:
+    """Read a constant input (initializer/Constant) as a python int list."""
+    return [int(x) for x in np.atleast_1d(np.asarray(v.get_arr()))]
+
+
+R = OnnxImportRegistry.register
+
+# -- elementwise / unary ----------------------------------------------------
+
+for onnx_op, our in [("Relu", "relu"), ("Sigmoid", "sigmoid"),
+                     ("Tanh", "tanh"), ("Erf", "erf"), ("Exp", "exp"),
+                     ("Log", "log"), ("Neg", "neg"), ("Abs", "abs"),
+                     ("Sqrt", "sqrt"), ("Reciprocal", "reciprocal"),
+                     ("Floor", "floor"), ("Ceil", "ceil"),
+                     ("Round", "round"), ("Sign", "sign"),
+                     ("Softplus", "softplus"), ("Softsign", "softsign"),
+                     ("Identity", "identity"), ("Sin", "sin"),
+                     ("Cos", "cos"), ("Not", "logical_not")]:
+    R(onnx_op, (lambda our: lambda sd, n, ins:
+                sd.op(our, ins[0], name=n.output[0]))(our))
+
+for onnx_op, our in [("Add", "add"), ("Sub", "sub"), ("Mul", "mul"),
+                     ("Div", "div"), ("Pow", "pow"),
+                     ("Equal", "equal"), ("Greater", "greater"),
+                     ("Less", "less"), ("And", "logical_and"),
+                     ("Or", "logical_or")]:
+    R(onnx_op, (lambda our: lambda sd, n, ins:
+                sd.op(our, ins[0], ins[1], name=n.output[0]))(our))
+
+
+@R("Gelu")
+def _gelu(sd, n, ins):
+    return sd.op("gelu", ins[0], name=n.output[0])
+
+
+@R("LeakyRelu")
+def _leaky(sd, n, ins):
+    return sd.op("leaky_relu", ins[0], alpha=_af(n, "alpha", 0.01),
+                 name=n.output[0])
+
+
+@R("Elu")
+def _elu(sd, n, ins):
+    return sd.op("elu", ins[0], name=n.output[0])
+
+
+@R("Clip")
+def _clip(sd, n, ins):
+    # opset>=11: min/max as optional inputs; older: attrs
+    lo = hi = None
+    if len(ins) > 1 and ins[1] is not None:
+        lo = float(np.asarray(ins[1].get_arr()))
+    else:
+        lo = _af(n, "min")
+    if len(ins) > 2 and ins[2] is not None:
+        hi = float(np.asarray(ins[2].get_arr()))
+    else:
+        hi = _af(n, "max")
+    return sd.op("clip", ins[0], lo=lo, hi=hi, name=n.output[0])
+
+
+def _variadic(our_op):
+    def fn(sd, n, ins):
+        if len(ins) == 1:     # don't rename the input node itself
+            return sd.op("identity", ins[0], name=n.output[0])
+        out = ins[0]
+        for x in ins[1:]:
+            out = sd.op(our_op, out, x)
+        return sd.rename(out.name, n.output[0])
+    return fn
+
+
+R("Min", _variadic("minimum"))
+R("Max", _variadic("maximum"))
+R("Sum", _variadic("add"))
+
+
+@R("Where")
+def _where(sd, n, ins):
+    return sd.op("where", ins[0], ins[1], ins[2], name=n.output[0])
+
+
+@R("Dropout")
+def _dropout(sd, n, ins):
+    # inference-mode import: identity (reference does the same for frozen
+    # graphs); the optional mask output is not produced
+    return sd.op("identity", ins[0], name=n.output[0])
+
+
+@R("Cast")
+def _cast(sd, n, ins):
+    dt = _np_dtype(_ai(n, "to", 1))
+    return sd.op("cast", ins[0], dtype=np.dtype(dt).name, name=n.output[0])
+
+
+# -- matmul / gemm ----------------------------------------------------------
+
+R("MatMul", lambda sd, n, ins: sd.op("matmul", ins[0], ins[1],
+                                     name=n.output[0]))
+
+
+@R("Gemm")
+def _gemm(sd, n, ins):
+    args = ins if len(ins) > 2 and ins[2] is not None else ins[:2]
+    return sd.op("gemm", *args, alpha=_af(n, "alpha", 1.0),
+                 beta=_af(n, "beta", 1.0), trans_a=_ai(n, "transA", 0),
+                 trans_b=_ai(n, "transB", 0), name=n.output[0])
+
+
+# -- conv / pool / norm -----------------------------------------------------
+
+def _conv_pads(node, n_spatial=2):
+    auto = _astr(node, "auto_pad", "NOTSET")
+    if auto not in ("", "NOTSET", "VALID"):
+        raise UnmappedOnnxOpException(
+            f"auto_pad={auto} unsupported — export with explicit pads "
+            "(torch and tf2onnx both do)")
+    pads = _aints(node, "pads", [0] * (2 * n_spatial))
+    return pads
+
+
+@R("Conv")
+def _conv(sd, n, ins):
+    pads = _conv_pads(n)
+    args = ins if len(ins) > 2 and ins[2] is not None else ins[:2]
+    return sd.op("conv2d_nchw", *args,
+                 stride=tuple(_aints(n, "strides", [1, 1])),
+                 pads=tuple(pads),
+                 dilation=tuple(_aints(n, "dilations", [1, 1])),
+                 groups=_ai(n, "group", 1), name=n.output[0])
+
+
+@R("MaxPool")
+def _maxpool(sd, n, ins):
+    if _ai(n, "ceil_mode", 0):
+        raise UnmappedOnnxOpException("MaxPool ceil_mode=1 unsupported")
+    k = _aints(n, "kernel_shape")
+    return sd.op("max_pool2d_nchw", ins[0], kernel=tuple(k),
+                 stride=tuple(_aints(n, "strides", k)),
+                 pads=tuple(_conv_pads(n)), name=n.output[0])
+
+
+@R("AveragePool")
+def _avgpool(sd, n, ins):
+    if _ai(n, "ceil_mode", 0):
+        raise UnmappedOnnxOpException("AveragePool ceil_mode=1 unsupported")
+    k = _aints(n, "kernel_shape")
+    return sd.op("avg_pool2d_nchw", ins[0], kernel=tuple(k),
+                 stride=tuple(_aints(n, "strides", k)),
+                 pads=tuple(_conv_pads(n)),
+                 count_include_pad=bool(_ai(n, "count_include_pad", 0)),
+                 name=n.output[0])
+
+
+R("GlobalAveragePool", lambda sd, n, ins:
+  sd.op("global_avg_pool_nchw", ins[0], name=n.output[0]))
+
+
+@R("BatchNormalization")
+def _bn(sd, n, ins):
+    # inputs: X, scale, B, input_mean, input_var (inference form)
+    return sd.op("batch_norm_nchw", ins[0], ins[1], ins[2], ins[3], ins[4],
+                 eps=_af(n, "epsilon", 1e-5), name=n.output[0])
+
+
+@R("LayerNormalization")
+def _ln(sd, n, ins):
+    axis = _ai(n, "axis", -1)
+    if axis not in (-1,):
+        raise UnmappedOnnxOpException("LayerNormalization axis != -1 "
+                                      "unsupported")
+    args = ins if len(ins) > 2 and ins[2] is not None else ins[:2]
+    return sd.op("layer_norm", *args, eps=_af(n, "epsilon", 1e-5),
+                 name=n.output[0])
+
+
+# -- shape ops --------------------------------------------------------------
+
+@R("Reshape")
+def _reshape(sd, n, ins):
+    return sd.op("reshape_onnx", ins[0], shape=_const_ints(ins[1]),
+                 name=n.output[0])
+
+
+@R("Flatten")
+def _flatten(sd, n, ins):
+    return sd.op("flatten2d", ins[0], axis=_ai(n, "axis", 1),
+                 name=n.output[0])
+
+
+@R("Transpose")
+def _transpose(sd, n, ins):
+    return sd.op("transpose", ins[0], perm=_aints(n, "perm"),
+                 name=n.output[0])
+
+
+@R("Concat")
+def _concat(sd, n, ins):
+    return sd.op("concat", *ins, axis=_ai(n, "axis", 0), name=n.output[0])
+
+
+@R("Squeeze")
+def _squeeze(sd, n, ins):
+    # opset>=13: axes as input; older: attr
+    if len(ins) > 1 and ins[1] is not None:
+        axes = _const_ints(ins[1])
+    else:
+        axes = _aints(n, "axes")
+    return sd.op("squeeze", ins[0],
+                 axis=None if axes is None else tuple(axes),
+                 name=n.output[0])
+
+
+@R("Unsqueeze")
+def _unsqueeze(sd, n, ins):
+    if len(ins) > 1 and ins[1] is not None:
+        axes = _const_ints(ins[1])
+    else:
+        axes = _aints(n, "axes")
+    out = ins[0]
+    for ax in sorted(axes):
+        out = sd.op("expand_dims", out, axis=ax)
+    return sd.rename(out.name, n.output[0])
+
+
+@R("Slice")
+def _slice(sd, n, ins):
+    if len(ins) > 1 and ins[1] is not None:    # opset>=10: inputs
+        starts = _const_ints(ins[1])
+        ends = _const_ints(ins[2])
+        axes = _const_ints(ins[3]) if len(ins) > 3 and ins[3] is not None \
+            else None
+        steps = _const_ints(ins[4]) if len(ins) > 4 and ins[4] is not None \
+            else None
+    else:                                      # opset<10: attrs
+        starts = _aints(n, "starts")
+        ends = _aints(n, "ends")
+        axes = _aints(n, "axes")
+        steps = None
+    return sd.op("slice_onnx", ins[0], starts=starts, ends=ends, axes=axes,
+                 steps=steps, name=n.output[0])
+
+
+@R("Gather")
+def _gather(sd, n, ins):
+    return sd.op("gather", ins[0], ins[1], axis=_ai(n, "axis", 0),
+                 name=n.output[0])
+
+
+@R("Split")
+def _split(sd, n, ins):
+    axis = _ai(n, "axis", 0)
+    if len(ins) > 1 and ins[1] is not None:    # opset>=13: sizes as input
+        sizes = _const_ints(ins[1])
+    else:
+        sizes = _aints(n, "split")
+    if sizes is None:
+        raise UnmappedOnnxOpException(
+            "Split without explicit sizes needs static input shape — "
+            "export with 'split' sizes")
+    v = sd.op("split_axis", ins[0], sizes=sizes, axis=axis)
+    return tuple(sd.op("tuple_get", v, index=i, name=out)
+                 for i, out in enumerate(n.output))
+
+
+@R("Pad")
+def _pad(sd, n, ins):
+    mode = _astr(n, "mode", "constant")
+    if mode != "constant":
+        raise UnmappedOnnxOpException(f"Pad mode={mode} unsupported")
+    if len(ins) > 1 and ins[1] is not None:    # opset>=11: pads as input
+        pads = _const_ints(ins[1])
+        value = float(np.asarray(ins[2].get_arr())) \
+            if len(ins) > 2 and ins[2] is not None else 0.0
+    else:
+        pads = _aints(n, "pads")
+        value = _af(n, "value", 0.0)
+    rank = len(pads) // 2
+    paddings = [[pads[i], pads[i + rank]] for i in range(rank)]
+    return sd.op("pad", ins[0], paddings=paddings, value=value,
+                 name=n.output[0])
+
+
+# -- reductions / softmax ---------------------------------------------------
+
+def _reduce(our_op):
+    def fn(sd, n, ins):
+        if len(ins) > 1 and ins[1] is not None:  # opset>=18: axes as input
+            axes = _const_ints(ins[1])
+        else:
+            axes = _aints(n, "axes")
+        return sd.op(our_op, ins[0],
+                     axis=None if axes is None else tuple(axes),
+                     keepdims=bool(_ai(n, "keepdims", 1)),
+                     name=n.output[0])
+    return fn
+
+
+R("ReduceMean", _reduce("mean"))
+R("ReduceSum", _reduce("sum"))
+R("ReduceMax", _reduce("max"))
+R("ReduceMin", _reduce("min"))
+R("ReduceProd", _reduce("prod"))
+
+
+@R("Softmax")
+def _softmax(sd, n, ins):
+    return sd.op("softmax", ins[0], axis=_ai(n, "axis", -1),
+                 name=n.output[0])
+
+
+@R("LogSoftmax")
+def _log_softmax(sd, n, ins):
+    return sd.op("log_softmax", ins[0], axis=_ai(n, "axis", -1),
+                 name=n.output[0])
+
+
+@R("ArgMax")
+def _argmax(sd, n, ins):
+    v = sd.op("argmax", ins[0], axis=_ai(n, "axis", 0))
+    if _ai(n, "keepdims", 1):
+        v = sd.op("expand_dims", v, axis=_ai(n, "axis", 0))
+    return sd.rename(v.name, n.output[0])
+
+
+# -- import driver ----------------------------------------------------------
+
+def import_onnx_model(src, trainable: bool = True) -> SameDiff:
+    """Import an ONNX model (path, bytes, or ModelProto) into a SameDiff
+    graph.  Graph inputs -> placeholders; float initializers -> trainable
+    variables (fine-tunable) unless `trainable=False`; other initializers ->
+    constants.  The returned graph records `import_inputs` /
+    `import_outputs` (the ONNX graph's I/O names)."""
+    model = src if isinstance(src, ModelProto) else load_model(src)
+    g = model.graph
+    sd = SameDiff.create()
+    produced = {}
+
+    init_names = set()
+    for t in g.initializer:
+        arr = t.to_array()
+        init_names.add(t.name)
+        if trainable and np.issubdtype(arr.dtype, np.floating):
+            produced[t.name] = sd.var(t.name, np.asarray(arr))
+        else:
+            produced[t.name] = sd.constant(t.name, np.asarray(arr))
+
+    for vi in g.input:
+        if vi.name in produced:
+            continue
+        shape = None if vi.shape is None else tuple(
+            d if d is not None and d > 0 else None for d in vi.shape)
+        produced[vi.name] = sd.placeholder(
+            vi.name, shape=shape, dtype=np.dtype(_np_dtype(vi.elem_type)).name)
+
+    for node in g.node:
+        if node.op_type == "Constant":
+            a = _attrs(node)
+            if "value" in a:
+                produced[node.output[0]] = sd.constant(
+                    node.output[0], a["value"].t.to_array())
+            elif "value_float" in a:
+                produced[node.output[0]] = sd.constant(
+                    node.output[0], np.float32(a["value_float"].f))
+            elif "value_int" in a:
+                produced[node.output[0]] = sd.constant(
+                    node.output[0], np.int64(a["value_int"].i))
+            else:
+                raise UnmappedOnnxOpException(
+                    "Constant node without value/value_float/value_int")
+            continue
+        fn = OnnxImportRegistry.get(node.op_type)
+        ins = [produced[i] if i else None for i in node.input]
+        out = fn(sd, node, ins)
+        outs = out if isinstance(out, tuple) else (out,)
+        for oname, v in zip(node.output, outs):
+            if v.name != oname:
+                v = sd.rename(v.name, oname)
+            produced[oname] = v
+
+    sd.import_inputs = [vi.name for vi in g.input
+                        if vi.name not in init_names]
+    sd.import_outputs = [vi.name for vi in g.output]
+    return sd
