@@ -416,3 +416,33 @@ def _slice_onnx(x, starts, ends, axes=None, steps=None):
             en = min(en, dim)
         idx[ax] = slice(st, en if en != -1 else None, sp)
     return x[tuple(idx)]
+
+
+# ---- TF-import support ops (modelimport.tf_import; BERT-class graphs) ----
+
+register_op("swap_last2", lambda a: jnp.swapaxes(a, -1, -2))
+register_op("split_equal", lambda a, num, axis=0:
+            tuple(jnp.split(a, num, axis=axis)))
+
+
+@register_op("tf_strided_slice")
+def _tf_strided_slice_op(x, begin, end, strides, begin_mask=0, end_mask=0,
+                         ellipsis_mask=0, new_axis_mask=0,
+                         shrink_axis_mask=0):
+    """TF StridedSlice semantics (masks are bitfields over spec positions)."""
+    idx = []
+    for i in range(len(begin)):
+        if (ellipsis_mask >> i) & 1:
+            idx.append(Ellipsis)
+        elif (new_axis_mask >> i) & 1:
+            idx.append(None)
+        elif (shrink_axis_mask >> i) & 1:
+            idx.append(int(begin[i]))
+        else:
+            b = None if (begin_mask >> i) & 1 else int(begin[i])
+            e = None if (end_mask >> i) & 1 else int(end[i])
+            idx.append(slice(b, e, int(strides[i])))
+    return x[tuple(idx)]
+
+
+register_op("floor_div", jnp.floor_divide)   # int-preserving (TF FloorDiv)

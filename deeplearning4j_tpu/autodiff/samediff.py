@@ -422,11 +422,13 @@ class SameDiff:
 
     # ---- naming ----
     def _fresh(self, base: str) -> str:
+        # ':' is illegal in TF/ONNX node names, so auto-generated names can
+        # never collide with names arriving later from a model import
         self._counter += 1
-        name = f"{base}_{self._counter}"
+        name = f"{base}:{self._counter}"
         while name in self._nodes:
             self._counter += 1
-            name = f"{base}_{self._counter}"
+            name = f"{base}:{self._counter}"
         return name
 
     def _add(self, node: Node) -> SDVariable:

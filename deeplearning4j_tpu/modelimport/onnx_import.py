@@ -114,7 +114,9 @@ for onnx_op, our in [("Add", "add"), ("Sub", "sub"), ("Mul", "mul"),
 
 @R("Gelu")
 def _gelu(sd, n, ins):
-    return sd.op("gelu", ins[0], name=n.output[0])
+    approx = _astr(n, "approximate", "none")
+    return sd.op("gelu", ins[0], approximate=(approx == "tanh"),
+                 name=n.output[0])
 
 
 @R("LeakyRelu")

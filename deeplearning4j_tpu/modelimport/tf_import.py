@@ -183,28 +183,217 @@ def _cast(sd, n, ins):
     return sd.op("cast", ins[0], dtype=np.dtype(dt).name, name=n.name)
 
 
+# ---------------------------------------------------------------------------
+# BERT-class graph ops (VERDICT #4: BatchMatMul, GatherV2, StridedSlice,
+# Squeeze, Split, FusedBatchNorm, Erf-GELU patterns — the set a frozen
+# BERT GraphDef needs; reference TFOpMappingRegistry covers the same)
+# ---------------------------------------------------------------------------
+
+def _batch_matmul(sd, n, ins):
+    a, b = ins[0], ins[1]
+    if n.attr["adj_x"].b:
+        a = sd.op("swap_last2", a)
+    if n.attr["adj_y"].b:
+        b = sd.op("swap_last2", b)
+    return sd.op("matmul", a, b, name=n.name)
+
+
+R("BatchMatMul", _batch_matmul)
+R("BatchMatMulV2", _batch_matmul)
+R("BatchMatMulV3", _batch_matmul)
+
+
+@R("GatherV2")
+def _gather_v2(sd, n, ins):
+    axis = int(np.asarray(ins[2].get_arr()))
+    if int(n.attr["batch_dims"].i):
+        raise UnmappedTFOpException("GatherV2 batch_dims != 0 unsupported")
+    return sd.op("gather", ins[0], ins[1], axis=axis, name=n.name)
+
+
+R("Gather", lambda sd, n, ins: sd.op("gather", ins[0], ins[1], axis=0,
+                                     name=n.name))
+
+
+@R("StridedSlice")
+def _tf_strided_slice(sd, n, ins):
+    return sd.op(
+        "tf_strided_slice", ins[0],
+        begin=[int(v) for v in np.asarray(ins[1].get_arr())],
+        end=[int(v) for v in np.asarray(ins[2].get_arr())],
+        strides=[int(v) for v in np.asarray(ins[3].get_arr())],
+        begin_mask=int(n.attr["begin_mask"].i),
+        end_mask=int(n.attr["end_mask"].i),
+        ellipsis_mask=int(n.attr["ellipsis_mask"].i),
+        new_axis_mask=int(n.attr["new_axis_mask"].i),
+        shrink_axis_mask=int(n.attr["shrink_axis_mask"].i),
+        name=n.name)
+
+
+@R("Squeeze")
+def _squeeze(sd, n, ins):
+    dims = [int(d) for d in n.attr["squeeze_dims"].list.i]
+    return sd.op("squeeze", ins[0], axis=tuple(dims) if dims else None,
+                 name=n.name)
+
+
+@R("Split")
+def _split(sd, n, ins):
+    # inputs: (axis, value); attr num_split — equal split
+    axis = int(np.asarray(ins[0].get_arr()))
+    num = int(n.attr["num_split"].i)
+    v = sd.op("split_equal", ins[1], num=num, axis=axis)
+    return tuple(sd.op("tuple_get", v, index=i,
+                       name=n.name if i == 0 else f"{n.name}_{i}")
+                 for i in range(num))
+
+
+@R("SplitV")
+def _split_v(sd, n, ins):
+    sizes = [int(s) for s in np.asarray(ins[1].get_arr())]
+    axis = int(np.asarray(ins[2].get_arr()))
+    v = sd.op("split_axis", ins[0], sizes=sizes, axis=axis)
+    return tuple(sd.op("tuple_get", v, index=i,
+                       name=n.name if i == 0 else f"{n.name}_{i}")
+                 for i in range(len(sizes)))
+
+
+def _fused_bn(sd, n, ins):
+    # inputs: x, scale, offset, mean, variance (inference); NHWC layout —
+    # params broadcast over the last axis, so plain batch_norm works
+    if n.attr["is_training"].b:
+        raise UnmappedTFOpException(
+            "FusedBatchNorm is_training=true unsupported (freeze first)")
+    if n.attr["data_format"].s not in (b"", b"NHWC"):
+        raise UnmappedTFOpException("FusedBatchNorm: only NHWC supported")
+    eps = float(n.attr["epsilon"].f) if "epsilon" in n.attr else 1e-4
+    return sd.op("batch_norm", ins[0], ins[3], ins[4], ins[1], ins[2],
+                 eps=eps, name=n.name)
+
+
+R("FusedBatchNorm", _fused_bn)
+R("FusedBatchNormV2", _fused_bn)
+R("FusedBatchNormV3", _fused_bn)
+
+
+@R("OneHot")
+def _one_hot(sd, n, ins):
+    depth = int(np.asarray(ins[1].get_arr()))
+    on = float(np.asarray(ins[2].get_arr()))
+    off = float(np.asarray(ins[3].get_arr()))
+    axis = int(n.attr["axis"].i) if "axis" in n.attr else -1
+    if axis != -1:
+        raise UnmappedTFOpException("OneHot axis != -1 unsupported")
+    oh = sd.op("one_hot", ins[0], depth=depth)
+    if (on, off) == (1.0, 0.0):
+        return sd.rename(oh.name, n.name)
+    return sd.op("add", sd.op("mul", oh, on - off), off, name=n.name)
+
+
+@R("Fill")
+def _fill(sd, n, ins):
+    dims = [int(d) for d in np.asarray(ins[0].get_arr())]
+    value = np.asarray(ins[1].get_arr())
+    return sd.constant(n.name, np.full(dims, value))
+
+
+@R("SquaredDifference")
+def _sqdiff(sd, n, ins):
+    return sd.op("square", sd.op("sub", ins[0], ins[1]), name=n.name)
+
+
+R("Select", lambda sd, n, ins: sd.op("where", ins[0], ins[1], ins[2],
+                                     name=n.name))
+R("SelectV2", lambda sd, n, ins: sd.op("where", ins[0], ins[1], ins[2],
+                                       name=n.name))
+R("LeakyRelu", lambda sd, n, ins: sd.op(
+    "leaky_relu", ins[0],
+    alpha=float(n.attr["alpha"].f) if "alpha" in n.attr else 0.2,
+    name=n.name))
+R("Softplus", lambda sd, n, ins: sd.op("softplus", ins[0], name=n.name))
+R("Floor", lambda sd, n, ins: sd.op("floor", ins[0], name=n.name))
+R("FloorDiv", lambda sd, n, ins: sd.op("floor_div", ins[0], ins[1],
+                                       name=n.name))
+R("GreaterEqual", lambda sd, n, ins: sd.op("greater_equal", ins[0], ins[1],
+                                           name=n.name))
+R("Greater", lambda sd, n, ins: sd.op("greater", ins[0], ins[1],
+                                      name=n.name))
+R("Less", lambda sd, n, ins: sd.op("less", ins[0], ins[1], name=n.name))
+R("Equal", lambda sd, n, ins: sd.op("equal", ins[0], ins[1], name=n.name))
+R("LogicalAnd", lambda sd, n, ins: sd.op("logical_and", ins[0], ins[1],
+                                         name=n.name))
+R("LogicalNot", lambda sd, n, ins: sd.op("logical_not", ins[0],
+                                         name=n.name))
+R("Gelu", lambda sd, n, ins: sd.op(
+    "gelu", ins[0],
+    approximate=bool(n.attr["approximate"].b) if "approximate" in n.attr
+    else False,                       # tf.nn.gelu defaults to exact erf
+    name=n.name))
+
+
+@R("Tile")
+def _tile(sd, n, ins):
+    reps = [int(r) for r in np.asarray(ins[1].get_arr())]
+    return sd.op("tile", ins[0], reps=reps, name=n.name)
+
+
+def _pad_tf(sd, n, ins):
+    paddings = np.asarray(ins[1].get_arr()).tolist()
+    value = 0.0 if len(ins) < 3 else float(np.asarray(ins[2].get_arr()))
+    return sd.op("pad", ins[0], paddings=paddings, value=value, name=n.name)
+
+
+R("Pad", _pad_tf)
+R("PadV2", _pad_tf)
+
+
+@R("Min")
+def _reduce_min(sd, n, ins):
+    axes = [int(a) for a in np.atleast_1d(np.asarray(ins[1].get_arr()))]
+    return sd.op("min", ins[0], axis=axes,
+                 keepdims=bool(n.attr["keep_dims"].b), name=n.name)
+
+
 def import_graph_def(graph_def, input_names: List[str] = None) -> SameDiff:
     """Walk a (frozen) GraphDef into a SameDiff graph.  Variables must be
-    frozen to Const (the reference likewise imports frozen graphs)."""
+    frozen to Const (the reference likewise imports frozen graphs).
+    Multi-output TF nodes (Split, FusedBatchNorm, ...) register each output
+    under `name:i`; plain `name` refers to output 0, matching TF edge
+    naming."""
+    from tensorflow.python.framework import dtypes
     sd = SameDiff.create()
     produced = {}
 
-    def clean(inp: str) -> str:
-        inp = inp.split(":")[0]
-        return inp[1:] if inp.startswith("^") else inp
+    def lookup(inp: str):
+        inp = inp[1:] if inp.startswith("^") else inp
+        if inp in produced:
+            return produced[inp]
+        base, _, idx = inp.partition(":")
+        if idx not in ("", "0"):
+            # consuming output i>0 of a node whose mapper produced fewer
+            # outputs must fail loudly, not alias to output 0
+            raise UnmappedTFOpException(
+                f"Edge '{inp}' consumes a secondary output the mapper for "
+                f"'{base}' does not produce")
+        return produced[base]
 
     for node in graph_def.node:
         if node.op == "Placeholder":
             shape = _attr_shape(node) or None
+            dt = np.dtype(dtypes.as_dtype(
+                node.attr["dtype"].type).as_numpy_dtype).name \
+                if node.attr["dtype"].type else "float32"
             produced[node.name] = sd.placeholder(
-                node.name, shape=shape if shape else None)
+                node.name, shape=shape if shape else None, dtype=dt)
         elif node.op == "Const":
             produced[node.name] = sd.constant(node.name, _const_value(node))
         elif node.op == "NoOp":
             continue
         else:
-            ins = [produced[clean(i)] for i in node.input
-                   if not i.startswith("^")]
-            produced[node.name] = TFImportRegistry.get(node.op)(sd, node,
-                                                                ins)
+            ins = [lookup(i) for i in node.input if not i.startswith("^")]
+            out = TFImportRegistry.get(node.op)(sd, node, ins)
+            outs = out if isinstance(out, tuple) else (out,)
+            produced[node.name] = outs[0]
+            for i, v in enumerate(outs):
+                produced[f"{node.name}:{i}"] = v
     return sd

@@ -9,8 +9,10 @@ repeated fine-tune epochs skip the frozen compute entirely (the reference's
 `featurize`/`fitFeaturized` flow; on TPU this also shrinks the compiled
 step to the trainable suffix).
 
-ComputationGraph transfer learning: freeze + head-swap via the same
-builder pattern is future work (reference `TransferLearning.GraphBuilder`).
+`TransferLearning.GraphBuilder` is the ComputationGraph counterpart
+(reference `TransferLearning.GraphBuilder`): freeze an ancestor subgraph,
+remove/splice/add vertices, resize heads — retained vertices keep their
+trained parameters.
 """
 from __future__ import annotations
 
@@ -139,6 +141,162 @@ class TransferLearning:
     @staticmethod
     def builder(net: MultiLayerNetwork) -> "TransferLearning.Builder":
         return TransferLearning.Builder(net)
+
+    class GraphBuilder:
+        """ComputationGraph transfer learning (reference
+        `TransferLearning.GraphBuilder`): freeze a feature-extractor
+        subgraph, remove/replace vertices, swap heads — retained vertices
+        keep their trained parameters."""
+
+        def __init__(self, net):
+            from deeplearning4j_tpu.nn.graph import ComputationGraph
+            if not isinstance(net, ComputationGraph):
+                raise TypeError("GraphBuilder wraps a ComputationGraph; use "
+                                "TransferLearning.builder for MLNs")
+            self._net = net
+            self._conf = copy.deepcopy(net.conf)
+            self._frozen_at: List[str] = []
+            self._reinit: set = set()
+            self._removed: set = set()
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+
+        def fine_tune_configuration(self, ft: FineTuneConfiguration):
+            self._fine_tune = ft
+            return self
+
+        def set_feature_extractor(self, *vertex_names: str):
+            """Freeze the named vertices and every ancestor (reference
+            `setFeatureExtractor(String...)`)."""
+            for n in vertex_names:
+                if n not in self._conf.vertices:
+                    raise ValueError(f"Unknown vertex '{n}'")
+            self._frozen_at = list(vertex_names)
+            return self
+
+        def remove_vertex_and_connections(self, name: str):
+            """Drop a vertex and its edges; consumers must be re-wired via
+            add_layer/add_vertex before build (reference
+            `removeVertexAndConnections` leaves the same obligation)."""
+            self._conf.vertices.pop(name)
+            self._conf.vertex_inputs.pop(name, None)
+            self._conf.network_outputs = [
+                o for o in self._conf.network_outputs if o != name]
+            self._removed.add(name)
+            return self
+
+        def remove_vertex_keep_connections(self, name: str):
+            """Splice a single-input vertex out of the DAG, re-pointing its
+            consumers at its input."""
+            ins = self._conf.vertex_inputs.get(name, [])
+            if len(ins) != 1:
+                raise ValueError(
+                    f"remove_vertex_keep_connections needs exactly one "
+                    f"input edge on '{name}', found {len(ins)}")
+            (src,) = ins
+            self._conf.vertices.pop(name)
+            self._conf.vertex_inputs.pop(name)
+            for v, vins in self._conf.vertex_inputs.items():
+                self._conf.vertex_inputs[v] = [src if i == name else i
+                                               for i in vins]
+            self._conf.network_outputs = [
+                src if o == name else o for o in self._conf.network_outputs]
+            self._removed.add(name)
+            return self
+
+        def add_layer(self, name: str, layer: Layer, *inputs: str):
+            from deeplearning4j_tpu.nn.graph import LayerVertex
+            return self.add_vertex(name, LayerVertex(name=name, layer=layer),
+                                   *inputs)
+
+        def add_vertex(self, name: str, vertex, *inputs: str):
+            if name in self._conf.vertices:
+                raise ValueError(f"Vertex '{name}' already exists")
+            self._conf.vertices[name] = vertex
+            self._conf.vertex_inputs[name] = list(inputs)
+            self._reinit.add(name)
+            return self
+
+        def set_outputs(self, *names: str):
+            self._conf.network_outputs = list(names)
+            return self
+
+        def n_out_replace(self, layer_name: str, n_out: int,
+                          weight_init: Optional[str] = None):
+            """Resize a layer vertex's n_out; it and its direct consumers
+            re-initialize (reference `nOutReplace`)."""
+            from deeplearning4j_tpu.nn.graph import LayerVertex
+            v = self._conf.vertices[layer_name]
+            if not isinstance(v, LayerVertex) or not hasattr(v.layer,
+                                                             "n_out"):
+                raise ValueError(f"'{layer_name}' is not a resizable layer")
+            v.layer.n_out = n_out
+            if weight_init:
+                v.layer.weight_init = weight_init
+            self._reinit.add(layer_name)
+            # the width change propagates through parameterless vertices
+            # (Merge/ElementWise/Scale/...) until absorbed by the next
+            # parameterized layer, which must re-initialize
+            frontier = [layer_name]
+            while frontier:
+                src = frontier.pop()
+                for consumer, ins in self._conf.vertex_inputs.items():
+                    if src in ins and consumer not in self._reinit:
+                        self._reinit.add(consumer)
+                        if not isinstance(self._conf.vertices[consumer],
+                                          LayerVertex):
+                            frontier.append(consumer)
+            return self
+
+        def _ancestors_of(self, roots: List[str]) -> set:
+            closed = set()
+            stack = list(roots)
+            while stack:
+                n = stack.pop()
+                if n in closed or n not in self._conf.vertices:
+                    continue
+                closed.add(n)
+                stack.extend(self._conf.vertex_inputs.get(n, []))
+            return closed
+
+        def build(self):
+            from deeplearning4j_tpu.nn.graph import ComputationGraph
+            conf = self._conf
+            if self._fine_tune:
+                self._fine_tune.apply(conf)
+            frozen = self._ancestors_of(self._frozen_at)
+            for n in frozen:
+                layer = getattr(conf.vertices[n], "layer", None)
+                if layer is not None:
+                    layer.frozen = True
+            net = ComputationGraph(conf).init()
+            # carry trained params into retained, un-reinitialized vertices;
+            # copy leaves — the jitted step donates param buffers, so the
+            # source and derived nets must never share arrays
+            for name in conf.vertices:
+                if name in self._reinit or name in self._removed:
+                    continue
+                if name in self._net.params_:
+                    old = self._net.params_[name]
+                    shapes_match = all(
+                        np.shape(a) == np.shape(b)
+                        for a, b in zip(jax.tree_util.tree_leaves(old),
+                                        jax.tree_util.tree_leaves(
+                                            net.params_[name])))
+                    if not shapes_match:
+                        raise ValueError(
+                            f"Cannot transplant params into '{name}': its "
+                            "expected shapes changed (an upstream edit "
+                            "resized it) — mark it for re-init via "
+                            "n_out_replace or rebuild it explicitly")
+                    net.params_[name] = jax.tree_util.tree_map(
+                        jnp.copy, old)
+                    net.state_[name] = jax.tree_util.tree_map(
+                        jnp.copy, self._net.state_[name])
+            return net
+
+    @staticmethod
+    def graph_builder(net) -> "TransferLearning.GraphBuilder":
+        return TransferLearning.GraphBuilder(net)
 
 
 class TransferLearningHelper:

@@ -73,3 +73,23 @@ class CompressedGradientExchange:
         dense_bytes = sum(4 * int(np.prod(s) or 1) for s in self._shapes)
         sparse_bytes = sum(4 * (len(s) + 1) for s in streams)
         return dense_bytes / max(sparse_bytes, 1)
+
+
+def allreduce_compressed(exchange: CompressedGradientExchange,
+                         transport, grads):
+    """Sum a gradient pytree across ranks through the compressed path:
+    encode locally (residuals carried), all-gather the sparse streams over
+    `transport` (a `transport.TcpGradientMesh`), decode every rank's stream,
+    sum dense.  This is the reference's EncodedGradientsAccumulator
+    apply-peer-updates loop made synchronous (SURVEY.md §3.4 north star)."""
+    from deeplearning4j_tpu.parallel.transport import (pack_streams,
+                                                       unpack_streams)
+    streams = exchange.encode(grads)
+    payload = pack_streams(streams, exchange.thresholds())
+    total = None
+    for peer_payload in transport.allgather(payload):
+        peer_streams, peer_thr = unpack_streams(peer_payload)
+        dense = exchange.decode(peer_streams, peer_thr)
+        total = dense if total is None else jax.tree_util.tree_map(
+            lambda a, b: a + b, total, dense)
+    return total

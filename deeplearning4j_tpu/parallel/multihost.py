@@ -1,0 +1,184 @@
+"""Multi-host training runtime — the Spark/Aeron scale-out replacement.
+
+Reference: `deeplearning4j-scaleout/spark/dl4j-spark*` (TrainingMaster,
+SharedTrainingMaster) + the Aeron mesh under `nd4j-parameter-server-parent/`
+(SURVEY.md §2.4, §3.4): a JVM cluster forms a UDP mesh, workers push
+threshold-compressed gradients, a master coordinates epochs.
+
+TPU-native inversion: the *control plane* is `jax.distributed` (one
+coordinator, N processes) and the *data plane* is XLA collectives over
+ICI/DCN inside the one jitted SPMD step — there is no parameter server, no
+gossip, no per-batch host hop.  What remains host-side is exactly what the
+reference kept host-side: process bootstrap, global-mesh formation, and the
+optional compressed-gradient DCN path (`parallel.transport` +
+`parallel.compression`).
+
+`LocalLauncher` is SURVEY §4's "multi-node without a cluster" story
+(Aeron-on-loopback / Spark local[*]): N OS processes on localhost, each
+with its own XLA CPU client, forming one global device mesh over the
+`jax.distributed` coordination service with gloo collectives.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+# Env keys the launcher sets and `initialize()` reads (the moral equivalent
+# of Spark's master URL + executor id).
+ENV_COORD = "DL4J_TPU_COORDINATOR"
+ENV_NPROC = "DL4J_TPU_NUM_PROCESSES"
+ENV_PID = "DL4J_TPU_PROCESS_ID"
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the training cluster (reference: SharedTrainingMaster worker
+    bootstrap).  Arguments default to the `DL4J_TPU_*` env the launcher
+    sets; on real TPU pods, call with no args — `jax.distributed.initialize`
+    auto-detects the slice topology from the TPU metadata."""
+    import jax
+    coordinator_address = coordinator_address or os.environ.get(ENV_COORD)
+    if num_processes is None and ENV_NPROC in os.environ:
+        num_processes = int(os.environ[ENV_NPROC])
+    if process_id is None and ENV_PID in os.environ:
+        process_id = int(os.environ[ENV_PID])
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def process_index() -> int:
+    import jax
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+    return jax.process_count()
+
+
+def global_mesh(axes: Optional[Dict[str, int]] = None):
+    """Mesh over every device of every process (default: pure DP)."""
+    import jax
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    return make_mesh(axes, jax.devices())
+
+
+def shard_host_local_batch(mesh, batch, axis: str = "data"):
+    """Each process contributes its *local* slice of the global batch; the
+    result is one global jax.Array sharded over `axis` (the SPMD analog of
+    Spark partitioning an RDD of DataSets across executors).  All processes
+    must feed equal-sized local batches."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    nproc = jax.process_count()
+
+    def place(leaf):
+        leaf = np.asarray(leaf)
+        spec = P(*([axis] + [None] * (leaf.ndim - 1)))
+        global_shape = (leaf.shape[0] * nproc,) + leaf.shape[1:]
+        return jax.make_array_from_process_local_data(
+            NamedSharding(mesh, spec), leaf, global_shape)
+    return jax.tree_util.tree_map(place, batch)
+
+
+def allgather_params(tree):
+    """Gather a (possibly sharded) param tree to replicated host numpy on
+    every process — the checkpoint/eval hook (reference: params sync back
+    to the Spark driver)."""
+    from jax.experimental import multihost_utils
+    return multihost_utils.process_allgather(tree, tiled=False)
+
+
+# ---------------------------------------------------------------------------
+# localhost launcher (SURVEY §4: "multi-node without a cluster")
+# ---------------------------------------------------------------------------
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def child_env(coordinator: str, num_processes: int, process_id: int,
+              devices_per_process: int = 1,
+              platform: str = "cpu") -> Dict[str, str]:
+    """Environment for a spawned worker: force the CPU platform with K
+    virtual devices and scrub any single-chip TPU plugin state inherited
+    from the parent (a tunnel-attached chip cannot be shared by N
+    processes; the real multi-host TPU path initializes per-host chips
+    from clean slice metadata instead)."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("TPU_", "PJRT_", "AXON_"))
+           and k != "_AXON_REGISTERED"}
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    # prepend (don't clobber) so parent-supplied deps stay importable; drop
+    # only single-chip plugin path entries
+    inherited = [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+                 if p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join([repo_root] + inherited)
+    env["JAX_PLATFORMS"] = platform
+    if platform == "cpu":
+        env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                            f"{devices_per_process}")
+    env[ENV_COORD] = coordinator
+    env[ENV_NPROC] = str(num_processes)
+    env[ENV_PID] = str(process_id)
+    return env
+
+
+class LocalLauncher:
+    """Spawn an SPMD worker script across N localhost processes and wait.
+
+    Each process sees `devices_per_process` XLA CPU devices; together they
+    form an `N*devices_per_process`-device global mesh.  stdout/stderr are
+    captured per rank; a nonzero exit raises with the failing rank's tail.
+    """
+
+    def __init__(self, num_processes: int, devices_per_process: int = 1,
+                 platform: str = "cpu"):
+        self.num_processes = num_processes
+        self.devices_per_process = devices_per_process
+        self.platform = platform
+
+    def run(self, script: str, args: Sequence[str] = (),
+            timeout: float = 300.0) -> List[str]:
+        coordinator = f"127.0.0.1:{free_port()}"
+        procs = []
+        for rank in range(self.num_processes):
+            env = child_env(coordinator, self.num_processes, rank,
+                            self.devices_per_process, self.platform)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-u", script, *map(str, args)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env))
+        outs: List[str] = []
+        failed = None
+        for rank, p in enumerate(procs):
+            try:
+                out, _ = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+                out += "\n<rank timed out>"
+                failed = failed or (rank, out, -9)
+            outs.append(out)
+            if p.returncode not in (0, None) and failed is None:
+                failed = (rank, out, p.returncode)
+        if failed is not None:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            rank, out, rc = failed
+            raise RuntimeError(
+                f"multihost rank {rank} failed (rc={rc}):\n{out[-4000:]}")
+        return outs

@@ -1,0 +1,146 @@
+"""TCP transport for compressed gradient exchange — the Aeron substitute.
+
+Reference: `nd4j-serde/nd4j-aeron` + `nd4j-parameter-server-parent`
+(SURVEY.md §2.4): workers publish threshold-encoded gradient streams over
+an Aeron UDP mesh.  Here the *fast* path (intra-slice) is XLA all-reduce
+over ICI and never touches this module; this transport exists for the
+reference's remaining role — shipping `parallel.compression` streams
+between hosts over a commodity network (DCN) — and for the
+multi-process-on-localhost tests (SURVEY §4's Aeron-on-loopback analog).
+
+Topology: star via rank 0 (the parameter-server-shaped rank), length-
+prefixed binary frames, no pickling — streams are raw int32/float32 buffers
+exactly as the C++ codec emits them.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import time
+from typing import List, Optional
+
+import numpy as np
+
+
+def _send_msg(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed during receive")
+        buf += chunk
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket) -> bytes:
+    (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    return _recv_exact(sock, n)
+
+
+def pack_streams(streams: List[np.ndarray],
+                 thresholds: List[float]) -> bytes:
+    """[count | per-leaf: len, threshold, int32 stream] — language-neutral
+    framing (the FlatBuffers-message role in the reference's Aeron path)."""
+    out = [struct.pack("<I", len(streams))]
+    for s, t in zip(streams, thresholds):
+        s = np.ascontiguousarray(s, dtype=np.int32)
+        out.append(struct.pack("<If", s.size, float(t)))
+        out.append(s.tobytes())
+    return b"".join(out)
+
+
+def unpack_streams(payload: bytes):
+    (count,) = struct.unpack_from("<I", payload, 0)
+    off = 4
+    streams, thresholds = [], []
+    for _ in range(count):
+        n, t = struct.unpack_from("<If", payload, off)
+        off += 8
+        streams.append(np.frombuffer(payload, np.int32, n, off).copy())
+        off += 4 * n
+        thresholds.append(t)
+    return streams, thresholds
+
+
+class TcpGradientMesh:
+    """All-gather of opaque byte payloads across ranks (star via rank 0).
+
+    Rank 0 binds, accepts `world-1` peers (each identifies itself with its
+    rank), gathers one payload per rank per round, and broadcasts the full
+    list — every rank then holds every rank's compressed stream, mirroring
+    the reference mesh where each worker applies every peer's encoded
+    delta."""
+
+    def __init__(self, rank: int, world: int, port: int,
+                 host: str = "127.0.0.1", timeout: float = 60.0):
+        self.rank = rank
+        self.world = world
+        self._peers: List[Optional[socket.socket]] = [None] * world
+        self._server: Optional[socket.socket] = None
+        if world == 1:
+            return
+        if rank == 0:
+            srv = socket.create_server((host, port), backlog=world)
+            srv.settimeout(timeout)
+            self._server = srv
+            for _ in range(world - 1):
+                conn, _ = srv.accept()
+                conn.settimeout(timeout)
+                (peer_rank,) = struct.unpack("<I", _recv_exact(conn, 4))
+                self._peers[peer_rank] = conn
+        else:
+            deadline = time.monotonic() + timeout
+            while True:
+                try:
+                    conn = socket.create_connection((host, port),
+                                                    timeout=timeout)
+                    break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.1)
+            conn.settimeout(timeout)
+            conn.sendall(struct.pack("<I", rank))
+            self._peers[0] = conn
+
+    def allgather(self, payload: bytes) -> List[bytes]:
+        if self.world == 1:
+            return [payload]
+        if self.rank == 0:
+            gathered: List[bytes] = [b""] * self.world
+            gathered[0] = payload
+            for r in range(1, self.world):
+                gathered[r] = _recv_msg(self._peers[r])
+            blob = struct.pack("<I", self.world) + b"".join(
+                struct.pack("<Q", len(g)) + g for g in gathered)
+            for r in range(1, self.world):
+                _send_msg(self._peers[r], blob)
+            return gathered
+        _send_msg(self._peers[0], payload)
+        blob = _recv_msg(self._peers[0])
+        (world,) = struct.unpack_from("<I", blob, 0)
+        off = 4
+        gathered = []
+        for _ in range(world):
+            (n,) = struct.unpack_from("<Q", blob, off)
+            off += 8
+            gathered.append(blob[off: off + n])
+            off += n
+        return gathered
+
+    def close(self) -> None:
+        for s in self._peers:
+            if s is not None:
+                s.close()
+        if self._server is not None:
+            self._server.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -43,6 +43,39 @@ def _shard_batch(x, mesh: Mesh, axis: str):
     return jax.tree_util.tree_map(place, x)
 
 
+def _shard_opt_state_like(opt_state, params, mesh: Mesh):
+    """Place optimizer state so param-shaped moments (Adam m/v, momentum
+    buffers, ...) inherit each param's sharding; anything else (step counts,
+    scalars, empty states) replicates.  Handles both layouts in the tree:
+    `{layer: {"m": layer_params, ...}}` (MultiLayerNetwork/ComputationGraph
+    per-layer updaters) and `{"m": params, "v": params}` (flat updaters) by
+    recursive structural match against the params tree."""
+    repl = NamedSharding(mesh, P())
+
+    def try_match(sub, param_sub):
+        s_leaves, s_def = jax.tree_util.tree_flatten(sub)
+        p_leaves, p_def = jax.tree_util.tree_flatten(param_sub)
+        if s_def == p_def and s_leaves and all(
+                np.shape(a) == np.shape(b)
+                for a, b in zip(s_leaves, p_leaves)):
+            return jax.tree_util.tree_map(
+                lambda s, p: jax.device_put(s, p.sharding), sub, param_sub)
+        return None
+
+    def place(sub, param_sub):
+        matched = try_match(sub, param_sub)
+        if matched is not None:
+            return matched
+        if isinstance(sub, dict):
+            return {k: place(v, param_sub[k]
+                             if isinstance(param_sub, dict)
+                             and k in param_sub else param_sub)
+                    for k, v in sub.items()}
+        return jax.device_put(sub, repl)
+
+    return place(opt_state, params)
+
+
 class ParallelWrapper:
     """Data-parallel trainer wrapping a MultiLayerNetwork or
     ComputationGraph.  API parity with the reference builder:
@@ -114,7 +147,10 @@ class ParallelWrapper:
     # ---- placement ----
     def _place_model(self):
         """Replicate (or TP-shard) params/state/opt-state over the mesh once;
-        the jitted step keeps shardings on its outputs thereafter."""
+        the jitted step keeps shardings on its outputs thereafter.  Optimizer
+        moments are param-shaped, so they FOLLOW the param sharding — a
+        TP-sharded layer keeps its Adam m/v sharded too (no HBM waste, no
+        per-step reshard)."""
         if self._placed:
             return
         m = self.model
@@ -125,13 +161,54 @@ class ParallelWrapper:
             m.params_ = jax.device_put(m.params_, repl)
         repl = NamedSharding(self.mesh, P())
         m.state_ = jax.device_put(m.state_, repl)
-        m.opt_state_ = jax.device_put(m.opt_state_, repl)
+        if m.opt_state_ is not None:
+            m.opt_state_ = _shard_opt_state_like(m.opt_state_, m.params_,
+                                                 self.mesh)
         self._placed = True
 
     # ---- training ----
+    def _fit_ds(self, ds):
+        """Shard one DataSet/MultiDataSet (features, labels, masks) over the
+        data axis and run the model's compiled step."""
+        m = self.model
+
+        def shard(t):
+            return None if t is None else _shard_batch(t, self.mesh,
+                                                       self.data_axis)
+
+        if hasattr(ds, "features_masks"):          # MultiDataSet (CG path)
+            if ds.features_masks is not None and any(
+                    mk is not None for mk in ds.features_masks):
+                raise NotImplementedError(
+                    "ComputationGraph training does not consume feature "
+                    "masks (same as its compiled step); drop them or mask "
+                    "inside the input pipeline")
+            x = [shard(f) for f in ds.features]
+            y = [shard(l) for l in ds.labels]
+            lm = [shard(mk) for mk in ds.labels_masks] \
+                if ds.labels_masks is not None else None
+            with self.mesh:
+                m._fit_batch(m._as_input_dict(x), y, lm)
+        else:
+            fm = getattr(ds, "features_mask", None)
+            lm = shard(getattr(ds, "labels_mask", None))
+            with self.mesh:
+                if hasattr(m, "_as_input_dict"):   # CG fed single-input DS
+                    if fm is not None:
+                        raise NotImplementedError(
+                            "ComputationGraph training does not consume "
+                            "feature masks")
+                    m._fit_batch(m._as_input_dict(shard(ds.features)),
+                                 m._as_list(shard(ds.labels)),
+                                 None if lm is None else [lm])
+                else:
+                    m.fit(shard(ds.features), shard(ds.labels),
+                          features_mask=shard(fm), labels_mask=lm)
+
     def fit(self, data, labels=None, *, epochs: int = 1):
-        """fit(x, y) or fit(iterator, epochs=N): the model's own compiled
-        step, run SPMD with the batch sharded over the data axis."""
+        """fit(x, y), fit(DataSet/MultiDataSet), or fit(iterator, epochs=N):
+        the model's own compiled step, run SPMD with every batch array
+        (multi-input features, labels, masks) sharded over the data axis."""
         self._place_model()
         m = self.model
         if labels is not None:
@@ -140,15 +217,29 @@ class ParallelWrapper:
             with self.mesh:
                 m.fit(x, y)
             return self
+        if hasattr(data, "features"):              # bare DataSet/MultiDataSet
+            self._fit_ds(data)
+            return self
         for _ in range(epochs):
             if hasattr(data, "reset"):
                 data.reset()
             for ds in data:
-                x = _shard_batch(ds.features, self.mesh, self.data_axis)
-                y = _shard_batch(ds.labels, self.mesh, self.data_axis)
-                with self.mesh:
-                    m.fit(x, y)
+                self._fit_ds(ds)
             m.epoch += 1
+        return self
+
+    def fit_host_local(self, features, labels):
+        """Multi-host fit: every process passes its *local* slice of the
+        global batch; slices are assembled into one global sharded array
+        (parallel.multihost.shard_host_local_batch) and the same SPMD step
+        runs across all hosts — the SharedTraining data path."""
+        from deeplearning4j_tpu.parallel.multihost import (
+            shard_host_local_batch)
+        self._place_model()
+        x = shard_host_local_batch(self.mesh, features, self.data_axis)
+        y = shard_host_local_batch(self.mesh, labels, self.data_axis)
+        with self.mesh:
+            self.model.fit(x, y)
         return self
 
     def average_updaters(self):

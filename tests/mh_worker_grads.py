@@ -1,0 +1,35 @@
+"""Compressed-gradient TCP exchange worker (spawned by test_multihost via
+LocalLauncher — NOT a pytest file).
+
+Each rank threshold-encodes a deterministic rank-dependent gradient tree,
+all-gathers the sparse streams over TcpGradientMesh, decodes every peer's
+stream and sums — the Aeron gradient-sharing loop on loopback.  Results are
+written per-rank for the driver to verify."""
+import os
+import sys
+
+import numpy as np
+
+from deeplearning4j_tpu.parallel.compression import (
+    CompressedGradientExchange, allreduce_compressed)
+from deeplearning4j_tpu.parallel.multihost import ENV_NPROC, ENV_PID
+from deeplearning4j_tpu.parallel.transport import TcpGradientMesh
+
+port = int(sys.argv[1])
+out_dir = sys.argv[2]
+rank = int(os.environ[ENV_PID])
+world = int(os.environ[ENV_NPROC])
+
+template = {"w": np.zeros((64, 32), np.float32),
+            "b": np.zeros(32, np.float32)}
+ex = CompressedGradientExchange(template, threshold=0.05)
+rng = np.random.default_rng(100 + rank)
+grads = {"w": rng.standard_normal((64, 32)).astype(np.float32) * 0.1,
+         "b": rng.standard_normal(32).astype(np.float32) * 0.1}
+
+with TcpGradientMesh(rank, world, port) as mesh:
+    total = allreduce_compressed(ex, mesh, grads)
+
+np.savez(os.path.join(out_dir, f"sum_{rank}.npz"),
+         **{k: np.asarray(v) for k, v in total.items()})
+print(f"rank {rank}/{world}: exchange done", flush=True)

@@ -1,0 +1,50 @@
+"""Multi-host SPMD training worker (spawned by test_multihost via
+LocalLauncher — NOT a pytest file).
+
+Each process joins the jax.distributed cluster, contributes its local slice
+of a deterministic global batch, and trains the same seeded MLN through
+ParallelWrapper.fit_host_local over the global mesh.  Final params are
+written per-rank for the driver test to compare (across ranks, and against
+a single-process reference)."""
+import os
+import sys
+
+import numpy as np
+
+from deeplearning4j_tpu.parallel import multihost
+
+multihost.initialize()
+
+from deeplearning4j_tpu.nn import (DenseLayer, InputType,  # noqa: E402
+                                   MultiLayerNetwork, NeuralNetConfiguration,
+                                   OutputLayer)
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper  # noqa: E402
+from deeplearning4j_tpu.train import Sgd  # noqa: E402
+
+out_dir = sys.argv[1]
+steps = int(sys.argv[2])
+rank = multihost.process_index()
+world = multihost.process_count()
+mesh = multihost.global_mesh()
+
+rng = np.random.default_rng(0)
+X = rng.standard_normal((16, 10)).astype(np.float32)
+Y = np.eye(2, dtype=np.float32)[(X.sum(1) > 0).astype(int)]
+per = X.shape[0] // world
+xl = X[rank * per:(rank + 1) * per]
+yl = Y[rank * per:(rank + 1) * per]
+
+conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.1))
+        .list([DenseLayer(n_out=16, activation="tanh"),
+               OutputLayer(n_out=2, loss="mcxent", activation="softmax")])
+        .set_input_type(InputType.feed_forward(10)).build())
+net = MultiLayerNetwork(conf).init()
+pw = ParallelWrapper(net, mesh)
+for _ in range(steps):
+    pw.fit_host_local(xl, yl)
+
+params = np.asarray(net.params())
+np.savez(os.path.join(out_dir, f"params_{rank}.npz"), params=params,
+         score=np.float64(net.score()))
+print(f"rank {rank}/{world}: devices={len(mesh.devices.flat)} "
+      f"score={net.score():.6f}", flush=True)

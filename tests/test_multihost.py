@@ -1,0 +1,86 @@
+"""Multi-process-on-localhost distributed tests (VERDICT #2; SURVEY §4's
+"multi-node without a cluster" obligation — the Aeron-on-loopback / Spark
+local[*] analog).
+
+`LocalLauncher` spawns real OS processes, each with its own XLA CPU client;
+they form a global device mesh over the `jax.distributed` coordination
+service (gloo collectives) and train the same SPMD step — the reference's
+`dl4j-spark-parameterserver` SharedTraining story.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel.multihost import LocalLauncher, free_port
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def test_two_process_dp_training_matches_single_process(tmp_path):
+    """2 processes x 2 CPU devices = one 4-device global DP mesh.  Both
+    ranks must end bit-identical (SPMD sync), and match a single-process
+    fit on the full batch (gradient-mean equivalence)."""
+    steps = 5
+    launcher = LocalLauncher(num_processes=2, devices_per_process=2)
+    outs = launcher.run(os.path.join(HERE, "mh_worker_train.py"),
+                        [str(tmp_path), str(steps)], timeout=420)
+    assert any("devices=4" in o for o in outs), outs[0][-500:]
+
+    p0 = np.load(tmp_path / "params_0.npz")["params"]
+    p1 = np.load(tmp_path / "params_1.npz")["params"]
+    np.testing.assert_array_equal(p0, p1)
+
+    # single-process reference on the identical seeded net + full batch
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.train import Sgd
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((16, 10)).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[(X.sum(1) > 0).astype(int)]
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.1))
+            .list([DenseLayer(n_out=16, activation="tanh"),
+                   OutputLayer(n_out=2, loss="mcxent",
+                               activation="softmax")])
+            .set_input_type(InputType.feed_forward(10)).build())
+    net = MultiLayerNetwork(conf).init()
+    for _ in range(steps):
+        net.fit(X, Y)
+    ref = np.asarray(net.params())
+    np.testing.assert_allclose(p0, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_compressed_gradient_allreduce_over_tcp(tmp_path):
+    """3 ranks exchange threshold-encoded gradients over the TCP star and
+    each must hold the identical decoded sum (the codec's below-threshold
+    residuals stay local, so the expected value is the sum of each rank's
+    decode(encode(g)) — computed here with fresh codecs)."""
+    world = 3
+    port = free_port()
+    launcher = LocalLauncher(num_processes=world)
+    launcher.run(os.path.join(HERE, "mh_worker_grads.py"),
+                 [str(port), str(tmp_path)], timeout=240)
+
+    results = [dict(np.load(tmp_path / f"sum_{r}.npz"))
+               for r in range(world)]
+    for r in range(1, world):
+        for k in results[0]:
+            np.testing.assert_array_equal(results[0][k], results[r][k])
+
+    from deeplearning4j_tpu.parallel.compression import (
+        CompressedGradientExchange)
+    template = {"w": np.zeros((64, 32), np.float32),
+                "b": np.zeros(32, np.float32)}
+    expected = None
+    for r in range(world):
+        ex = CompressedGradientExchange(template, threshold=0.05)
+        rng = np.random.default_rng(100 + r)
+        grads = {"w": rng.standard_normal((64, 32)).astype(np.float32) * 0.1,
+                 "b": rng.standard_normal(32).astype(np.float32) * 0.1}
+        dense = ex.decode(ex.encode(grads))
+        expected = dense if expected is None else {
+            k: expected[k] + dense[k] for k in expected}
+    for k in expected:
+        np.testing.assert_allclose(results[0][k], np.asarray(expected[k]),
+                                   rtol=1e-6, atol=1e-7)

@@ -176,3 +176,107 @@ def test_transfer_learning_helper_featurize():
     out_full = np.asarray(full.output(it._list[0].features))
     out_feat = np.asarray(helper.output_from_featurized(feat[0].features))
     np.testing.assert_allclose(out_full, out_feat, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# ComputationGraph transfer learning (VERDICT #9; reference
+# `TransferLearningCompGraphTest.java`)
+# ---------------------------------------------------------------------------
+
+def _cg_base(seed=11):
+    from deeplearning4j_tpu.nn import (ComputationGraph, DenseLayer,
+                                       GraphBuilder, InputType, OutputLayer)
+    conf = (GraphBuilder()
+            .seed(seed).updater(Sgd(0.1)).weight_init("XAVIER")
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(8))
+            .add_layer("f1", DenseLayer(n_out=12, activation="relu"), "in")
+            .add_layer("f2", DenseLayer(n_out=10, activation="relu"), "f1")
+            .add_layer("out", OutputLayer(n_out=3, loss="mcxent",
+                                          activation="softmax"), "f2")
+            .set_outputs("out").build())
+    return ComputationGraph(conf).init()
+
+
+def _cg_data(n=32, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 8).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, n)]
+    return x, y
+
+
+def test_cg_transfer_freeze_and_head_swap():
+    from deeplearning4j_tpu.nn import DenseLayer, OutputLayer
+    base = _cg_base()
+    x, y = _cg_data()
+    for _ in range(5):
+        base.fit([x], [y])
+    f1_before = np.asarray(base.params_["f1"]["W"]).copy()
+
+    new = (TransferLearning.graph_builder(base)
+           .set_feature_extractor("f2")
+           .remove_vertex_and_connections("out")
+           .add_layer("new_out", OutputLayer(n_out=5, loss="mcxent",
+                                             activation="softmax"), "f2")
+           .set_outputs("new_out")
+           .build())
+    # retained params transplanted
+    np.testing.assert_array_equal(np.asarray(new.params_["f1"]["W"]),
+                                  f1_before)
+    assert new.params_["new_out"]["W"].shape == (10, 5)
+    # frozen ancestors stay fixed through training; head moves
+    y5 = np.eye(5, dtype=np.float32)[np.random.RandomState(1).randint(
+        0, 5, 32)]
+    head_before = np.asarray(new.params_["new_out"]["W"]).copy()
+    f2_before = np.asarray(new.params_["f2"]["W"]).copy()
+    for _ in range(3):
+        new.fit([x], [y5])
+    np.testing.assert_array_equal(np.asarray(new.params_["f1"]["W"]),
+                                  f1_before)
+    np.testing.assert_array_equal(np.asarray(new.params_["f2"]["W"]),
+                                  f2_before)
+    assert not np.allclose(np.asarray(new.params_["new_out"]["W"]),
+                           head_before)
+    # and the source network is untouched by the derived net's training
+    # (donation-aliasing regression: ADVICE r1 finding)
+    base.output([x])
+
+
+def test_cg_transfer_nout_replace_reinits_consumer():
+    base = _cg_base()
+    x, y = _cg_data()
+    base.fit([x], [y])
+    new = (TransferLearning.graph_builder(base)
+           .n_out_replace("f2", 16, weight_init="XAVIER")
+           .build())
+    assert new.params_["f2"]["W"].shape == (12, 16)
+    assert new.params_["out"]["W"].shape == (16, 3)
+    # f1 retained
+    np.testing.assert_array_equal(np.asarray(new.params_["f1"]["W"]),
+                                  np.asarray(base.params_["f1"]["W"]))
+    new.fit([x], [y])
+    assert np.isfinite(new.score())
+
+
+def test_cg_transfer_splice_vertex():
+    from deeplearning4j_tpu.nn import ScaleVertex
+    from deeplearning4j_tpu.nn import (ComputationGraph, DenseLayer,
+                                       GraphBuilder, InputType, OutputLayer)
+    conf = (GraphBuilder()
+            .seed(3).updater(Sgd(0.1))
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(4))
+            .add_layer("d", DenseLayer(n_out=6, activation="tanh"), "in")
+            .add_vertex("sc", ScaleVertex(scale=2.0), "d")
+            .add_layer("out", OutputLayer(n_out=2, loss="mcxent",
+                                          activation="softmax"), "sc")
+            .set_outputs("out").build())
+    base = ComputationGraph(conf).init()
+    new = (TransferLearning.graph_builder(base)
+           .remove_vertex_keep_connections("sc")
+           .build())
+    assert "sc" not in new.conf.vertices
+    assert new.conf.vertex_inputs["out"] == ["d"]
+    x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    out = new.output([x])
+    assert np.asarray(out[0]).shape == (8, 2)
