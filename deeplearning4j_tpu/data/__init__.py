@@ -14,4 +14,5 @@ from deeplearning4j_tpu.data.normalizers import (  # noqa: F401
 from deeplearning4j_tpu.data.rr_iterator import (  # noqa: F401
     RecordReaderDataSetIterator, SequenceRecordReaderDataSetIterator)
 from deeplearning4j_tpu.data.datasets import (  # noqa: F401
-    IrisDataSetIterator, MnistDataSetIterator, SyntheticMnist, read_idx)
+    Cifar10DataSetIterator, EmnistDataSetIterator, IrisDataSetIterator,
+    MnistDataSetIterator, SyntheticCifar10, SyntheticMnist, read_idx)

@@ -35,7 +35,29 @@ def read_idx(path: str) -> np.ndarray:
         return data.reshape(dims)
 
 
-class MnistDataSetIterator(DataSetIterator):
+
+class _ArrayDataSetIterator(DataSetIterator):
+    """Shared shuffled/drop-last batching over in-memory (x, y) arrays —
+    the common substrate of the MNIST/EMNIST/CIFAR iterators."""
+
+    def _init_batching(self, batch_size: int, shuffle: bool, seed: int):
+        self._bs = batch_size
+        self._shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+
+    def batch_size(self) -> int:
+        return self._bs
+
+    def __iter__(self) -> Iterator[DataSet]:
+        idx = np.arange(len(self.x))
+        if self._shuffle:
+            self._rng.shuffle(idx)
+        for i in range(0, len(idx) - self._bs + 1, self._bs):
+            sl = idx[i:i + self._bs]
+            yield DataSet(self.x[sl], self.y[sl])
+
+
+class MnistDataSetIterator(_ArrayDataSetIterator):
     """MNIST batches, NHWC [B, 28, 28, 1] in [0, 1], one-hot labels
     (reference `MnistDataSetIterator`)."""
 
@@ -54,31 +76,21 @@ class MnistDataSetIterator(DataSetIterator):
         x = read_idx(img_path).astype(np.float32) / 255.0
         self.x = x[..., None]
         self.y = np.eye(10, dtype=np.float32)[read_idx(lbl_path)]
-        self._bs = batch_size
-        self._shuffle = shuffle
-        self._rng = np.random.default_rng(seed)
+        self._init_batching(batch_size, shuffle, seed)
 
     @staticmethod
-    def _find(data_dir: str, name: str) -> str:
+    def _find(data_dir: str, name: str, dataset: str = "MNIST",
+              env_var: str = "MNIST_DIR",
+              synthetic: str = "SyntheticMnist") -> str:
         for cand in (os.path.join(data_dir, name),
                      os.path.join(data_dir, name + ".gz")):
             if os.path.exists(cand):
                 return cand
         raise FileNotFoundError(
-            f"MNIST file {name}[.gz] not found in '{data_dir}' — no "
-            "download possible (zero egress); set MNIST_DIR or use "
-            "SyntheticMnist")
+            f"{dataset} file {name}[.gz] not found in '{data_dir}' — no "
+            f"download possible (zero egress); set {env_var}"
+            + (f" or use {synthetic}" if synthetic else ""))
 
-    def batch_size(self) -> int:
-        return self._bs
-
-    def __iter__(self) -> Iterator[DataSet]:
-        idx = np.arange(len(self.x))
-        if self._shuffle:
-            self._rng.shuffle(idx)
-        for i in range(0, len(idx) - self._bs + 1, self._bs):
-            sl = idx[i:i + self._bs]
-            yield DataSet(self.x[sl], self.y[sl])
 
 
 class SyntheticMnist(DataSetIterator):
@@ -133,3 +145,95 @@ class IrisDataSetIterator(DataSetIterator):
     def __iter__(self) -> Iterator[DataSet]:
         for i in range(0, 150, self._bs):
             yield DataSet(self.x[i:i + self._bs], self.y[i:i + self._bs])
+
+
+class Cifar10DataSetIterator(_ArrayDataSetIterator):
+    """CIFAR-10 batches, NHWC [B, 32, 32, 3] in [0, 1], one-hot labels
+    (reference `Cifar10DataSetIterator`).  Reads the canonical binary
+    format: per record 1 label byte + 3072 CHW pixel bytes, files
+    `data_batch_{1..5}.bin` / `test_batch.bin` (CIFAR_DIR env or explicit
+    path) — the reference downloads the same files; zero egress here."""
+
+    def __init__(self, batch_size: int, train: bool = True,
+                 data_dir: Optional[str] = None, seed: int = 0,
+                 shuffle: bool = True):
+        data_dir = data_dir or os.environ.get("CIFAR_DIR", "")
+        names = [f"data_batch_{i}.bin" for i in range(1, 6)] if train \
+            else ["test_batch.bin"]
+        xs, ys = [], []
+        for name in names:
+            path = os.path.join(data_dir, name)
+            if not os.path.exists(path):
+                raise FileNotFoundError(
+                    f"CIFAR-10 file {name} not found in '{data_dir}' — no "
+                    "download possible (zero egress); set CIFAR_DIR or use "
+                    "SyntheticCifar10")
+            raw = np.frombuffer(open(path, "rb").read(), np.uint8)
+            rec = raw.reshape(-1, 3073)
+            ys.append(rec[:, 0])
+            # stored CHW -> NHWC
+            xs.append(rec[:, 1:].reshape(-1, 3, 32, 32)
+                      .transpose(0, 2, 3, 1))
+        self.x = np.concatenate(xs).astype(np.float32) / 255.0
+        self.y = np.eye(10, dtype=np.float32)[np.concatenate(ys)]
+        self._init_batching(batch_size, shuffle, seed)
+
+
+
+class SyntheticCifar10(DataSetIterator):
+    """CIFAR-shaped deterministic stand-in (same role as SyntheticMnist)."""
+
+    def __init__(self, batch_size: int, n_batches: int = 10, seed: int = 0):
+        self._bs = batch_size
+        self._n = n_batches
+        rng = np.random.RandomState(0)
+        self._templates = rng.rand(10, 32, 32, 3).astype(np.float32)
+        self._seed = seed
+
+    def batch_size(self) -> int:
+        return self._bs
+
+    def __iter__(self) -> Iterator[DataSet]:
+        rng = np.random.RandomState(self._seed + 1)
+        for _ in range(self._n):
+            labels = rng.randint(0, 10, self._bs)
+            x = (0.7 * self._templates[labels]
+                 + 0.3 * rng.rand(self._bs, 32, 32, 3)).astype(np.float32)
+            yield DataSet(x, np.eye(10, dtype=np.float32)[labels])
+
+
+class EmnistDataSetIterator(_ArrayDataSetIterator):
+    """EMNIST batches (reference `EmnistDataSetIterator` with its `Set`
+    enum): same IDX format as MNIST, split-dependent class count.  Files
+    `emnist-{split}-{train|test}-images-idx3-ubyte[.gz]` under EMNIST_DIR
+    or `data_dir`."""
+
+    NUM_CLASSES = {"byclass": 62, "bymerge": 47, "balanced": 47,
+                   "letters": 26, "digits": 10, "mnist": 10}
+
+    def __init__(self, split: str, batch_size: int, train: bool = True,
+                 data_dir: Optional[str] = None, seed: int = 0,
+                 shuffle: bool = True):
+        split = split.lower()
+        if split not in self.NUM_CLASSES:
+            raise ValueError(f"Unknown EMNIST split '{split}'; one of "
+                             f"{sorted(self.NUM_CLASSES)}")
+        self.n_classes = self.NUM_CLASSES[split]
+        data_dir = data_dir or os.environ.get("EMNIST_DIR", "")
+        part = "train" if train else "test"
+        img = MnistDataSetIterator._find(
+            data_dir, f"emnist-{split}-{part}-images-idx3-ubyte",
+            dataset="EMNIST", env_var="EMNIST_DIR", synthetic="")
+        lbl = MnistDataSetIterator._find(
+            data_dir, f"emnist-{split}-{part}-labels-idx1-ubyte",
+            dataset="EMNIST", env_var="EMNIST_DIR", synthetic="")
+        # official EMNIST IDX images are stored transposed relative to
+        # MNIST orientation (NIST column-major conversion); flip them
+        x = read_idx(img).transpose(0, 2, 1)
+        self.x = (x.astype(np.float32) / 255.0)[..., None]
+        labels = read_idx(lbl).astype(np.int64)
+        if split == "letters":      # letters split is 1-indexed
+            labels = labels - 1
+        self.y = np.eye(self.n_classes, dtype=np.float32)[labels]
+        self._init_batching(batch_size, shuffle, seed)
+

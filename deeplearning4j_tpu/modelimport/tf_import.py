@@ -243,8 +243,10 @@ def _split(sd, n, ins):
     axis = int(np.asarray(ins[0].get_arr()))
     num = int(n.attr["num_split"].i)
     v = sd.op("split_equal", ins[1], num=num, axis=axis)
+    # secondary outputs take ':i' names — illegal in TF node names, so they
+    # can never collide with a later real node (TF uniquifies with _N)
     return tuple(sd.op("tuple_get", v, index=i,
-                       name=n.name if i == 0 else f"{n.name}_{i}")
+                       name=n.name if i == 0 else f"{n.name}:{i}")
                  for i in range(num))
 
 
@@ -254,7 +256,7 @@ def _split_v(sd, n, ins):
     axis = int(np.asarray(ins[2].get_arr()))
     v = sd.op("split_axis", ins[0], sizes=sizes, axis=axis)
     return tuple(sd.op("tuple_get", v, index=i,
-                       name=n.name if i == 0 else f"{n.name}_{i}")
+                       name=n.name if i == 0 else f"{n.name}:{i}")
                  for i in range(len(sizes)))
 
 

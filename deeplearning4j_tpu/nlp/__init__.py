@@ -3,3 +3,5 @@ from deeplearning4j_tpu.nlp.tokenization import (  # noqa: F401
     BertWordPieceTokenizer, CommonPreprocessor, DefaultTokenizerFactory)
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec  # noqa: F401
 from deeplearning4j_tpu.nlp.bert_iterator import BertIterator  # noqa: F401
+from deeplearning4j_tpu.nlp.glove import Glove  # noqa: F401
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors  # noqa: F401

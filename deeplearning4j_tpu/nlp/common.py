@@ -1,0 +1,60 @@
+"""Shared embedding-model plumbing (reference: the `WordVectors` /
+`SequenceVectors.Builder` interfaces in `deeplearning4j-nlp/.../models/
+embeddings/` that Word2Vec, GloVe and ParagraphVectors all extend)."""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+
+def kwargs_builder(target_cls, rename: Dict[str, str] = None):
+    """Reference-style fluent Builder: any `.setting(value)` call records a
+    constructor kwarg; `.build()` instantiates.  `rename` maps reference
+    builder method names onto constructor kwargs (e.g.
+    `elements_learning_algorithm` -> `elements_algo`)."""
+    rename = rename or {}
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def __getattr__(self, name):
+            def setter(v):
+                key = rename.get(name, name)
+                self._kw[key] = v.lower() if key in rename.values() \
+                    and isinstance(v, str) else v
+                return self
+
+            return setter
+
+        def build(self):
+            return target_cls(**self._kw)
+
+    return Builder
+
+
+class WordVectorsMixin:
+    """Cosine lookup API over a `[V, D]` table (reference `WordVectors`).
+    Subclasses expose `vocab`, `inv_vocab` and `_lookup_table()`."""
+
+    def _lookup_table(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def has_word(self, word: str) -> bool:
+        return word in self.vocab
+
+    def get_word_vector(self, word: str) -> np.ndarray:
+        return self._lookup_table()[self.vocab[word]]
+
+    def similarity(self, w1: str, w2: str) -> float:
+        a, b = self.get_word_vector(w1), self.get_word_vector(w2)
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+
+    def words_nearest(self, word: str, n: int = 10) -> List[str]:
+        table = self._lookup_table()
+        v = self.get_word_vector(word)
+        norms = np.linalg.norm(table, axis=1) + 1e-12
+        sims = table @ v / (norms * np.linalg.norm(v) + 1e-12)
+        return [self.inv_vocab[i] for i in np.argsort(-sims)
+                if self.inv_vocab[i] != word][:n]

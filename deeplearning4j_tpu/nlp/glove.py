@@ -1,0 +1,153 @@
+"""GloVe (reference `deeplearning4j-nlp/.../models/glove/{Glove,
+GloveWeightLookupTable,AbstractCoOccurrences}.java`; Pennington et al. 2014).
+
+TPU-native split: co-occurrence counting is host-side ETL (the reference's
+AbstractCoOccurrences shuffling threads collapse into one numpy pass over
+sentence windows), and training is ONE jitted AdaGrad step over batches of
+(i, j, X_ij) triples — weighted least squares
+f(X)(w_i·w̃_j + b_i + b̃_j − log X)², with gathers/scatters XLA fuses.
+"""
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.common import WordVectorsMixin, kwargs_builder
+from deeplearning4j_tpu.nlp.tokenization import (CommonPreprocessor,
+                                                 DefaultTokenizerFactory)
+
+
+class Glove(WordVectorsMixin):
+    """Builder mirrors the reference:
+
+        glove = (Glove.builder().min_word_frequency(2).layer_size(50)
+                 .window_size(5).x_max(10).alpha(0.75).epochs(20)
+                 .learning_rate(0.05).seed(7).build())
+        glove.fit(sentences)
+        glove.get_word_vector("day"); glove.words_nearest("day", 5)
+    """
+
+    def __init__(self, layer_size=50, window_size=5, min_word_frequency=2,
+                 learning_rate=0.05, epochs=25, batch_size=2048, x_max=10.0,
+                 alpha=0.75, symmetric=True, seed=42):
+        self.layer_size = layer_size
+        self.window_size = window_size
+        self.min_word_frequency = min_word_frequency
+        self.learning_rate = learning_rate
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.x_max = x_max
+        self.alpha = alpha
+        self.symmetric = symmetric
+        self.seed = seed
+        self.vocab: Dict[str, int] = {}
+        self.inv_vocab: Dict[int, str] = {}
+        self.vectors: Optional[np.ndarray] = None   # w + w̃ (paper's sum)
+        self._tok = DefaultTokenizerFactory(CommonPreprocessor())
+
+    @staticmethod
+    def builder():
+        return kwargs_builder(Glove)()
+
+    # ---- co-occurrence ETL (reference AbstractCoOccurrences) ----
+    def _cooccurrences(self, corpus: List[List[str]]):
+        counts = Counter(t for sent in corpus for t in sent)
+        words = [w for w, n in counts.most_common()
+                 if n >= self.min_word_frequency]
+        self.vocab = {w: i for i, w in enumerate(words)}
+        self.inv_vocab = {i: w for w, i in self.vocab.items()}
+        cooc: Dict[tuple, float] = {}
+        for sent in corpus:
+            ids = [self.vocab[t] for t in sent if t in self.vocab]
+            for pos, center in enumerate(ids):
+                lo = max(0, pos - self.window_size)
+                for j in range(lo, pos):
+                    # 1/d harmonic weighting, as the paper/reference
+                    w = 1.0 / (pos - j)
+                    cooc[(center, ids[j])] = cooc.get((center, ids[j]),
+                                                      0.0) + w
+                    if self.symmetric:
+                        cooc[(ids[j], center)] = cooc.get(
+                            (ids[j], center), 0.0) + w
+        if not cooc:
+            raise ValueError("No co-occurrences (corpus/vocab too small)")
+        ij = np.array(list(cooc.keys()), np.int32)
+        return ij[:, 0], ij[:, 1], np.array(list(cooc.values()), np.float32)
+
+    # ---- compiled AdaGrad step (reference GloveWeightLookupTable) ----
+    def _make_step(self):
+        lr = self.learning_rate
+        x_max, alpha = self.x_max, self.alpha
+
+        def step(params, grads_sq, wi, wj, xij):
+            def loss_fn(p):
+                W, Wc, b, bc = p
+                diff = (jnp.sum(W[wi] * Wc[wj], -1) + b[wi] + bc[wj]
+                        - jnp.log(xij))
+                fx = jnp.minimum((xij / x_max) ** alpha, 1.0)
+                return 0.5 * jnp.sum(fx * diff * diff)
+
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            new_p, new_gsq = [], []
+            for p, gi, acc in zip(params, g, grads_sq):
+                acc = acc + gi * gi
+                new_p.append(p - lr * gi / jnp.sqrt(acc + 1e-8))
+                new_gsq.append(acc)
+            return tuple(new_p), tuple(new_gsq), loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def fit(self, sentences: Sequence) -> "Glove":
+        corpus = [self._tok.tokenize(s) if isinstance(s, str) else list(s)
+                  for s in sentences]
+        wi, wj, xij = self._cooccurrences(corpus)
+        V, D = len(self.vocab), self.layer_size
+        rng = np.random.RandomState(self.seed)
+        params = tuple(jnp.asarray(a) for a in (
+            (rng.rand(V, D).astype(np.float32) - 0.5) / D,
+            (rng.rand(V, D).astype(np.float32) - 0.5) / D,
+            np.zeros(V, np.float32), np.zeros(V, np.float32)))
+        grads_sq = tuple(jnp.zeros_like(p) for p in params)
+        step = self._make_step()
+        bs = self.batch_size
+        n = len(wi)
+        for _ in range(self.epochs):
+            order = rng.permutation(n)
+            pad = (-n) % bs
+            if pad:
+                order = np.concatenate([order, rng.choice(n, pad)])
+            for i in range(0, len(order), bs):
+                sel = order[i:i + bs]
+                params, grads_sq, loss = step(params, grads_sq, wi[sel],
+                                              wj[sel], xij[sel])
+            self._last_loss = float(loss)
+        W, Wc = np.asarray(params[0]), np.asarray(params[1])
+        self.vectors = W + Wc
+        return self
+
+    # ---- lookup API (WordVectors interface parity) ----
+    def _lookup_table(self) -> np.ndarray:
+        return self.vectors
+
+    def save(self, path: str):
+        np.savez_compressed(path, vectors=self.vectors,
+                            vocab=json.dumps(self.vocab),
+                            config=json.dumps({
+                                "layer_size": self.layer_size,
+                                "window_size": self.window_size}))
+
+    @staticmethod
+    def load(path: str) -> "Glove":
+        with np.load(path, allow_pickle=False) as z:
+            cfg = json.loads(str(z["config"]))
+            g = Glove(layer_size=cfg["layer_size"],
+                      window_size=cfg["window_size"])
+            g.vocab = json.loads(str(z["vocab"]))
+            g.inv_vocab = {i: k for k, i in g.vocab.items()}
+            g.vectors = z["vectors"]
+        return g

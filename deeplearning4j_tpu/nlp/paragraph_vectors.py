@@ -1,0 +1,249 @@
+"""ParagraphVectors / doc2vec (reference `deeplearning4j-nlp/.../models/
+paragraphvectors/ParagraphVectors.java` + the DM/DBOW learners under
+`models/embeddings/learning/impl/sequence/`; Le & Mikolov 2014).
+
+Built on the word2vec substrate: a doc-vector table joins the word tables,
+and the same jitted negative-sampling step trains them — PV-DM (doc vector
++ window mean predicts the center word) or PV-DBOW (doc vector alone
+predicts sampled words).  `infer_vector` trains a fresh doc vector against
+frozen word tables, exactly the reference's `inferVector` flow, as one
+jitted loop."""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.common import kwargs_builder
+from deeplearning4j_tpu.nlp.tokenization import (CommonPreprocessor,
+                                                 DefaultTokenizerFactory)
+
+
+class ParagraphVectors:
+    """Builder mirrors the reference:
+
+        pv = (ParagraphVectors.builder().layer_size(64).window_size(4)
+              .min_word_frequency(1).sequence_learning_algorithm("dm")
+              .epochs(30).learning_rate(0.05).seed(3).build())
+        pv.fit(docs, labels)              # parallel lists
+        pv.infer_vector("some new text")
+        pv.nearest_labels("some new text", 3)
+    """
+
+    def __init__(self, layer_size=100, window_size=5, min_word_frequency=1,
+                 negative_sample=5, learning_rate=0.025, epochs=10,
+                 batch_size=1024, seed=42, sequence_algo="dm",
+                 infer_epochs=50):
+        self.layer_size = layer_size
+        self.window_size = window_size
+        self.min_word_frequency = min_word_frequency
+        self.negative = negative_sample
+        self.learning_rate = learning_rate
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+        self.sequence_algo = sequence_algo          # "dm" | "dbow"
+        self.infer_epochs = infer_epochs
+        self.vocab: Dict[str, int] = {}
+        self.labels: List[str] = []
+        self.doc_vectors: Optional[np.ndarray] = None
+        self.syn0: Optional[np.ndarray] = None
+        self.syn1: Optional[np.ndarray] = None
+        self.counts: Optional[np.ndarray] = None
+        self._tok = DefaultTokenizerFactory(CommonPreprocessor())
+
+    @staticmethod
+    def builder():
+        return kwargs_builder(
+            ParagraphVectors,
+            {"sequence_learning_algorithm": "sequence_algo"})()
+
+    # ---- ETL ----
+    def _build_vocab(self, corpus: List[List[str]]):
+        from collections import Counter
+        c = Counter(t for doc in corpus for t in doc)
+        words = [w for w, n in c.most_common()
+                 if n >= self.min_word_frequency]
+        self.vocab = {w: i for i, w in enumerate(words)}
+        self.counts = np.array([c[w] for w in words], np.float64)
+
+    def _examples(self, corpus, rng):
+        """(doc_id, ctx_ids [2w] padded, ctx_mask, center) rows.  For DBOW
+        the context is empty (mask 0) — only the doc vector predicts."""
+        W = 2 * self.window_size
+        docs, ctxs, masks, centers = [], [], [], []
+        for d, doc in enumerate(corpus):
+            ids = [self.vocab[t] for t in doc if t in self.vocab]
+            for pos, center in enumerate(ids):
+                row = np.zeros(W, np.int32)
+                msk = np.zeros(W, np.float32)
+                if self.sequence_algo == "dm":
+                    w = rng.randint(1, self.window_size + 1)
+                    window = [ids[pos + off] for off in range(-w, w + 1)
+                              if off != 0 and 0 <= pos + off < len(ids)]
+                    row[:len(window)] = window
+                    msk[:len(window)] = 1.0
+                docs.append(d)
+                ctxs.append(row)
+                masks.append(msk)
+                centers.append(center)
+        return (np.asarray(docs, np.int32), np.asarray(ctxs, np.int32),
+                np.asarray(masks, np.float32),
+                np.asarray(centers, np.int32))
+
+    # ---- compiled step ----
+    def _make_step(self, train_words: bool):
+        lr = self.learning_rate
+
+        def step(doc_vecs, syn0, syn1, doc, ctx, ctx_mask, center,
+                 negatives):
+            def loss_fn(p):
+                dv, s0, s1 = p
+                e = s0[ctx] * ctx_mask[..., None]
+                denom = jnp.sum(ctx_mask, 1, keepdims=True) + 1.0
+                v = (dv[doc] + jnp.sum(e, 1)) / denom     # doc + window mean
+                pos = jnp.sum(v * s1[center], -1)
+                negs = jnp.einsum("bd,bnd->bn", v, s1[negatives])
+                # MEAN over examples (sum over negatives): step size stays
+                # batch-size-invariant, so the fixed-shape padding (tiny
+                # inference docs pad heavily) cannot inflate the update
+                return -jnp.mean(jax.nn.log_sigmoid(pos)
+                                 + jnp.sum(jax.nn.log_sigmoid(-negs), -1))
+
+            loss, g = jax.value_and_grad(loss_fn)((doc_vecs, syn0, syn1))
+            gd, g0, g1 = g
+            doc_vecs = doc_vecs - lr * gd
+            if train_words:
+                syn0 = syn0 - lr * g0
+                syn1 = syn1 - lr * g1
+            return doc_vecs, syn0, syn1, loss
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def _neg_p(self):
+        p = self.counts ** 0.75
+        return p / p.sum()
+
+    def _step_for(self, train_words: bool):
+        # memoize the two jitted step variants: a fresh closure per
+        # infer_vector call would be a jit cache miss (full recompile)
+        if not hasattr(self, "_steps"):
+            self._steps = {}
+        if train_words not in self._steps:
+            self._steps[train_words] = self._make_step(train_words)
+        return self._steps[train_words]
+
+    def _run_training(self, doc_vecs, syn0, syn1, corpus, rng,
+                      train_words: bool, epochs: int):
+        step = self._step_for(train_words)
+        neg_p = self._neg_p()
+        bs = min(self.batch_size, 4096)
+        for _ in range(epochs):
+            docs, ctxs, masks, centers = self._examples(corpus, rng)
+            if len(docs) == 0:
+                raise ValueError("No training examples (vocab too small)")
+            order = rng.permutation(len(docs))
+            pad = (-len(order)) % bs
+            if pad:
+                order = np.concatenate([order,
+                                        rng.choice(len(docs), pad)])
+            for i in range(0, len(order), bs):
+                sel = order[i:i + bs]
+                negs = rng.choice(len(neg_p), size=(bs, self.negative),
+                                  p=neg_p).astype(np.int32)
+                doc_vecs, syn0, syn1, loss = step(
+                    doc_vecs, syn0, syn1, docs[sel], ctxs[sel], masks[sel],
+                    centers[sel], negs)
+        return doc_vecs, syn0, syn1
+
+    # ---- fit ----
+    def fit(self, documents: Sequence, labels: Optional[Sequence[str]] = None
+            ) -> "ParagraphVectors":
+        corpus = [self._tok.tokenize(d) if isinstance(d, str) else list(d)
+                  for d in documents]
+        self.labels = list(labels) if labels is not None else [
+            f"DOC_{i}" for i in range(len(corpus))]
+        if len(self.labels) != len(corpus):
+            raise ValueError("labels/documents length mismatch")
+        self._build_vocab(corpus)
+        if not self.vocab:
+            raise ValueError("Empty vocabulary: lower min_word_frequency")
+        rng = np.random.RandomState(self.seed)
+        V, D, N = len(self.vocab), self.layer_size, len(corpus)
+        doc_vecs = jnp.asarray((rng.rand(N, D) - 0.5) / D, jnp.float32)
+        syn0 = jnp.asarray((rng.rand(V, D) - 0.5) / D, jnp.float32)
+        syn1 = jnp.zeros((V, D), jnp.float32)
+        doc_vecs, syn0, syn1 = self._run_training(
+            doc_vecs, syn0, syn1, corpus, rng, train_words=True,
+            epochs=self.epochs)
+        self.doc_vectors = np.asarray(doc_vecs)
+        self.syn0 = np.asarray(syn0)
+        self.syn1 = np.asarray(syn1)
+        return self
+
+    # ---- inference (reference `inferVector`) ----
+    def infer_vector(self, text) -> np.ndarray:
+        tokens = self._tok.tokenize(text) if isinstance(text, str) \
+            else list(text)
+        corpus = [tokens]
+        rng = np.random.RandomState(self.seed + 1)
+        dv = jnp.asarray((rng.rand(1, self.layer_size) - 0.5)
+                         / self.layer_size, jnp.float32)
+        dv, _, _ = self._run_training(
+            dv, jnp.asarray(self.syn0), jnp.asarray(self.syn1), corpus,
+            rng, train_words=False, epochs=self.infer_epochs)
+        return np.asarray(dv)[0]
+
+    # ---- lookup (reference LabelSeeker / nearestLabels) ----
+    def get_doc_vector(self, label: str) -> np.ndarray:
+        return self.doc_vectors[self.labels.index(label)]
+
+    def similarity_to_label(self, text, label: str) -> float:
+        v = self.infer_vector(text)
+        d = self.get_doc_vector(label)
+        return float(v @ d / (np.linalg.norm(v) * np.linalg.norm(d)
+                              + 1e-12))
+
+    def nearest_labels(self, text, n: int = 5) -> List[str]:
+        v = self.infer_vector(text)
+        norms = np.linalg.norm(self.doc_vectors, axis=1) + 1e-12
+        sims = self.doc_vectors @ v / (norms * np.linalg.norm(v) + 1e-12)
+        return [self.labels[i] for i in np.argsort(-sims)[:n]]
+
+    # ---- persistence ----
+    def save(self, path: str):
+        np.savez_compressed(
+            path, doc_vectors=self.doc_vectors, syn0=self.syn0,
+            syn1=self.syn1, counts=self.counts,
+            vocab=json.dumps(self.vocab), labels=json.dumps(self.labels),
+            config=json.dumps({"layer_size": self.layer_size,
+                               "window_size": self.window_size,
+                               "sequence_algo": self.sequence_algo,
+                               "learning_rate": self.learning_rate,
+                               "infer_epochs": self.infer_epochs,
+                               "negative_sample": self.negative,
+                               "batch_size": self.batch_size,
+                               "seed": self.seed}))
+
+    @staticmethod
+    def load(path: str) -> "ParagraphVectors":
+        with np.load(path, allow_pickle=False) as z:
+            cfg = json.loads(str(z["config"]))
+            pv = ParagraphVectors(
+                layer_size=cfg["layer_size"],
+                window_size=cfg["window_size"],
+                sequence_algo=cfg["sequence_algo"],
+                learning_rate=cfg.get("learning_rate", 0.025),
+                infer_epochs=cfg.get("infer_epochs", 50),
+                negative_sample=cfg.get("negative_sample", 5),
+                batch_size=cfg.get("batch_size", 1024),
+                seed=cfg.get("seed", 42))
+            pv.vocab = json.loads(str(z["vocab"]))
+            pv.labels = json.loads(str(z["labels"]))
+            pv.doc_vectors = z["doc_vectors"]
+            pv.syn0, pv.syn1 = z["syn0"], z["syn1"]
+            pv.counts = z["counts"]
+        return pv

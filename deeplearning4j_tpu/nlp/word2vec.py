@@ -18,11 +18,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.nlp.common import WordVectorsMixin, kwargs_builder
 from deeplearning4j_tpu.nlp.tokenization import (CommonPreprocessor,
                                                  DefaultTokenizerFactory)
 
 
-class Word2Vec:
+class Word2Vec(WordVectorsMixin):
     """Skip-gram / CBOW with negative sampling.
 
     Builder mirrors the reference:
@@ -63,26 +64,10 @@ class Word2Vec:
         self._tok = DefaultTokenizerFactory(CommonPreprocessor())
 
     # ---- builder ----
-    class Builder:
-        def __init__(self):
-            self._kw = {}
-
-        def __getattr__(self, name):
-            def setter(v):
-                self._kw[name] = v
-                return self
-            return setter
-
-        def build(self) -> "Word2Vec":
-            kw = dict(self._kw)
-            algo = kw.pop("elements_learning_algorithm", None)
-            if algo:
-                kw["elements_algo"] = algo.lower()
-            return Word2Vec(**kw)
-
     @staticmethod
-    def builder() -> "Word2Vec.Builder":
-        return Word2Vec.Builder()
+    def builder():
+        return kwargs_builder(
+            Word2Vec, {"elements_learning_algorithm": "elements_algo"})()
 
     # ---- vocab ----
     def _build_vocab(self, corpus: List[List[str]]):
@@ -245,23 +230,8 @@ class Word2Vec:
         return self
 
     # ---- lookup API (reference WordVectors interface) ----
-    def has_word(self, word: str) -> bool:
-        return word in self.vocab
-
-    def get_word_vector(self, word: str) -> np.ndarray:
-        return self.syn0[self.vocab[word]]
-
-    def similarity(self, w1: str, w2: str) -> float:
-        a, b = self.get_word_vector(w1), self.get_word_vector(w2)
-        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
-
-    def words_nearest(self, word: str, n: int = 10) -> List[str]:
-        v = self.get_word_vector(word)
-        norms = np.linalg.norm(self.syn0, axis=1) + 1e-12
-        sims = self.syn0 @ v / (norms * np.linalg.norm(v) + 1e-12)
-        idx = np.argsort(-sims)
-        return [self.inv_vocab[i] for i in idx
-                if self.inv_vocab[i] != word][:n]
+    def _lookup_table(self) -> np.ndarray:
+        return self.syn0
 
     # ---- persistence (reference WordVectorSerializer) ----
     def save(self, path: str):

@@ -13,6 +13,13 @@ from deeplearning4j_tpu.nn.recurrent import (  # noqa: F401
     RnnOutputLayer, SimpleRnn)
 from deeplearning4j_tpu.nn.attention import (  # noqa: F401
     LearnedSelfAttentionLayer, RecurrentAttentionLayer, SelfAttentionLayer)
+from deeplearning4j_tpu.nn.objdetect import (  # noqa: F401
+    DetectedObject, SpaceToDepthLayer, Yolo2OutputLayer, YoloUtils)
+from deeplearning4j_tpu.nn.layers_extra import (  # noqa: F401
+    CenterLossOutputLayer, Convolution3DLayer, Cropping1DLayer,
+    Cropping2DLayer, Cropping3DLayer, Deconvolution3DLayer,
+    LocallyConnected1DLayer, LocallyConnected2DLayer, PReLULayer,
+    Subsampling1DLayer, Subsampling3DLayer)
 from deeplearning4j_tpu.nn.multilayer import (  # noqa: F401
     MultiLayerConfiguration, MultiLayerNetwork, NeuralNetConfiguration)
 from deeplearning4j_tpu.nn.graph import (  # noqa: F401
@@ -32,6 +39,11 @@ _LAYER_CLASSES = [
     Bidirectional, GravesLSTM, LastTimeStep, LSTM, RnnLossLayer,
     RnnOutputLayer, SimpleRnn,
     LearnedSelfAttentionLayer, RecurrentAttentionLayer, SelfAttentionLayer,
+    SpaceToDepthLayer, Yolo2OutputLayer,
+    CenterLossOutputLayer, Convolution3DLayer, Cropping1DLayer,
+    Cropping2DLayer, Cropping3DLayer, Deconvolution3DLayer,
+    LocallyConnected1DLayer, LocallyConnected2DLayer, PReLULayer,
+    Subsampling1DLayer, Subsampling3DLayer,
 ]
 
 # Name -> class registry for config JSON round-trip (the reference's Jackson

@@ -1,0 +1,405 @@
+"""Extended layer zoo: 3-D convolution/pooling, cropping, locally-connected,
+PReLU, center-loss head.
+
+Reference configs under `deeplearning4j-nn/.../nn/conf/layers/`:
+`Convolution3D`, `Deconvolution3D`, `Subsampling1DLayer`,
+`Subsampling3DLayer`, `Cropping1D/2D/3D`, `LocallyConnected1D/2D`,
+`PReLULayer`, `CenterLossOutputLayer` (the FaceNet head in
+`InceptionResNetV1.java`).
+
+TPU notes: 3-D convs run NDHWC/DHWIO through `lax.conv_general_dilated`
+(XLA tiles the contraction onto the MXU exactly as 2-D); locally-connected
+layers extract patches once and contract with an unshared [spatial, patch,
+out] kernel in a single einsum — no per-position loop.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.core import InputType, Layer
+from deeplearning4j_tpu.ops.initializers import init_weights
+
+
+def _triple(v) -> Tuple[int, int, int]:
+    if isinstance(v, (tuple, list)):
+        return int(v[0]), int(v[1]), int(v[2])
+    return (int(v),) * 3
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return int(v[0]), int(v[1])
+    return int(v), int(v)
+
+
+def _out_dim(size, k, s, p, same: bool):
+    if size is None:
+        return None
+    if same:
+        return -(-size // s)
+    return (size + 2 * p - k) // s + 1
+
+
+@dataclasses.dataclass(kw_only=True)
+class Convolution3DLayer(Layer):
+    """3-D convolution over [B, D, H, W, C] (reference `Convolution3D`;
+    data_format NDHWC)."""
+
+    n_out: int = 0
+    kernel_size: Any = (3, 3, 3)
+    stride: Any = (1, 1, 1)
+    padding: Any = (0, 0, 0)
+    dilation: Any = (1, 1, 1)
+    convolution_mode: str = "Truncate"
+    has_bias: bool = True
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        d, h, w, c = input_type.shape
+        kd, kh, kw = _triple(self.kernel_size)
+        params = {"W": init_weights(rng, (kd, kh, kw, c, self.n_out),
+                                    self.winit("RELU"), dtype)}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        same = self.convolution_mode.lower() == "same"
+        sd, sh, sw = _triple(self.stride)
+        pd, ph, pw = _triple(self.padding)
+        out = InputType.convolutional3d(
+            _out_dim(d, kd, sd, pd, same), _out_dim(h, kh, sh, ph, same),
+            _out_dim(w, kw, sw, pw, same), self.n_out)
+        return params, {}, out
+
+    def _padding_arg(self):
+        if self.convolution_mode.lower() == "same":
+            return "SAME"
+        return [(p, p) for p in _triple(self.padding)]
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_input_dropout(x, train, rng)
+        y = lax.conv_general_dilated(
+            x, params["W"], window_strides=_triple(self.stride),
+            padding=self._padding_arg(), rhs_dilation=_triple(self.dilation),
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if self.has_bias:
+            y = y + params["b"]
+        return self.act_fn()(y), state
+
+
+@dataclasses.dataclass(kw_only=True)
+class Deconvolution3DLayer(Layer):
+    """3-D transpose convolution (reference `Deconvolution3D`)."""
+
+    n_out: int = 0
+    kernel_size: Any = (2, 2, 2)
+    stride: Any = (2, 2, 2)
+    convolution_mode: str = "Truncate"
+    has_bias: bool = True
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        d, h, w, c = input_type.shape
+        kd, kh, kw = _triple(self.kernel_size)
+        params = {"W": init_weights(rng, (kd, kh, kw, c, self.n_out),
+                                    self.winit("RELU"), dtype)}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        sd, sh, sw = _triple(self.stride)
+        same = self.convolution_mode.lower() == "same"
+
+        def up(size, k, s):
+            if size is None:
+                return None
+            return size * s if same else (size - 1) * s + k
+        out = InputType.convolutional3d(up(d, kd, sd), up(h, kh, sh),
+                                        up(w, kw, sw), self.n_out)
+        return params, {}, out
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_input_dropout(x, train, rng)
+        pad = "SAME" if self.convolution_mode.lower() == "same" else "VALID"
+        y = lax.conv_transpose(
+            x, params["W"], strides=_triple(self.stride), padding=pad,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if self.has_bias:
+            y = y + params["b"]
+        return self.act_fn()(y), state
+
+
+def _pool_nd(x, kind, window, strides, padding):
+    if kind.upper() == "MAX":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, strides, padding)
+    s = lax.reduce_window(x, jnp.zeros((), x.dtype), lax.add, window,
+                          strides, padding)
+    if padding == "VALID":
+        denom = 1
+        for w in window:
+            denom *= w
+        return s / denom
+    # SAME: divide by the count of VALID elements per window so padded edge
+    # windows aren't underscaled (matches the 2-D SubsamplingLayer)
+    cnt = lax.reduce_window(jnp.ones_like(x), jnp.zeros((), x.dtype),
+                            lax.add, window, strides, padding)
+    return s / cnt
+
+
+@dataclasses.dataclass(kw_only=True)
+class Subsampling1DLayer(Layer):
+    """1-D pooling over [B, T, F] (reference `Subsampling1DLayer`)."""
+
+    pooling_type: str = "MAX"
+    kernel_size: int = 2
+    stride: int = 2
+    convolution_mode: str = "Truncate"
+    REGULARIZABLE: Tuple[str, ...] = ()
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        t, f = input_type.shape
+        same = self.convolution_mode.lower() == "same"
+        t = _out_dim(t, int(self.kernel_size), int(self.stride), 0, same)
+        return {}, {}, InputType.recurrent(f, t)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        pad = "SAME" if self.convolution_mode.lower() == "same" else "VALID"
+        y = _pool_nd(x, self.pooling_type,
+                     (1, int(self.kernel_size), 1),
+                     (1, int(self.stride), 1), pad)
+        return y, state
+
+
+@dataclasses.dataclass(kw_only=True)
+class Subsampling3DLayer(Layer):
+    """3-D pooling over [B, D, H, W, C] (reference `Subsampling3DLayer`)."""
+
+    pooling_type: str = "MAX"
+    kernel_size: Any = (2, 2, 2)
+    stride: Any = (2, 2, 2)
+    convolution_mode: str = "Truncate"
+    REGULARIZABLE: Tuple[str, ...] = ()
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        d, h, w, c = input_type.shape
+        kd, kh, kw = _triple(self.kernel_size)
+        sd, sh, sw = _triple(self.stride)
+        same = self.convolution_mode.lower() == "same"
+        return {}, {}, InputType.convolutional3d(
+            _out_dim(d, kd, sd, 0, same), _out_dim(h, kh, sh, 0, same),
+            _out_dim(w, kw, sw, 0, same), c)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        pad = "SAME" if self.convolution_mode.lower() == "same" else "VALID"
+        y = _pool_nd(x, self.pooling_type,
+                     (1,) + _triple(self.kernel_size) + (1,),
+                     (1,) + _triple(self.stride) + (1,), pad)
+        return y, state
+
+
+@dataclasses.dataclass(kw_only=True)
+class Cropping1DLayer(Layer):
+    """Crop timesteps: [B, T, F] -> [B, T-top-bottom, F] (reference
+    `Cropping1D`)."""
+
+    cropping: Any = (0, 0)
+    REGULARIZABLE: Tuple[str, ...] = ()
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        t, f = input_type.shape
+        a, b = _pair(self.cropping)
+        return {}, {}, InputType.recurrent(
+            f, None if t is None else t - a - b)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        a, b = _pair(self.cropping)
+        return x[:, a: x.shape[1] - b], state
+
+
+@dataclasses.dataclass(kw_only=True)
+class Cropping2DLayer(Layer):
+    """Crop H/W (reference `Cropping2D`): cropping = (top, bottom, left,
+    right) or a single symmetric value."""
+
+    cropping: Any = (0, 0, 0, 0)
+    REGULARIZABLE: Tuple[str, ...] = ()
+
+    def _crops(self):
+        c = self.cropping
+        if isinstance(c, int):
+            return c, c, c, c
+        if len(c) == 2:
+            return c[0], c[0], c[1], c[1]
+        return tuple(int(v) for v in c)
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        h, w, ch = input_type.shape
+        t, b, l, r = self._crops()
+        return {}, {}, InputType.convolutional(h - t - b, w - l - r, ch)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        t, b, l, r = self._crops()
+        return x[:, t: x.shape[1] - b, l: x.shape[2] - r], state
+
+
+@dataclasses.dataclass(kw_only=True)
+class Cropping3DLayer(Layer):
+    """Crop D/H/W (reference `Cropping3D`): (d0, d1, h0, h1, w0, w1)."""
+
+    cropping: Any = (0, 0, 0, 0, 0, 0)
+    REGULARIZABLE: Tuple[str, ...] = ()
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        d, h, w, c = input_type.shape
+        d0, d1, h0, h1, w0, w1 = (int(v) for v in self.cropping)
+        return {}, {}, InputType.convolutional3d(d - d0 - d1, h - h0 - h1,
+                                                 w - w0 - w1, c)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        d0, d1, h0, h1, w0, w1 = (int(v) for v in self.cropping)
+        return x[:, d0: x.shape[1] - d1, h0: x.shape[2] - h1,
+                 w0: x.shape[3] - w1], state
+
+
+@dataclasses.dataclass(kw_only=True)
+class LocallyConnected2DLayer(Layer):
+    """Unshared-weight 2-D conv (reference `LocallyConnected2D`): one
+    kernel PER output position.  Patches extracted once, contracted with a
+    [OH, OW, KH*KW*C, n_out] kernel in a single einsum."""
+
+    n_out: int = 0
+    kernel_size: Any = (3, 3)
+    stride: Any = (1, 1)
+    has_bias: bool = True
+
+    def _out_hw(self, h, w):
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        return (h - kh) // sh + 1, (w - kw) // sw + 1
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        h, w, c = input_type.shape
+        kh, kw = _pair(self.kernel_size)
+        oh, ow = self._out_hw(h, w)
+        params = {"W": init_weights(rng, (oh, ow, kh * kw * c, self.n_out),
+                                    self.winit("RELU"), dtype)
+                  / jnp.sqrt(1.0 * kh * kw)}
+        if self.has_bias:
+            params["b"] = jnp.full((oh, ow, self.n_out), self.bias_init,
+                                   dtype)
+        return params, {}, InputType.convolutional(oh, ow, self.n_out)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_input_dropout(x, train, rng)
+        kh, kw = _pair(self.kernel_size)
+        patches = lax.conv_general_dilated_patches(
+            x, (kh, kw), _pair(self.stride), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        y = jnp.einsum("bhwp,hwpo->bhwo", patches, params["W"])
+        if self.has_bias:
+            y = y + params["b"]
+        return self.act_fn()(y), state
+
+
+@dataclasses.dataclass(kw_only=True)
+class LocallyConnected1DLayer(Layer):
+    """Unshared-weight 1-D conv over [B, T, F] (reference
+    `LocallyConnected1D`)."""
+
+    n_out: int = 0
+    kernel_size: int = 3
+    stride: int = 1
+    has_bias: bool = True
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        t, f = input_type.shape
+        k, s = int(self.kernel_size), int(self.stride)
+        if t is None:
+            raise ValueError("LocallyConnected1D needs a static sequence "
+                             "length (unshared weights are per-position)")
+        ot = (t - k) // s + 1
+        params = {"W": init_weights(rng, (ot, k * f, self.n_out),
+                                    self.winit("RELU"), dtype)
+                  / jnp.sqrt(1.0 * k)}
+        if self.has_bias:
+            params["b"] = jnp.full((ot, self.n_out), self.bias_init, dtype)
+        return params, {}, InputType.recurrent(self.n_out, ot)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_input_dropout(x, train, rng)
+        k, s = int(self.kernel_size), int(self.stride)
+        patches = lax.conv_general_dilated_patches(
+            x, (k,), (s,), "VALID", dimension_numbers=("NWC", "WIO", "NWC"))
+        y = jnp.einsum("btp,tpo->bto", patches, params["W"])
+        if self.has_bias:
+            y = y + params["b"]
+        return self.act_fn()(y), state
+
+
+@dataclasses.dataclass(kw_only=True)
+class PReLULayer(Layer):
+    """Parametric ReLU with a learnable per-feature slope (reference
+    `PReLULayer`)."""
+
+    alpha_init: float = 0.25
+    shared_axes: Optional[Tuple[int, ...]] = None
+    REGULARIZABLE: Tuple[str, ...] = ()
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        # dynamic (None) dims share their slope — broadcastable size 1
+        shape = [1 if s is None else s for s in input_type.shape]
+        if self.shared_axes:
+            for ax in self.shared_axes:      # 1-based over non-batch dims
+                shape[ax - 1] = 1
+        params = {"alpha": jnp.full(tuple(shape), self.alpha_init, dtype)}
+        return params, {}, input_type
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return jnp.where(x >= 0, x, params["alpha"] * x), state
+
+
+@dataclasses.dataclass(kw_only=True)
+class CenterLossOutputLayer(Layer):
+    """Softmax + center loss head (reference `CenterLossOutputLayer`, the
+    InceptionResNetV1/FaceNet pairing; Wen et al. 2016).
+
+    loss = CE(softmax(xW+b), y) + lambda/2 * mean ||f - c_y||^2.
+
+    The class centers are a parameter driven by the SAME gradient step
+    (d/dc of the center term = lambda*(c_y - f) per assigned sample) — the
+    alpha-EMA of the reference collapses into the updater's learning rate,
+    trading its separate schedule for one fused XLA step."""
+
+    n_out: int = 0
+    alpha: float = 0.05            # kept for config parity (see docstring)
+    lambda_: float = 0.5
+    gradient_check: bool = False
+    REGULARIZABLE: Tuple[str, ...] = ("W",)
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        f = input_type.shape[-1]
+        k1, _ = jax.random.split(rng)
+        params = {"W": init_weights(k1, (f, self.n_out),
+                                    self.winit("XAVIER"), dtype),
+                  "b": jnp.zeros((self.n_out,), dtype),
+                  "centers": jnp.zeros((self.n_out, f), dtype)}
+        return params, {}, InputType.feed_forward(self.n_out)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        return jax.nn.softmax(x @ params["W"] + params["b"], axis=-1), state
+
+    def compute_loss(self, params, state, x, labels, *, train=True,
+                     rng=None, mask=None):
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        f32 = jnp.promote_types(x.dtype, jnp.float32)
+        feats = x.astype(f32)
+        logits = feats @ params["W"].astype(f32) + params["b"].astype(f32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ce = -jnp.mean(jnp.sum(labels * logp, axis=-1))
+        assigned = labels @ params["centers"].astype(f32)   # c_{y_i}
+        center = 0.5 * jnp.mean(jnp.sum((feats - assigned) ** 2, axis=-1))
+        return ce + self.lambda_ * center

@@ -1,0 +1,200 @@
+"""Object detection: YOLOv2 output layer + box utilities.
+
+Reference: `deeplearning4j-nn/.../layers/objdetect/{Yolo2OutputLayer,
+YoloUtils}.java` and `conf/layers/objdetect/Yolo2OutputLayer.java` —
+anchor-based single-shot detection loss (Redmon & Farhadi 2016) plus
+decode/NMS helpers; `conf/layers/SpaceToDepthLayer.java` is the passthrough
+reorg used by full YOLOv2.
+
+TPU design notes: the loss is pure elementwise/reduction math over the
+[B, H, W, A, 5+C] head tensor — one fused XLA kernel, no per-box host
+loop (the reference iterates boxes on the JVM to build its mask tensors;
+here masks arrive rasterized in the label tensor).  Decode is jittable;
+NMS runs host-side on the few boxes that survive confidence filtering, as
+the reference's `YoloUtils.getPredictedObjects` does.
+
+Label format (documented contract, simpler than the reference's
+[mb, 4+C, H, W] rasterized boxes but equivalent in content):
+`[B, H, W, A, 5 + C]` per anchor slot —
+  [0:2] tx, ty   target center offsets within the cell, in (0, 1)
+  [2:4] tw, th   log-space size targets: log(box / anchor)
+  [4]   objectness indicator (1 where a box is assigned to this anchor)
+  [5:]  one-hot class
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.core import InputType, Layer
+
+
+@dataclasses.dataclass(kw_only=True)
+class SpaceToDepthLayer(Layer):
+    """[B,H,W,C] -> [B,H/b,W/b,C*b*b] (reference `SpaceToDepthLayer`; the
+    YOLOv2 passthrough/reorg)."""
+
+    block_size: int = 2
+    REGULARIZABLE: Tuple[str, ...] = ()
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        h, w, c = input_type.shape
+        b = self.block_size
+        if h % b or w % b:
+            raise ValueError(f"SpaceToDepth: {h}x{w} not divisible by {b}")
+        return {}, {}, InputType.convolutional(h // b, w // b, c * b * b)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        b = self.block_size
+        B, H, W, C = x.shape
+        x = x.reshape(B, H // b, b, W // b, b, C)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(B, H // b, W // b,
+                                                  b * b * C)
+        return x, state
+
+
+@dataclasses.dataclass(kw_only=True)
+class Yolo2OutputLayer(Layer):
+    """YOLOv2 detection head loss (reference objdetect `Yolo2OutputLayer`).
+
+    Consumes the conv head's raw [B, H, W, A*(5+C)] activations and the
+    rasterized label tensor (module docstring).  Loss terms follow the
+    paper/reference: lambda_coord * coord MSE (xy after sigmoid, wh in log
+    space), objectness MSE split by lambda_noobj, and per-assigned-anchor
+    class cross-entropy."""
+
+    anchors: Sequence[Tuple[float, float]] = ((1.0, 1.0),)
+    n_classes: int = 1
+    lambda_coord: float = 5.0
+    lambda_noobj: float = 0.5
+    REGULARIZABLE: Tuple[str, ...] = ()
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        h, w, c = input_type.shape
+        need = len(self.anchors) * (5 + self.n_classes)
+        if c != need:
+            raise ValueError(
+                f"Yolo2OutputLayer expects {need} channels "
+                f"({len(self.anchors)} anchors x (5+{self.n_classes})), "
+                f"got {c}")
+        return {}, {}, input_type
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return x, state        # raw head; decode via YoloUtils
+
+    def _split(self, x):
+        B, H, W, _ = x.shape
+        A = len(self.anchors)
+        p = x.reshape(B, H, W, A, 5 + self.n_classes)
+        return (jax.nn.sigmoid(p[..., 0:2]), p[..., 2:4],
+                jax.nn.sigmoid(p[..., 4]), p[..., 5:])
+
+    def compute_loss(self, params, state, x, labels, *, train=True,
+                     rng=None, mask=None):
+        x = x.astype(jnp.promote_types(x.dtype, jnp.float32))
+        labels = labels.reshape(x.shape[0], x.shape[1], x.shape[2],
+                                len(self.anchors), 5 + self.n_classes)
+        pxy, pwh, pobj, plogits = self._split(x)
+        lxy = labels[..., 0:2]
+        lwh = labels[..., 2:4]
+        lobj = labels[..., 4]
+        lcls = labels[..., 5:]
+        B = x.shape[0]
+
+        coord = jnp.sum(lobj[..., None] * ((pxy - lxy) ** 2
+                                           + (pwh - lwh) ** 2))
+        obj = jnp.sum(lobj * (pobj - 1.0) ** 2) \
+            + self.lambda_noobj * jnp.sum((1.0 - lobj) * pobj ** 2)
+        logp = jax.nn.log_softmax(plogits, axis=-1)
+        cls = -jnp.sum(lobj * jnp.sum(lcls * logp, axis=-1))
+        return (self.lambda_coord * coord + obj + cls) / B
+
+
+class DetectedObject:
+    """One decoded detection (reference `DetectedObject`)."""
+
+    def __init__(self, center_x, center_y, width, height, cls, confidence):
+        self.center_x = float(center_x)
+        self.center_y = float(center_y)
+        self.width = float(width)
+        self.height = float(height)
+        self.predicted_class = int(cls)
+        self.confidence = float(confidence)
+
+    def box(self):
+        return (self.center_x - self.width / 2,
+                self.center_y - self.height / 2,
+                self.center_x + self.width / 2,
+                self.center_y + self.height / 2)
+
+    def __repr__(self):
+        return (f"DetectedObject(cls={self.predicted_class}, "
+                f"conf={self.confidence:.3f}, cx={self.center_x:.2f}, "
+                f"cy={self.center_y:.2f})")
+
+
+class YoloUtils:
+    """Decode + NMS (reference `YoloUtils`)."""
+
+    @staticmethod
+    def decode(head: jnp.ndarray, anchors, n_classes: int):
+        """Raw head [B,H,W,A*(5+C)] -> (boxes [B,H,W,A,4] in grid units
+        (cx, cy, w, h), confidence [B,H,W,A], class probs [B,H,W,A,C]).
+        Jittable."""
+        B, H, W, _ = head.shape
+        A = len(anchors)
+        p = head.reshape(B, H, W, A, 5 + n_classes)
+        cy, cx = jnp.meshgrid(jnp.arange(H), jnp.arange(W), indexing="ij")
+        grid = jnp.stack([cx, cy], axis=-1)[None, :, :, None, :]
+        anc = jnp.asarray(anchors, jnp.float32)[None, None, None, :, :]
+        xy = jax.nn.sigmoid(p[..., 0:2]) + grid
+        wh = anc * jnp.exp(p[..., 2:4])
+        conf = jax.nn.sigmoid(p[..., 4])
+        probs = jax.nn.softmax(p[..., 5:], axis=-1)
+        return jnp.concatenate([xy, wh], axis=-1), conf, probs
+
+    @staticmethod
+    def iou(a, b) -> float:
+        ax1, ay1, ax2, ay2 = a
+        bx1, by1, bx2, by2 = b
+        iw = max(0.0, min(ax2, bx2) - max(ax1, bx1))
+        ih = max(0.0, min(ay2, by2) - max(ay1, by1))
+        inter = iw * ih
+        ua = (ax2 - ax1) * (ay2 - ay1) + (bx2 - bx1) * (by2 - by1) - inter
+        return inter / ua if ua > 0 else 0.0
+
+    @staticmethod
+    def get_predicted_objects(head, anchors, n_classes,
+                              conf_threshold: float = 0.5,
+                              nms_threshold: float = 0.4
+                              ) -> List[List[DetectedObject]]:
+        """Confidence-filter, then per-class greedy NMS on the host (the
+        device work — decode — stays jitted)."""
+        boxes, conf, probs = YoloUtils.decode(jnp.asarray(head), anchors,
+                                              n_classes)
+        boxes = np.asarray(boxes)
+        conf = np.asarray(conf)
+        probs = np.asarray(probs)
+        out: List[List[DetectedObject]] = []
+        for bi in range(boxes.shape[0]):
+            cand: List[DetectedObject] = []
+            sel = np.argwhere(conf[bi] > conf_threshold)
+            for (y, x, a) in sel:
+                cx, cy, w, h = boxes[bi, y, x, a]
+                cls = int(np.argmax(probs[bi, y, x, a]))
+                cand.append(DetectedObject(
+                    cx, cy, w, h, cls,
+                    conf[bi, y, x, a] * probs[bi, y, x, a, cls]))
+            cand.sort(key=lambda d: -d.confidence)
+            kept: List[DetectedObject] = []
+            for d in cand:
+                if all(d.predicted_class != k.predicted_class
+                       or YoloUtils.iou(d.box(), k.box()) < nms_threshold
+                       for k in kept):
+                    kept.append(d)
+            out.append(kept)
+        return out

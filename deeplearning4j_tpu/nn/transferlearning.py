@@ -233,17 +233,19 @@ class TransferLearning:
             if weight_init:
                 v.layer.weight_init = weight_init
             self._reinit.add(layer_name)
-            # the width change propagates through parameterless vertices
-            # (Merge/ElementWise/Scale/...) until absorbed by the next
-            # parameterized layer, which must re-initialize
+            # the width change propagates until absorbed by a layer that
+            # SETS its own output width (has n_out: Dense/Conv/Output/...);
+            # everything else — Merge/ElementWise/Activation/BatchNorm/
+            # pooling — passes the width through and re-initializes
             frontier = [layer_name]
             while frontier:
                 src = frontier.pop()
                 for consumer, ins in self._conf.vertex_inputs.items():
                     if src in ins and consumer not in self._reinit:
                         self._reinit.add(consumer)
-                        if not isinstance(self._conf.vertices[consumer],
-                                          LayerVertex):
+                        c_layer = getattr(self._conf.vertices[consumer],
+                                          "layer", None)
+                        if c_layer is None or not hasattr(c_layer, "n_out"):
                             frontier.append(consumer)
             return self
 

@@ -122,9 +122,10 @@ def child_env(coordinator: str, num_processes: int, process_id: int,
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     # prepend (don't clobber) so parent-supplied deps stay importable; drop
-    # only single-chip plugin path entries
+    # only the single-chip plugin's own site dir, not arbitrary paths that
+    # merely contain similar substrings
     inherited = [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
-                 if p and "axon" not in p]
+                 if p and "/.axon_site" not in p]
     env["PYTHONPATH"] = os.pathsep.join([repo_root] + inherited)
     env["JAX_PLATFORMS"] = platform
     if platform == "cpu":

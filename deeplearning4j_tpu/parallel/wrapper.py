@@ -215,10 +215,13 @@ class ParallelWrapper:
             x = _shard_batch(data, self.mesh, self.data_axis)
             y = _shard_batch(labels, self.mesh, self.data_axis)
             with self.mesh:
-                m.fit(x, y)
+                for _ in range(epochs):
+                    m.fit(x, y)
             return self
         if hasattr(data, "features"):              # bare DataSet/MultiDataSet
-            self._fit_ds(data)
+            for _ in range(epochs):
+                self._fit_ds(data)
+                m.epoch += 1
             return self
         for _ in range(epochs):
             if hasattr(data, "reset"):

@@ -5,3 +5,5 @@ from deeplearning4j_tpu.rl.policy import (  # noqa: F401
     EpsGreedy, GreedyPolicy)
 from deeplearning4j_tpu.rl.qlearning import (  # noqa: F401
     QLearningConfiguration, QLearningDiscrete)
+from deeplearning4j_tpu.rl.async_learning import (  # noqa: F401
+    A3CDiscrete, AsyncConfiguration, AsyncNStepQLearningDiscrete)

@@ -1,0 +1,282 @@
+"""Asynchronous-family RL: advantage actor-critic + n-step Q.
+
+Reference: `rl4j-core/.../learning/async/{a3c/discrete/A3CDiscrete,
+nstep/discrete/AsyncNStepQLearningDiscrete}.java` and their
+`AsyncGlobal`/`AsyncThread` machinery — N JVM worker threads each roll an
+environment t_max steps, compute n-step-return gradients, and race them
+into a shared global network.
+
+TPU-native inversion (same shape as SURVEY §3.4's gradient-sharing note):
+the thread pool becomes a VECTOR of environments stepped host-side in
+lockstep, and the racy global-net update becomes ONE jitted batched
+update over all workers' n-step returns — algorithmically A3C's batched
+synchronous form (A2C), which is the accelerator-shaped equivalent; the
+async staleness was a JVM-concurrency artifact, not an algorithmic
+feature.  Policy/value share a trunk inside one fused XLA step (policy
+gradient + value MSE + entropy bonus)."""
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops.initializers import init_weights
+from deeplearning4j_tpu.rl.mdp import MDP
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+@dataclasses.dataclass
+class AsyncConfiguration:
+    """Reference `A3CConfiguration` / `AsyncNStepQLearningConfiguration`
+    fields; `num_envs` is the worker-thread count reborn as a batch dim."""
+
+    seed: int = 0
+    max_step: int = 20_000          # total env steps across all envs
+    n_step: int = 5                 # t_max rollout length
+    num_envs: int = 8               # reference numThreads
+    gamma: float = 0.99
+    learning_rate: float = 7e-4
+    entropy_coef: float = 0.01      # A3C only
+    value_coef: float = 0.5         # A3C only
+    target_update: int = 200        # n-step Q only (global steps)
+    eps_init: float = 1.0           # n-step Q only
+    eps_min: float = 0.05
+    anneal_steps: int = 2_000
+    hidden: Tuple[int, ...] = (64, 64)
+
+
+def _init_trunk(key, sizes):
+    params = []
+    for i in range(len(sizes) - 1):
+        key, sub = jax.random.split(key)
+        params.append({
+            "W": init_weights(sub, (sizes[i], sizes[i + 1]), "XAVIER",
+                              jnp.float32),
+            "b": jnp.zeros(sizes[i + 1], jnp.float32)})
+    return key, params
+
+
+def _mlp(params, x):
+    for i, layer in enumerate(params):
+        x = x @ layer["W"] + layer["b"]
+        if i < len(params) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+class _VecEnv:
+    """Lockstep vector of host-side MDPs (the worker threads' envs)."""
+
+    def __init__(self, mdp_factory: Callable[[], MDP], n: int):
+        self.envs = [mdp_factory() for _ in range(n)]
+        self.obs = np.stack([e.reset() for e in self.envs])
+        self.ep_reward = np.zeros(n)
+        self.last_rewards: List[float] = []
+
+    def step(self, actions: np.ndarray):
+        next_obs, rewards, dones = [], [], []
+        for i, (env, a) in enumerate(zip(self.envs, actions)):
+            o, r, done, _ = env.step(int(a))
+            self.ep_reward[i] += r
+            if done:
+                self.last_rewards.append(self.ep_reward[i])
+                self.ep_reward[i] = 0.0
+                o = env.reset()
+            next_obs.append(o)
+            rewards.append(r)
+            dones.append(done)
+        self.obs = np.stack(next_obs)
+        return (self.obs, np.asarray(rewards, np.float32),
+                np.asarray(dones, np.float32))
+
+
+class A3CDiscrete:
+    """Advantage actor-critic (reference `A3CDiscreteDense`), batched-
+    synchronous (see module docstring)."""
+
+    def __init__(self, obs_size: int, n_actions: int,
+                 conf: Optional[AsyncConfiguration] = None):
+        self.conf = conf or AsyncConfiguration()
+        self.obs_size = obs_size
+        self.n_actions = n_actions
+        key = jax.random.PRNGKey(self.conf.seed)
+        sizes = (obs_size,) + tuple(self.conf.hidden)
+        key, trunk = _init_trunk(key, sizes)
+        key, pol = _init_trunk(key, (sizes[-1], n_actions))
+        key, val = _init_trunk(key, (sizes[-1], 1))
+        self.params = {"trunk": trunk, "policy": pol, "value": val}
+        self._step = self._make_step()
+        self._key = key
+
+    def _forward(self, params, obs):
+        h = _mlp(params["trunk"], obs)
+        h = jnp.tanh(h)
+        logits = _mlp(params["policy"], h)
+        value = _mlp(params["value"], h)[..., 0]
+        return logits, value
+
+    def _make_step(self):
+        c = self.conf
+
+        def step(params, obs, actions, returns):
+            """obs [T*N, obs], actions [T*N], returns [T*N] (n-step)."""
+            def loss_fn(p):
+                logits, value = self._forward(p, obs)
+                logp = jax.nn.log_softmax(logits, -1)
+                probs = jax.nn.softmax(logits, -1)
+                adv = returns - value
+                pg = -jnp.mean(jnp.take_along_axis(
+                    logp, actions[:, None], 1)[:, 0]
+                    * jax.lax.stop_gradient(adv))
+                v_loss = jnp.mean(adv * adv)
+                entropy = -jnp.mean(jnp.sum(probs * logp, -1))
+                return (pg + c.value_coef * v_loss
+                        - c.entropy_coef * entropy)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            new = jax.tree_util.tree_map(
+                lambda p, g: p - c.learning_rate * g, params, grads)
+            return new, loss
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def _policy_actions(self, obs, key) -> np.ndarray:
+        logits, _ = self._forward(self.params, jnp.asarray(obs))
+        return np.asarray(jax.random.categorical(key, logits))
+
+    def train(self, mdp_factory: Callable[[], MDP]) -> "A3CDiscrete":
+        c = self.conf
+        vec = _VecEnv(mdp_factory, c.num_envs)
+        steps = 0
+        while steps < c.max_step:
+            obs_buf, act_buf, rew_buf, done_buf = [], [], [], []
+            for _ in range(c.n_step):
+                self._key, sub = jax.random.split(self._key)
+                actions = self._policy_actions(vec.obs, sub)
+                obs_buf.append(vec.obs.copy())
+                nobs, rewards, dones = vec.step(actions)
+                act_buf.append(actions)
+                rew_buf.append(rewards)
+                done_buf.append(dones)
+                steps += c.num_envs
+            # n-step returns bootstrapped from V(s_T)
+            _, boot = self._forward(self.params, jnp.asarray(vec.obs))
+            ret = np.asarray(boot)
+            returns = []
+            for t in reversed(range(c.n_step)):
+                ret = rew_buf[t] + c.gamma * ret * (1.0 - done_buf[t])
+                returns.append(ret)
+            returns = np.stack(list(reversed(returns)))       # [T, N]
+            self.params, self._loss = self._step(
+                self.params, jnp.asarray(np.concatenate(obs_buf)),
+                jnp.asarray(np.concatenate(act_buf)),
+                jnp.asarray(returns.reshape(-1)))
+        return self
+
+    def play(self, mdp: MDP, max_steps: int = 500) -> float:
+        obs = mdp.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            logits, _ = self._forward(self.params, jnp.asarray(obs[None]))
+            obs, r, done, _ = mdp.step(int(np.argmax(np.asarray(logits))))
+            total += r
+            if done:
+                break
+        return total
+
+
+class AsyncNStepQLearningDiscrete:
+    """n-step Q-learning (reference `AsyncNStepQLearningDiscrete`),
+    batched-synchronous with a periodically synced target net."""
+
+    def __init__(self, obs_size: int, n_actions: int,
+                 conf: Optional[AsyncConfiguration] = None):
+        self.conf = conf or AsyncConfiguration()
+        self.obs_size = obs_size
+        self.n_actions = n_actions
+        key = jax.random.PRNGKey(self.conf.seed)
+        sizes = (obs_size,) + tuple(self.conf.hidden) + (n_actions,)
+        key, self.params = _init_trunk(key, sizes)
+        self.target_params = jax.tree_util.tree_map(jnp.copy, self.params)
+        self._step = self._make_step()
+        self._rng = np.random.RandomState(self.conf.seed)
+
+    def _q(self, params, obs):
+        return _mlp(params, obs)
+
+    def _make_step(self):
+        lr = self.conf.learning_rate
+
+        def step(params, obs, actions, returns):
+            def loss_fn(p):
+                q = _mlp(p, obs)
+                qa = jnp.take_along_axis(q, actions[:, None], 1)[:, 0]
+                return jnp.mean((qa - returns) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            new = jax.tree_util.tree_map(lambda p, g: p - lr * g, params,
+                                         grads)
+            return new, loss
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def _eps(self, step):
+        c = self.conf
+        frac = min(1.0, step / max(1, c.anneal_steps))
+        return c.eps_init + frac * (c.eps_min - c.eps_init)
+
+    def train(self, mdp_factory: Callable[[], MDP]
+              ) -> "AsyncNStepQLearningDiscrete":
+        c = self.conf
+        vec = _VecEnv(mdp_factory, c.num_envs)
+        steps = 0
+        updates = 0
+        while steps < c.max_step:
+            obs_buf, act_buf, rew_buf, done_buf = [], [], [], []
+            for _ in range(c.n_step):
+                q = np.asarray(self._q(self.params, jnp.asarray(vec.obs)))
+                greedy = q.argmax(1)
+                explore = self._rng.rand(c.num_envs) < self._eps(steps)
+                actions = np.where(
+                    explore, self._rng.randint(0, self.n_actions,
+                                               c.num_envs), greedy)
+                obs_buf.append(vec.obs.copy())
+                _, rewards, dones = vec.step(actions)
+                act_buf.append(actions)
+                rew_buf.append(rewards)
+                done_buf.append(dones)
+                steps += c.num_envs
+            qt = np.asarray(self._q(self.target_params,
+                                    jnp.asarray(vec.obs)))
+            ret = qt.max(1)
+            returns = []
+            for t in reversed(range(c.n_step)):
+                ret = rew_buf[t] + c.gamma * ret * (1.0 - done_buf[t])
+                returns.append(ret)
+            returns = np.stack(list(reversed(returns)))
+            self.params, self._loss = self._step(
+                self.params,
+                jnp.asarray(np.concatenate(obs_buf)),
+                jnp.asarray(np.concatenate(act_buf).astype(np.int32)),
+                jnp.asarray(returns.reshape(-1)))
+            updates += 1
+            if updates % max(1, self.conf.target_update // c.n_step) == 0:
+                self.target_params = jax.tree_util.tree_map(
+                    jnp.copy, self.params)
+        return self
+
+    def play(self, mdp: MDP, max_steps: int = 500) -> float:
+        obs = mdp.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            q = np.asarray(self._q(self.params, jnp.asarray(obs[None])))
+            obs, r, done, _ = mdp.step(int(q.argmax()))
+            total += r
+            if done:
+                break
+        return total

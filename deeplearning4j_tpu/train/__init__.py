@@ -1,5 +1,6 @@
 from deeplearning4j_tpu.train.evaluation import (  # noqa: F401
-    Evaluation, RegressionEvaluation, ROC, ROCMultiClass)
+    Evaluation, EvaluationCalibration, RegressionEvaluation, ROC,
+    ROCBinary, ROCMultiClass)
 from deeplearning4j_tpu.train.schedules import (  # noqa: F401
     CycleSchedule, ExponentialSchedule, FixedSchedule, InverseSchedule,
     ISchedule, MapSchedule, PolySchedule, RampSchedule, SigmoidSchedule,
@@ -7,3 +8,5 @@ from deeplearning4j_tpu.train.schedules import (  # noqa: F401
 from deeplearning4j_tpu.train.updaters import (  # noqa: F401
     AdaDelta, AdaGrad, AdaMax, Adam, AdamW, AMSGrad, IUpdater, Nadam,
     Nesterovs, NoOp, RmsProp, Sgd, UPDATERS)
+from deeplearning4j_tpu.train.solvers import (  # noqa: F401
+    ConjugateGradient, LBFGS, LineGradientDescent)

@@ -231,3 +231,119 @@ class ROCMultiClass:
 
     def calculate_average_auc(self) -> float:
         return float(np.mean([r.calculate_auc() for r in self.rocs.values()]))
+
+
+class ROCBinary:
+    """Per-output-column ROC for multi-label binary outputs (reference
+    `org.nd4j.evaluation.classification.ROCBinary`): labels/predictions
+    [N, K] with independent sigmoid columns."""
+
+    def __init__(self):
+        self._rocs: List[ROC] = []
+
+    def eval(self, labels: np.ndarray, predictions: np.ndarray):
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 1:           # N samples of one output, not (1, N)
+            labels = labels[:, None]
+            predictions = predictions[:, None]
+        while len(self._rocs) < labels.shape[1]:
+            self._rocs.append(ROC())
+        for k in range(labels.shape[1]):
+            self._rocs[k].eval(labels[:, k], predictions[:, k])
+
+    def num_labels(self) -> int:
+        return len(self._rocs)
+
+    def calculate_auc(self, output: int) -> float:
+        return self._rocs[output].calculate_auc()
+
+    def calculate_auprc(self, output: int) -> float:
+        return self._rocs[output].calculate_auprc()
+
+    def calculate_average_auc(self) -> float:
+        return float(np.mean([r.calculate_auc() for r in self._rocs]))
+
+    def stats(self) -> str:
+        lines = ["ROCBinary:"]
+        for k, r in enumerate(self._rocs):
+            lines.append(f"  output {k}: AUC={r.calculate_auc():.4f} "
+                         f"AUPRC={r.calculate_auprc():.4f}")
+        return "\n".join(lines)
+
+
+class EvaluationCalibration:
+    """Reliability/calibration diagnostics (reference
+    `org.nd4j.evaluation.classification.EvaluationCalibration`):
+    reliability diagram per class, residual-probability histogram, and
+    probability histograms, from binned predicted probabilities."""
+
+    def __init__(self, reliability_bins: int = 10,
+                 histogram_bins: int = 10):
+        self.n_bins = reliability_bins
+        self.hist_bins = histogram_bins
+        self._counts: Optional[np.ndarray] = None   # [C, bins]
+        self._pos: Optional[np.ndarray] = None      # [C, bins] label==1
+        self._prob_sum: Optional[np.ndarray] = None
+        self._residuals: Optional[np.ndarray] = None
+        self._prob_hist: Optional[np.ndarray] = None
+
+    def _ensure(self, c: int):
+        if self._counts is None:
+            self._counts = np.zeros((c, self.n_bins))
+            self._pos = np.zeros((c, self.n_bins))
+            self._prob_sum = np.zeros((c, self.n_bins))
+            self._residuals = np.zeros(self.hist_bins)
+            self._prob_hist = np.zeros((c, self.hist_bins))
+
+    def eval(self, labels: np.ndarray, predictions: np.ndarray):
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 1:           # single binary output (as ROCBinary)
+            labels = labels[:, None]
+            predictions = predictions[:, None]
+        c = labels.shape[1]
+        self._ensure(c)
+        bins = np.clip((predictions * self.n_bins).astype(int), 0,
+                       self.n_bins - 1)
+        for k in range(c):
+            np.add.at(self._counts[k], bins[:, k], 1)
+            np.add.at(self._pos[k], bins[:, k], labels[:, k])
+            np.add.at(self._prob_sum[k], bins[:, k], predictions[:, k])
+            hb = np.clip((predictions[:, k] * self.hist_bins).astype(int),
+                         0, self.hist_bins - 1)
+            np.add.at(self._prob_hist[k], hb, 1)
+        # residual = |label - p| over ALL entries (reference residual plot)
+        res = np.abs(labels - predictions).ravel()
+        rb = np.clip((res * self.hist_bins).astype(int), 0,
+                     self.hist_bins - 1)
+        np.add.at(self._residuals, rb, 1)
+
+    def reliability_diagram(self, cls: int):
+        """Returns (mean_predicted_prob, observed_frequency) per bin
+        (NaN where a bin is empty)."""
+        with np.errstate(invalid="ignore", divide="ignore"):
+            mean_p = self._prob_sum[cls] / self._counts[cls]
+            obs = self._pos[cls] / self._counts[cls]
+        return mean_p, obs
+
+    def expected_calibration_error(self, cls: int) -> float:
+        n = self._counts[cls].sum()
+        mean_p, obs = self.reliability_diagram(cls)
+        valid = self._counts[cls] > 0
+        return float(np.sum(self._counts[cls][valid] / n
+                            * np.abs(mean_p[valid] - obs[valid])))
+
+    def get_residual_plot_all_classes(self) -> np.ndarray:
+        return self._residuals.copy()
+
+    def get_probability_histogram(self, cls: int) -> np.ndarray:
+        return self._prob_hist[cls].copy()
+
+    def stats(self) -> str:
+        c = self._counts.shape[0]
+        lines = ["EvaluationCalibration:"]
+        for k in range(c):
+            lines.append(
+                f"  class {k}: ECE={self.expected_calibration_error(k):.4f}")
+        return "\n".join(lines)

@@ -1,0 +1,185 @@
+"""Full-batch second-order solvers: LBFGS, conjugate gradient, line search.
+
+Reference: `deeplearning4j-nn/.../optimize/solvers/{LBFGS,
+ConjugateGradient,LineGradientDescent,BackTrackLineSearch}.java` — the
+Solver family used instead of SGD-style updaters for small full-batch
+problems.
+
+TPU design: ONE jitted value-and-grad over the flattened parameter vector
+(unflattened to the pytree inside the trace) is the only device program;
+the curvature bookkeeping (two-loop recursion, PR+ beta, backtracking) is
+a handful of device-resident vector ops driven from the host — the same
+split the reference has between its BaseOptimizer loop and ND4J math
+calls, minus the per-op JNI crossings.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _flat_loss_fn(model, x, y):
+    """(flat_params -> loss) for a MultiLayerNetwork/ComputationGraph-style
+    model, jitted once.  Eval-mode loss: deterministic objective (no
+    dropout), matching the reference's Solver line-search evaluations."""
+    leaves, treedef = jax.tree_util.tree_flatten(model.params_)
+    shapes = [l.shape for l in leaves]
+    sizes = [int(np.prod(s)) for s in shapes]
+    dtypes = [l.dtype for l in leaves]
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+
+    def unflatten(flat):
+        out, off = [], 0
+        for shape, size, dt in zip(shapes, sizes, dtypes):
+            out.append(flat[off:off + size].astype(dt).reshape(shape))
+            off += size
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    if hasattr(model, "_as_input_dict"):            # ComputationGraph
+        inputs = model._as_input_dict(x)
+        labels = model._as_list(y)
+
+        def loss(flat):
+            return model._loss(unflatten(flat), model.state_, inputs,
+                               labels, None, None, train=False)[0]
+    else:                                           # MultiLayerNetwork
+        def loss(flat):
+            return model._loss(unflatten(flat), model.state_, x, y, None,
+                               None, None, train=False)[0]
+
+    flat0 = jnp.concatenate([l.ravel().astype(jnp.float32)
+                             for l in leaves]) if leaves \
+        else jnp.zeros((0,), jnp.float32)
+    return jax.jit(jax.value_and_grad(loss)), flat0, unflatten
+
+
+def backtrack_line_search(vg: Callable, flat, loss0, grad, direction,
+                          max_steps: int = 20, c1: float = 1e-4,
+                          shrink: float = 0.5,
+                          initial_step: float = 1.0):
+    """Armijo backtracking (reference `BackTrackLineSearch`): shrink the
+    step until f(x + a*d) <= f(x) + c1*a*<g, d>.  Returns (step, new_flat,
+    new_loss, new_grad); step 0.0 means no acceptable point was found."""
+    slope = float(jnp.vdot(grad, direction))
+    if slope >= 0:          # not a descent direction — caller should reset
+        return 0.0, flat, loss0, grad
+    a = initial_step
+    for _ in range(max_steps):
+        cand = flat + a * direction
+        loss, g = vg(cand)
+        if float(loss) <= float(loss0) + c1 * a * slope \
+                and jnp.isfinite(loss):
+            return a, cand, loss, g
+        a *= shrink
+    return 0.0, flat, loss0, grad
+
+
+class LBFGS:
+    """Limited-memory BFGS (reference `solvers/LBFGS.java`)."""
+
+    def __init__(self, max_iterations: int = 100, m: int = 10,
+                 tolerance: float = 1e-6):
+        self.max_iterations = max_iterations
+        self.m = m
+        self.tolerance = tolerance
+
+    def optimize(self, model, x, y) -> float:
+        vg, flat, unflatten = _flat_loss_fn(model, x, y)
+        loss, grad = vg(flat)
+        s_hist: List[jnp.ndarray] = []
+        y_hist: List[jnp.ndarray] = []
+        for _ in range(self.max_iterations):
+            # two-loop recursion
+            q = grad
+            alphas = []
+            for s, yv in zip(reversed(s_hist), reversed(y_hist)):
+                rho = 1.0 / jnp.vdot(yv, s)
+                a = rho * jnp.vdot(s, q)
+                alphas.append((a, rho, s, yv))
+                q = q - a * yv
+            if y_hist:
+                gamma = (jnp.vdot(s_hist[-1], y_hist[-1])
+                         / jnp.vdot(y_hist[-1], y_hist[-1]))
+                q = gamma * q
+            for a, rho, s, yv in reversed(alphas):
+                b = rho * jnp.vdot(yv, q)
+                q = q + (a - b) * s
+            direction = -q
+            step, new_flat, new_loss, new_grad = backtrack_line_search(
+                vg, flat, loss, grad, direction)
+            if step == 0.0:
+                # reset curvature memory, fall back to steepest descent
+                s_hist.clear()
+                y_hist.clear()
+                step, new_flat, new_loss, new_grad = backtrack_line_search(
+                    vg, flat, loss, grad, -grad, initial_step=1e-1)
+                if step == 0.0:
+                    break
+            s_hist.append(new_flat - flat)
+            y_hist.append(new_grad - grad)
+            if len(s_hist) > self.m:
+                s_hist.pop(0)
+                y_hist.pop(0)
+            improved = float(loss) - float(new_loss)
+            flat, loss, grad = new_flat, new_loss, new_grad
+            if improved < self.tolerance:
+                break
+        model.params_ = unflatten(flat)
+        return float(loss)
+
+
+class ConjugateGradient:
+    """Nonlinear CG with Polak-Ribiere+ restarts (reference
+    `solvers/ConjugateGradient.java`)."""
+
+    def __init__(self, max_iterations: int = 100, tolerance: float = 1e-6):
+        self.max_iterations = max_iterations
+        self.tolerance = tolerance
+
+    def optimize(self, model, x, y) -> float:
+        vg, flat, unflatten = _flat_loss_fn(model, x, y)
+        loss, grad = vg(flat)
+        direction = -grad
+        for _ in range(self.max_iterations):
+            step, new_flat, new_loss, new_grad = backtrack_line_search(
+                vg, flat, loss, grad, direction, initial_step=1e-1)
+            if step == 0.0:
+                break
+            beta = jnp.maximum(
+                0.0, jnp.vdot(new_grad, new_grad - grad)
+                / jnp.maximum(jnp.vdot(grad, grad), 1e-20))   # PR+
+            direction = -new_grad + beta * direction
+            improved = float(loss) - float(new_loss)
+            flat, loss, grad = new_flat, new_loss, new_grad
+            if improved < self.tolerance:
+                break
+        model.params_ = unflatten(flat)
+        return float(loss)
+
+
+class LineGradientDescent:
+    """Steepest descent with line search (reference
+    `solvers/LineGradientDescent.java`)."""
+
+    def __init__(self, max_iterations: int = 100, tolerance: float = 1e-6):
+        self.max_iterations = max_iterations
+        self.tolerance = tolerance
+
+    def optimize(self, model, x, y) -> float:
+        vg, flat, unflatten = _flat_loss_fn(model, x, y)
+        loss, grad = vg(flat)
+        for _ in range(self.max_iterations):
+            step, new_flat, new_loss, new_grad = backtrack_line_search(
+                vg, flat, loss, grad, -grad, initial_step=1e-1)
+            if step == 0.0:
+                break
+            improved = float(loss) - float(new_loss)
+            flat, loss, grad = new_flat, new_loss, new_grad
+            if improved < self.tolerance:
+                break
+        model.params_ = unflatten(flat)
+        return float(loss)

@@ -1,3 +1,4 @@
 """Training UI / metrics (reference `deeplearning4j-ui-parent/**`)."""
 from deeplearning4j_tpu.ui.stats import (  # noqa: F401
     FileStatsStorage, InMemoryStatsStorage, StatsListener, render_html)
+from deeplearning4j_tpu.ui.server import UIServer  # noqa: F401

@@ -1,0 +1,93 @@
+"""Live training dashboard server.
+
+Reference: `deeplearning4j-ui-parent/deeplearning4j-ui/.../VertxUIServer`
++ TrainModule — a Vert.x HTTP server with websocket pushes that renders
+attached StatsStorage sessions.
+
+TPU-side inversion: training never blocks on the UI (the listener writes
+into host-side storage off the jitted step's critical path), so a plain
+stdlib `http.server` thread that RE-RENDERS the latest stats per request
+plus a `<meta http-equiv=refresh>` interval replaces the websocket push —
+same live-monitoring capability, zero dependencies."""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from deeplearning4j_tpu.ui.stats import InMemoryStatsStorage, render_html
+
+
+class UIServer:
+    """`UIServer.get_instance().attach(storage); server.start(9000)` —
+    reference `UIServer.getInstance().attach(statsStorage)`."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self):
+        self._storages: List[InMemoryStatsStorage] = []
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.refresh_seconds = 5
+
+    @staticmethod
+    def get_instance() -> "UIServer":
+        if UIServer._instance is None:
+            UIServer._instance = UIServer()
+        return UIServer._instance
+
+    def attach(self, storage: InMemoryStatsStorage) -> "UIServer":
+        self._storages.append(storage)
+        return self
+
+    def detach(self, storage: InMemoryStatsStorage) -> "UIServer":
+        self._storages = [s for s in self._storages if s is not storage]
+        return self
+
+    def _render(self) -> str:
+        if not self._storages:
+            return ("<html><body><h1>deeplearning4j_tpu UI</h1>"
+                    "<p>No StatsStorage attached.</p></body></html>")
+        html = "\n<hr/>\n".join(render_html(s) for s in self._storages)
+        tag = (f'<meta http-equiv="refresh" '
+               f'content="{self.refresh_seconds}">')
+        return html.replace("<head>", "<head>" + tag, 1)
+
+    def start(self, port: int = 9000, host: str = "127.0.0.1") -> int:
+        """Start serving; returns the bound port (pass 0 to auto-pick)."""
+        if self._httpd is not None:
+            return self._httpd.server_address[1]
+        ui = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):          # noqa: N802 (stdlib API)
+                body = ui._render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass                   # keep training logs clean
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> Optional[str]:
+        if self._httpd is None:
+            return None
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}/"
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None

@@ -1,0 +1,350 @@
+"""Vision zoo additions: Xception, InceptionResNet-V1, TinyYOLO, YOLO2.
+
+Reference: `deeplearning4j-zoo/.../zoo/model/{Xception,InceptionResNetV1,
+TinyYOLO,YOLO2}.java`.  All NHWC on ComputationGraph; separable/standard
+convs lower to MXU matmuls via XLA; the YOLO heads terminate in
+`nn.objdetect.Yolo2OutputLayer`.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence, Tuple
+
+from deeplearning4j_tpu.nn import (
+    ActivationLayer, BatchNormalizationLayer, ComputationGraph,
+    ComputationGraphConfiguration, ConvolutionLayer, DenseLayer,
+    DropoutLayer, ElementWiseVertex, GlobalPoolingLayer, GraphBuilder,
+    InputType, MergeVertex, OutputLayer, ScaleVertex,
+    SeparableConvolution2DLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.objdetect import SpaceToDepthLayer, Yolo2OutputLayer
+from deeplearning4j_tpu.zoo.base import ZooModel, zoo_model
+from deeplearning4j_tpu.zoo.graphs import _conv_bn
+
+
+def _sep_bn(b: GraphBuilder, name: str, inp: str, n: int, k=3, s=1,
+            act: str = "relu") -> str:
+    """separable-conv(no-bias) -> BN(act), the Xception building block."""
+    b.add_layer(f"{name}_sep",
+                SeparableConvolution2DLayer(n_out=n, kernel_size=k, stride=s,
+                                            convolution_mode="Same",
+                                            activation="identity",
+                                            has_bias=False), inp)
+    b.add_layer(f"{name}_bn", BatchNormalizationLayer(activation=act),
+                f"{name}_sep")
+    return f"{name}_bn"
+
+
+@zoo_model
+@dataclasses.dataclass
+class Xception(ZooModel):
+    """Xception (reference `zoo/model/Xception.java`; Chollet 2017):
+    entry/middle/exit flows of residual depthwise-separable blocks."""
+
+    input_shape: Tuple[int, ...] = (299, 299, 3)
+    middle_flow_blocks: int = 8   # reference: 8; reducible for tests
+
+    def _entry_block(self, b, name, inp, n, first_relu=True) -> str:
+        x = inp
+        if first_relu:
+            b.add_layer(f"{name}_relu0", ActivationLayer(activation="relu"),
+                        x)
+            x = f"{name}_relu0"
+        x = _sep_bn(b, f"{name}_s1", x, n, act="relu")
+        x = _sep_bn(b, f"{name}_s2", x, n, act="identity")
+        b.add_layer(f"{name}_pool",
+                    SubsamplingLayer(pooling_type="MAX", kernel_size=3,
+                                     stride=2, convolution_mode="Same"), x)
+        short = _conv_bn(b, f"{name}_proj", inp, n, 1, 2, act="identity")
+        b.add_vertex(f"{name}_add", ElementWiseVertex(op="Add"),
+                     f"{name}_pool", short)
+        return f"{name}_add"
+
+    def conf(self) -> ComputationGraphConfiguration:
+        h, w, c = self.input_shape
+        b = (GraphBuilder().seed(self.seed).updater(self._updater())
+             .weight_init("RELU").add_inputs("input")
+             .set_input_types(InputType.convolutional(h, w, c)))
+        x = _conv_bn(b, "stem1", "input", 32, 3, 2, mode="Truncate")
+        x = _conv_bn(b, "stem2", x, 64, 3, 1, mode="Truncate")
+        x = self._entry_block(b, "entry128", x, 128, first_relu=False)
+        x = self._entry_block(b, "entry256", x, 256)
+        x = self._entry_block(b, "entry728", x, 728)
+        for i in range(self.middle_flow_blocks):
+            inp = x
+            y = inp
+            for j in range(3):
+                b.add_layer(f"mid{i}_relu{j}",
+                            ActivationLayer(activation="relu"), y)
+                y = _sep_bn(b, f"mid{i}_s{j}", f"mid{i}_relu{j}", 728,
+                            act="identity")
+            b.add_vertex(f"mid{i}_add", ElementWiseVertex(op="Add"), y, inp)
+            x = f"mid{i}_add"
+        # exit flow
+        inp = x
+        b.add_layer("exit_relu0", ActivationLayer(activation="relu"), x)
+        y = _sep_bn(b, "exit_s1", "exit_relu0", 728, act="identity")
+        b.add_layer("exit_relu1", ActivationLayer(activation="relu"), y)
+        y = _sep_bn(b, "exit_s2", "exit_relu1", 1024, act="identity")
+        b.add_layer("exit_pool",
+                    SubsamplingLayer(pooling_type="MAX", kernel_size=3,
+                                     stride=2, convolution_mode="Same"), y)
+        short = _conv_bn(b, "exit_proj", inp, 1024, 1, 2, act="identity")
+        b.add_vertex("exit_add", ElementWiseVertex(op="Add"), "exit_pool",
+                     short)
+        x = _sep_bn(b, "exit_s3", "exit_add", 1536)
+        x = _sep_bn(b, "exit_s4", x, 2048)
+        b.add_layer("gap", GlobalPoolingLayer(pooling_type="AVG"), x)
+        b.add_layer("output", OutputLayer(n_out=self.n_classes,
+                                          loss="mcxent",
+                                          activation="softmax"), "gap")
+        b.set_outputs("output")
+        return b.build()
+
+    def init_model(self) -> ComputationGraph:
+        return self._net(ComputationGraph, self.conf())
+
+
+@zoo_model
+@dataclasses.dataclass
+class InceptionResNetV1(ZooModel):
+    """Inception-ResNet-V1 (reference `zoo/model/InceptionResNetV1.java`,
+    the FaceNet backbone; Szegedy et al. 2016).  Residual inception blocks
+    A/B/C with reductions, ending in a bottleneck embedding + softmax
+    head (the reference pairs it with center loss — see
+    `nn.layers.CenterLossOutputLayer`)."""
+
+    input_shape: Tuple[int, ...] = (160, 160, 3)
+    embedding_size: int = 128
+    blocks_a: int = 5
+    blocks_b: int = 10
+    blocks_c: int = 5
+
+    def _branch(self, b, name, inp, specs) -> str:
+        """Chain of conv-bn: specs = [(n, k, s), ...]."""
+        x = inp
+        for i, (n, k, s) in enumerate(specs):
+            x = _conv_bn(b, f"{name}_{i}", x, n, k, s)
+        return x
+
+    def _resnet_block(self, b, name, inp, branches, linear_ch,
+                      scale) -> str:
+        outs = [self._branch(b, f"{name}_br{i}", inp, spec)
+                for i, spec in enumerate(branches)]
+        b.add_vertex(f"{name}_cat", MergeVertex(), *outs)
+        b.add_layer(f"{name}_up",
+                    ConvolutionLayer(n_out=linear_ch, kernel_size=1,
+                                     activation="identity",
+                                     convolution_mode="Same"),
+                    f"{name}_cat")
+        b.add_vertex(f"{name}_scale", ScaleVertex(scale=scale),
+                     f"{name}_up")
+        b.add_vertex(f"{name}_add", ElementWiseVertex(op="Add"), inp,
+                     f"{name}_scale")
+        b.add_layer(f"{name}_relu", ActivationLayer(activation="relu"),
+                    f"{name}_add")
+        return f"{name}_relu"
+
+    def conf(self) -> ComputationGraphConfiguration:
+        h, w, c = self.input_shape
+        b = (GraphBuilder().seed(self.seed).updater(self._updater())
+             .weight_init("RELU").add_inputs("input")
+             .set_input_types(InputType.convolutional(h, w, c)))
+        # stem: 3x3/2 32 -> 3x3 32 -> 3x3 64 -> maxpool/2 -> 1x1 80 ->
+        # 3x3 192 -> 3x3/2 256
+        x = _conv_bn(b, "stem1", "input", 32, 3, 2)
+        x = _conv_bn(b, "stem2", x, 32, 3, 1)
+        x = _conv_bn(b, "stem3", x, 64, 3, 1)
+        b.add_layer("stem_pool",
+                    SubsamplingLayer(pooling_type="MAX", kernel_size=3,
+                                     stride=2, convolution_mode="Same"), x)
+        x = _conv_bn(b, "stem4", "stem_pool", 80, 1, 1)
+        x = _conv_bn(b, "stem5", x, 192, 3, 1)
+        x = _conv_bn(b, "stem6", x, 256, 3, 2)
+        # 5 x block35 (A): branches 1x1(32) | 1x1(32)-3x3(32) |
+        # 1x1(32)-3x3(32)-3x3(32)
+        for i in range(self.blocks_a):
+            x = self._resnet_block(
+                b, f"a{i}", x,
+                [[(32, 1, 1)], [(32, 1, 1), (32, 3, 1)],
+                 [(32, 1, 1), (32, 3, 1), (32, 3, 1)]], 256, 0.17)
+        # reduction-A -> 896 ch
+        ra_pool = f"ra_pool"
+        b.add_layer(ra_pool, SubsamplingLayer(pooling_type="MAX",
+                                              kernel_size=3, stride=2,
+                                              convolution_mode="Same"), x)
+        br1 = self._branch(b, "ra_b1", x, [(384, 3, 2)])
+        br2 = self._branch(b, "ra_b2", x,
+                           [(192, 1, 1), (192, 3, 1), (256, 3, 2)])
+        b.add_vertex("ra_cat", MergeVertex(), ra_pool, br1, br2)
+        x = "ra_cat"
+        # 10 x block17 (B): 1x1(128) | 1x1(128)-1x7(128)-7x1(128)
+        for i in range(self.blocks_b):
+            x = self._resnet_block(
+                b, f"b{i}", x,
+                [[(128, 1, 1)],
+                 [(128, 1, 1), (128, (1, 7), 1), (128, (7, 1), 1)]],
+                896, 0.10)
+        # reduction-B -> 1792 ch
+        rb_pool = "rb_pool"
+        b.add_layer(rb_pool, SubsamplingLayer(pooling_type="MAX",
+                                              kernel_size=3, stride=2,
+                                              convolution_mode="Same"), x)
+        br1 = self._branch(b, "rb_b1", x, [(256, 1, 1), (384, 3, 2)])
+        br2 = self._branch(b, "rb_b2", x, [(256, 1, 1), (256, 3, 2)])
+        br3 = self._branch(b, "rb_b3", x,
+                           [(256, 1, 1), (256, 3, 1), (256, 3, 2)])
+        b.add_vertex("rb_cat", MergeVertex(), rb_pool, br1, br2, br3)
+        x = "rb_cat"
+        # 5 x block8 (C): 1x1(192) | 1x1(192)-1x3(192)-3x1(192)
+        for i in range(self.blocks_c):
+            x = self._resnet_block(
+                b, f"c{i}", x,
+                [[(192, 1, 1)],
+                 [(192, 1, 1), (192, (1, 3), 1), (192, (3, 1), 1)]],
+                1792, 0.20)
+        b.add_layer("gap", GlobalPoolingLayer(pooling_type="AVG"), x)
+        b.add_layer("drop", DropoutLayer(dropout=0.8), "gap")
+        b.add_layer("bottleneck",
+                    DenseLayer(n_out=self.embedding_size,
+                               activation="identity"), "drop")
+        b.add_layer("output", OutputLayer(n_out=self.n_classes,
+                                          loss="mcxent",
+                                          activation="softmax"),
+                    "bottleneck")
+        b.set_outputs("output")
+        return b.build()
+
+    def init_model(self) -> ComputationGraph:
+        return self._net(ComputationGraph, self.conf())
+
+
+def _dark_conv(b, name, inp, n, k=3, s=1) -> str:
+    """conv-bn-leaky(0.1), the darknet building block."""
+    b.add_layer(f"{name}_conv",
+                ConvolutionLayer(n_out=n, kernel_size=k, stride=s,
+                                 convolution_mode="Same",
+                                 activation="identity", has_bias=False),
+                inp)
+    b.add_layer(f"{name}_bn",
+                BatchNormalizationLayer(activation="leakyrelu"),
+                f"{name}_conv")
+    return f"{name}_bn"
+
+
+# COCO-ish default anchor priors in grid units (reference TinyYOLO/YOLO2
+# defaults are VOC priors)
+_TINY_ANCHORS = ((1.08, 1.19), (3.42, 4.41), (6.63, 11.38),
+                 (9.42, 5.11), (16.62, 10.52))
+_YOLO2_ANCHORS = ((0.57273, 0.677385), (1.87446, 2.06253),
+                  (3.33843, 5.47434), (7.88282, 3.52778),
+                  (9.77052, 9.16828))
+
+
+@zoo_model
+@dataclasses.dataclass
+class TinyYOLO(ZooModel):
+    """TinyYOLO (reference `zoo/model/TinyYOLO.java`): 9-conv darknet-tiny
+    backbone + anchor head + Yolo2OutputLayer."""
+
+    n_classes: int = 20
+    input_shape: Tuple[int, ...] = (416, 416, 3)
+    anchors: Sequence[Tuple[float, float]] = _TINY_ANCHORS
+
+    def conf(self) -> ComputationGraphConfiguration:
+        h, w, c = self.input_shape
+        b = (GraphBuilder().seed(self.seed).updater(self._updater())
+             .weight_init("RELU").add_inputs("input")
+             .set_input_types(InputType.convolutional(h, w, c)))
+        x = "input"
+        for i, n in enumerate([16, 32, 64, 128, 256]):
+            x = _dark_conv(b, f"d{i}", x, n)
+            b.add_layer(f"p{i}", SubsamplingLayer(pooling_type="MAX",
+                                                  kernel_size=2, stride=2),
+                        x)
+            x = f"p{i}"
+        x = _dark_conv(b, "d5", x, 512)
+        b.add_layer("p5", SubsamplingLayer(pooling_type="MAX",
+                                           kernel_size=2, stride=1,
+                                           convolution_mode="Same"), x)
+        x = _dark_conv(b, "d6", "p5", 1024)
+        x = _dark_conv(b, "d7", x, 1024)
+        A = len(self.anchors)
+        b.add_layer("head",
+                    ConvolutionLayer(n_out=A * (5 + self.n_classes),
+                                     kernel_size=1,
+                                     activation="identity"), x)
+        b.add_layer("yolo",
+                    Yolo2OutputLayer(anchors=tuple(self.anchors),
+                                     n_classes=self.n_classes), "head")
+        b.set_outputs("yolo")
+        return b.build()
+
+    def init_model(self) -> ComputationGraph:
+        return self._net(ComputationGraph, self.conf())
+
+
+@zoo_model
+@dataclasses.dataclass
+class YOLO2(ZooModel):
+    """YOLOv2 (reference `zoo/model/YOLO2.java`): Darknet-19 backbone with
+    the SpaceToDepth passthrough merge + Yolo2OutputLayer."""
+
+    n_classes: int = 20
+    input_shape: Tuple[int, ...] = (416, 416, 3)
+    anchors: Sequence[Tuple[float, float]] = _YOLO2_ANCHORS
+
+    def conf(self) -> ComputationGraphConfiguration:
+        h, w, c = self.input_shape
+        b = (GraphBuilder().seed(self.seed).updater(self._updater())
+             .weight_init("RELU").add_inputs("input")
+             .set_input_types(InputType.convolutional(h, w, c)))
+
+        def pool(name, inp):
+            b.add_layer(name, SubsamplingLayer(pooling_type="MAX",
+                                               kernel_size=2, stride=2),
+                        inp)
+            return name
+
+        x = _dark_conv(b, "c1", "input", 32)
+        x = pool("p1", x)
+        x = _dark_conv(b, "c2", x, 64)
+        x = pool("p2", x)
+        x = _dark_conv(b, "c3a", x, 128)
+        x = _dark_conv(b, "c3b", x, 64, k=1)
+        x = _dark_conv(b, "c3c", x, 128)
+        x = pool("p3", x)
+        x = _dark_conv(b, "c4a", x, 256)
+        x = _dark_conv(b, "c4b", x, 128, k=1)
+        x = _dark_conv(b, "c4c", x, 256)
+        x = pool("p4", x)
+        x = _dark_conv(b, "c5a", x, 512)
+        x = _dark_conv(b, "c5b", x, 256, k=1)
+        x = _dark_conv(b, "c5c", x, 512)
+        x = _dark_conv(b, "c5d", x, 256, k=1)
+        passthrough = _dark_conv(b, "c5e", x, 512)
+        x = pool("p5", passthrough)
+        x = _dark_conv(b, "c6a", x, 1024)
+        x = _dark_conv(b, "c6b", x, 512, k=1)
+        x = _dark_conv(b, "c6c", x, 1024)
+        x = _dark_conv(b, "c6d", x, 512, k=1)
+        x = _dark_conv(b, "c6e", x, 1024)
+        x = _dark_conv(b, "c7a", x, 1024)
+        x = _dark_conv(b, "c7b", x, 1024)
+        # passthrough: 26x26x512 -> reorg -> 13x13x2048, merged with deep path
+        pt = _dark_conv(b, "pt_conv", passthrough, 64, k=1)
+        b.add_layer("pt_reorg", SpaceToDepthLayer(block_size=2), pt)
+        b.add_vertex("merge", MergeVertex(), "pt_reorg", x)
+        x = _dark_conv(b, "c8", "merge", 1024)
+        A = len(self.anchors)
+        b.add_layer("head",
+                    ConvolutionLayer(n_out=A * (5 + self.n_classes),
+                                     kernel_size=1,
+                                     activation="identity"), x)
+        b.add_layer("yolo",
+                    Yolo2OutputLayer(anchors=tuple(self.anchors),
+                                     n_classes=self.n_classes), "head")
+        b.set_outputs("yolo")
+        return b.build()
+
+    def init_model(self) -> ComputationGraph:
+        return self._net(ComputationGraph, self.conf())

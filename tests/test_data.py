@@ -188,3 +188,88 @@ def test_idx_roundtrip(tmp_path):
         f.write(struct.pack(">III", 2, 3, 4))
         f.write(arr.tobytes())
     np.testing.assert_array_equal(read_idx(str(p)), arr)
+
+
+# ---------------------------------------------------------------------------
+# CIFAR-10 / EMNIST iterators (VERDICT #8): tests author files in the REAL
+# formats (CIFAR binary records, IDX) and read them back.
+# ---------------------------------------------------------------------------
+
+def _write_cifar_bin(path, n, seed):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n).astype(np.uint8)
+    imgs = rng.randint(0, 256, (n, 3, 32, 32)).astype(np.uint8)  # CHW
+    rec = np.concatenate([labels[:, None],
+                          imgs.reshape(n, -1)], axis=1)
+    rec.astype(np.uint8).tofile(path)
+    return labels, imgs
+
+
+def _write_idx(path, arr):
+    import struct
+    arr = np.asarray(arr)
+    code = {np.uint8: 0x08}[arr.dtype.type]
+    with open(path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, code, arr.ndim))
+        for d in arr.shape:
+            f.write(struct.pack(">I", d))
+        f.write(arr.tobytes())
+
+
+def test_cifar10_iterator_reads_binary_format(tmp_path):
+    from deeplearning4j_tpu.data import Cifar10DataSetIterator
+    labs = []
+    for i in range(1, 6):
+        l, _ = _write_cifar_bin(tmp_path / f"data_batch_{i}.bin", 20, i)
+        labs.append(l)
+    it = Cifar10DataSetIterator(10, train=True, data_dir=str(tmp_path),
+                                shuffle=False)
+    batches = list(it)
+    assert len(batches) == 10
+    assert batches[0].features.shape == (10, 32, 32, 3)
+    assert batches[0].features.max() <= 1.0
+    np.testing.assert_array_equal(np.argmax(batches[0].labels, 1),
+                                  labs[0][:10])
+    # HWC layout: channel planes were stored CHW — check one pixel
+    raw = np.fromfile(tmp_path / "data_batch_1.bin", np.uint8)
+    rec0 = raw[:3073]
+    np.testing.assert_allclose(
+        batches[0].features[0, 0, 0],
+        rec0[1:][[0, 1024, 2048]].astype(np.float32) / 255.0)
+
+
+def test_cifar10_missing_file_error(tmp_path):
+    from deeplearning4j_tpu.data import Cifar10DataSetIterator
+    with pytest.raises(FileNotFoundError, match="zero egress"):
+        Cifar10DataSetIterator(8, data_dir=str(tmp_path))
+
+
+def test_emnist_iterator_splits_and_letters_offset(tmp_path):
+    from deeplearning4j_tpu.data import EmnistDataSetIterator
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (30, 28, 28)).astype(np.uint8)
+    labels = (rng.randint(0, 26) + 1) * np.ones(30, np.uint8)  # 1-indexed
+    _write_idx(tmp_path / "emnist-letters-train-images-idx3-ubyte", imgs)
+    _write_idx(tmp_path / "emnist-letters-train-labels-idx1-ubyte", labels)
+    it = EmnistDataSetIterator("letters", 10, train=True,
+                               data_dir=str(tmp_path), shuffle=False)
+    assert it.n_classes == 26
+    ds = next(iter(it))
+    assert ds.features.shape == (10, 28, 28, 1)
+    assert ds.labels.shape == (10, 26)
+    # loader must undo the EMNIST on-disk transpose
+    np.testing.assert_allclose(
+        ds.features[0, :, :, 0], imgs[0].T.astype(np.float32) / 255.0)
+    np.testing.assert_array_equal(np.argmax(ds.labels, 1),
+                                  labels[:10] - 1)
+    with pytest.raises(ValueError, match="Unknown EMNIST split"):
+        EmnistDataSetIterator("nope", 10, data_dir=str(tmp_path))
+
+
+def test_synthetic_cifar_trains():
+    from deeplearning4j_tpu.data import SyntheticCifar10
+    from deeplearning4j_tpu.zoo import SimpleCNN
+    net = SimpleCNN(n_classes=10, input_shape=(32, 32, 3)).init_model()
+    it = SyntheticCifar10(16, n_batches=4)
+    net.fit(it, epochs=2)
+    assert np.isfinite(net.score())

@@ -152,3 +152,122 @@ def test_misc_layers():
     y, _ = run(ActivationLayer(activation="relu"), InputType.feed_forward(3),
                jnp.array([[-1.0, 0.0, 2.0]]))
     np.testing.assert_allclose(np.asarray(y), [[0.0, 0.0, 2.0]])
+
+
+# ---------------------------------------------------------------------------
+# Extended layer zoo (VERDICT §2 layer-gap rows): 3-D conv/pool, cropping,
+# locally-connected, PReLU, center loss
+# ---------------------------------------------------------------------------
+
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration, OutputLayer
+from deeplearning4j_tpu.train.updaters import Sgd
+
+
+def test_conv3d_and_pool3d_shapes_and_train():
+    from deeplearning4j_tpu.nn import (Convolution3DLayer, OutputLayer,
+                                       Subsampling3DLayer)
+    rng = np.random.RandomState(0)
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1))
+            .list([Convolution3DLayer(n_out=4, kernel_size=3,
+                                      convolution_mode="Same",
+                                      activation="relu"),
+                   Subsampling3DLayer(pooling_type="MAX", kernel_size=2,
+                                      stride=2),
+                   OutputLayer(n_out=2, loss="mcxent",
+                               activation="softmax")])
+            .set_input_type(InputType.convolutional3d(8, 8, 8, 1)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = rng.rand(4, 8, 8, 8, 1).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 4)]
+    assert net.output(x).shape == (4, 2)
+    s0 = net.score_for(x, y)
+    for _ in range(10):
+        net.fit(x, y)
+    assert net.score_for(x, y) < s0
+
+
+def test_deconv3d_upsamples():
+    from deeplearning4j_tpu.nn import Deconvolution3DLayer
+    import jax
+    layer = Deconvolution3DLayer(n_out=3, kernel_size=2, stride=2,
+                                 activation="identity")
+    params, state, out_t = layer.initialize(
+        jax.random.PRNGKey(0), InputType.convolutional3d(4, 4, 4, 2))
+    assert out_t.shape == (8, 8, 8, 3)
+    x = np.random.RandomState(0).rand(2, 4, 4, 4, 2).astype(np.float32)
+    y, _ = layer.apply(params, state, jnp.asarray(x))
+    assert y.shape == (2, 8, 8, 8, 3)
+
+
+def test_subsampling1d_and_cropping():
+    from deeplearning4j_tpu.nn import (Cropping1DLayer, Cropping2DLayer,
+                                       Cropping3DLayer, Subsampling1DLayer)
+    import jax
+    x = np.arange(2 * 8 * 3, dtype=np.float32).reshape(2, 8, 3)
+    p = Subsampling1DLayer(pooling_type="MAX", kernel_size=2, stride=2)
+    y, _ = p.apply({}, {}, jnp.asarray(x))
+    assert y.shape == (2, 4, 3)
+    np.testing.assert_array_equal(np.asarray(y)[0, 0], x[0, 1])
+    c1 = Cropping1DLayer(cropping=(1, 2))
+    y, _ = c1.apply({}, {}, jnp.asarray(x))
+    assert y.shape == (2, 5, 3)
+    np.testing.assert_array_equal(np.asarray(y)[0, 0], x[0, 1])
+    x2 = np.zeros((1, 6, 6, 2), np.float32)
+    c2 = Cropping2DLayer(cropping=(1, 2, 0, 3))
+    y, _ = c2.apply({}, {}, jnp.asarray(x2))
+    assert y.shape == (1, 3, 3, 2)
+    x3 = np.zeros((1, 4, 4, 4, 1), np.float32)
+    c3 = Cropping3DLayer(cropping=(1, 1, 0, 2, 2, 0))
+    y, _ = c3.apply({}, {}, jnp.asarray(x3))
+    assert y.shape == (1, 2, 2, 2, 1)
+
+
+def test_locally_connected_matches_manual():
+    from deeplearning4j_tpu.nn import LocallyConnected2DLayer
+    import jax
+    rng = np.random.RandomState(1)
+    layer = LocallyConnected2DLayer(n_out=2, kernel_size=2, stride=1,
+                                    activation="identity", has_bias=False)
+    params, _, out_t = layer.initialize(
+        jax.random.PRNGKey(0), InputType.convolutional(3, 3, 2))
+    assert out_t.shape == (2, 2, 2)
+    x = rng.rand(1, 3, 3, 2).astype(np.float32)
+    y, _ = layer.apply(params, {}, jnp.asarray(x))
+    W = np.asarray(params["W"])     # [OH, OW, K*K*C, O]
+    # manual: per output position, its own kernel; patches are channel-major
+    # (conv_general_dilated_patches emits [C, KH, KW] feature order)
+    patch = x[0, 0:2, 0:2, :].transpose(2, 0, 1).reshape(-1)
+    np.testing.assert_allclose(np.asarray(y)[0, 0, 0], patch @ W[0, 0],
+                               rtol=1e-5)
+
+
+def test_prelu_learns_slope():
+    from deeplearning4j_tpu.nn import PReLULayer
+    import jax
+    layer = PReLULayer(alpha_init=0.25)
+    params, _, _ = layer.initialize(jax.random.PRNGKey(0),
+                                    InputType.feed_forward(4))
+    x = jnp.asarray([[-2.0, -1.0, 1.0, 2.0]])
+    y, _ = layer.apply(params, {}, x)
+    np.testing.assert_allclose(np.asarray(y)[0], [-0.5, -0.25, 1.0, 2.0])
+
+
+def test_center_loss_output_layer_trains_and_pulls_centers():
+    from deeplearning4j_tpu.nn import CenterLossOutputLayer, DenseLayer
+    rng = np.random.RandomState(3)
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1))
+            .list([DenseLayer(n_out=8, activation="tanh"),
+                   CenterLossOutputLayer(n_out=3, lambda_=0.1)])
+            .set_input_type(InputType.feed_forward(5)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = rng.randn(30, 5).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 30)]
+    s0 = net.score_for(x, y)
+    for _ in range(30):
+        net.fit(x, y)
+    assert net.score_for(x, y) < s0
+    # centers moved off their zero init toward class feature means
+    centers = np.asarray(net.params_["layer_1"]["centers"])
+    assert np.linalg.norm(centers) > 0.01
+    out = net.output(x)
+    assert np.allclose(np.asarray(out).sum(1), 1.0, atol=1e-4)

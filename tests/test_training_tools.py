@@ -280,3 +280,37 @@ def test_cg_transfer_splice_vertex():
     x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
     out = new.output([x])
     assert np.asarray(out[0]).shape == (8, 2)
+
+
+def test_cg_transfer_nout_replace_propagates_through_bn():
+    """Width changes must pass through BatchNorm (parameterized but
+    width-transparent) and re-init the next conv (review regression)."""
+    from deeplearning4j_tpu.nn import (BatchNormalizationLayer,
+                                       ComputationGraph, ConvolutionLayer,
+                                       GraphBuilder, GlobalPoolingLayer,
+                                       InputType, OutputLayer)
+    conf = (GraphBuilder().seed(2).updater(Sgd(0.1))
+            .add_inputs("in")
+            .set_input_types(InputType.convolutional(8, 8, 3))
+            .add_layer("conv1", ConvolutionLayer(n_out=4, kernel_size=3,
+                                                 convolution_mode="Same"),
+                       "in")
+            .add_layer("bn1", BatchNormalizationLayer(activation="relu"),
+                       "conv1")
+            .add_layer("conv2", ConvolutionLayer(n_out=6, kernel_size=3,
+                                                 convolution_mode="Same"),
+                       "bn1")
+            .add_layer("gap", GlobalPoolingLayer(pooling_type="AVG"),
+                       "conv2")
+            .add_layer("out", OutputLayer(n_out=2, loss="mcxent",
+                                          activation="softmax"), "gap")
+            .set_outputs("out").build())
+    base = ComputationGraph(conf).init()
+    new = (TransferLearning.graph_builder(base)
+           .n_out_replace("conv1", 8).build())
+    assert new.params_["conv1"]["W"].shape[-1] == 8
+    assert new.params_["conv2"]["W"].shape == (3, 3, 8, 6)
+    x = np.random.RandomState(0).rand(2, 8, 8, 3).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[[0, 1]]
+    new.fit([x], [y])
+    assert np.isfinite(new.score())
