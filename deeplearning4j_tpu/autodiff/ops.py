@@ -446,3 +446,199 @@ def _tf_strided_slice_op(x, begin, end, strides, begin_mask=0, end_mask=0,
 
 
 register_op("floor_div", jnp.floor_divide)   # int-preserving (TF FloorDiv)
+
+
+# ---------------------------------------------------------------------------
+# Extended declarable-op coverage (reference: the wider
+# `libnd4j/include/ops/declarable/generic/**` inventory beyond the baseline
+# configs — shape/order ops, segment reductions, scatter, linalg, image).
+# ---------------------------------------------------------------------------
+
+register_op("expm1", jnp.expm1)
+register_op("rsqrt", lambda a: lax.rsqrt(a))
+register_op("cbrt", jnp.cbrt)
+register_op("erfc", jax.scipy.special.erfc)
+register_op("mod", jnp.mod)
+register_op("fmod", jnp.fmod)
+register_op("squared_difference", lambda a, b: (a - b) ** 2)
+register_op("xlogy", jax.scipy.special.xlogy)
+register_op("hypot", jnp.hypot)
+register_op("atan2", jnp.arctan2)
+register_op("digamma", jax.scipy.special.digamma)
+register_op("lgamma", jax.scipy.special.gammaln)
+register_op("sinc", jnp.sinc)
+register_op("rint", jnp.rint)
+register_op("trunc", jnp.trunc)
+register_op("relu_derivative", lambda a: (a > 0).astype(a.dtype))
+register_op("hard_tanh", lambda a: jnp.clip(a, -1.0, 1.0))
+register_op("rational_tanh", lambda a: 1.7159 * jnp.tanh(2.0 * a / 3.0))
+register_op("rectified_tanh", lambda a: jnp.maximum(0.0, jnp.tanh(a)))
+register_op("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)))
+register_op("gelu_tanh", lambda a: jax.nn.gelu(a, approximate=True))
+register_op("thresholded_relu", lambda a, theta=1.0:
+            jnp.where(a > theta, a, 0.0))
+
+# order / search
+register_op("sort", lambda a, axis=-1, descending=False:
+            -jnp.sort(-a, axis=axis) if descending
+            else jnp.sort(a, axis=axis))
+register_op("argsort", lambda a, axis=-1: jnp.argsort(a, axis=axis))
+register_op("top_k", lambda a, k=1: lax.top_k(a, k))
+def _unique(a, size=None):
+    if size is None:
+        raise ValueError(
+            "unique needs a static `size` under jit (pad/truncate "
+            "semantics of jnp.unique) — pass size=<max distinct>")
+    return jnp.unique(a, size=size)
+
+
+register_op("unique", _unique)
+register_op("searchsorted", lambda sorted_seq, values:
+            jnp.searchsorted(sorted_seq, values))
+register_op("flip", lambda a, axis=None: jnp.flip(a, axis=axis))
+register_op("roll", lambda a, shift, axis=None:
+            jnp.roll(a, shift, axis=axis))
+register_op("diag", jnp.diag)
+register_op("diag_part", jnp.diagonal)
+register_op("trace", jnp.trace)
+register_op("tri", lambda n, m=None, k=0: jnp.tri(n, m, k))
+register_op("tril", lambda a, k=0: jnp.tril(a, k))
+register_op("triu", lambda a, k=0: jnp.triu(a, k))
+register_op("eye", lambda n, m=None, dtype="float32":
+            jnp.eye(n, m, dtype=jnp.dtype(dtype)))
+register_op("reverse_sequence", lambda a, lengths, seq_axis=1,
+            batch_axis=0: _reverse_sequence(a, lengths, seq_axis,
+                                            batch_axis))
+
+
+def _reverse_sequence(a, lengths, seq_axis, batch_axis):
+    if batch_axis != 0 or seq_axis != 1:
+        raise NotImplementedError(
+            "reverse_sequence supports batch_axis=0, seq_axis=1 — "
+            "transpose first for other layouts")
+    idx = jnp.arange(a.shape[seq_axis])
+    rev = lengths[:, None] - 1 - idx[None, :]
+    take = jnp.where(rev >= 0, rev, idx[None, :])
+    return jnp.take_along_axis(
+        a, take.reshape(take.shape + (1,) * (a.ndim - 2))
+        if a.ndim > 2 else take, axis=seq_axis)
+
+
+# segment / scatter
+register_op("segment_sum", lambda data, ids, num_segments:
+            jax.ops.segment_sum(data, ids, num_segments))
+register_op("segment_max", lambda data, ids, num_segments:
+            jax.ops.segment_max(data, ids, num_segments))
+register_op("segment_min", lambda data, ids, num_segments:
+            jax.ops.segment_min(data, ids, num_segments))
+register_op("segment_mean", lambda data, ids, num_segments:
+            jax.ops.segment_sum(data, ids, num_segments)
+            / jnp.maximum(jax.ops.segment_sum(
+                jnp.ones(data.shape[0], data.dtype), ids, num_segments),
+                1.0).reshape((-1,) + (1,) * (data.ndim - 1)))
+register_op("scatter_add", lambda a, idx, updates:
+            a.at[idx].add(updates))
+register_op("scatter_update", lambda a, idx, updates:
+            a.at[idx].set(updates))
+register_op("scatter_max", lambda a, idx, updates:
+            a.at[idx].max(updates))
+register_op("scatter_min", lambda a, idx, updates:
+            a.at[idx].min(updates))
+register_op("gather_nd", lambda a, idx: a[tuple(jnp.moveaxis(idx, -1, 0))])
+register_op("take_along_axis", lambda a, idx, axis=-1:
+            jnp.take_along_axis(a, idx, axis=axis))
+
+# linalg (reference generic/linalg/**)
+register_op("cholesky", jnp.linalg.cholesky)
+register_op("solve", jnp.linalg.solve)
+register_op("triangular_solve", lambda a, b, lower=True:
+            jax.scipy.linalg.solve_triangular(a, b, lower=lower))
+register_op("matrix_inverse", jnp.linalg.inv)
+register_op("matrix_determinant", jnp.linalg.det)
+register_op("log_matrix_determinant", lambda a:
+            jnp.linalg.slogdet(a)[1])
+register_op("qr", jnp.linalg.qr)
+register_op("svd", jnp.linalg.svd)
+register_op("eig_sym", jnp.linalg.eigh)
+register_op("lstsq", lambda a, b: jnp.linalg.lstsq(a, b)[0])
+register_op("matrix_band_part", lambda a, lower, upper:
+            _band_part(a, lower, upper))
+register_op("outer", jnp.outer)
+register_op("kron", jnp.kron)
+register_op("cross", jnp.cross)
+register_op("dot", jnp.dot)
+register_op("vdot", jnp.vdot)
+
+
+def _band_part(a, lower, upper):
+    m, n = a.shape[-2], a.shape[-1]
+    i = jnp.arange(m)[:, None]
+    j = jnp.arange(n)[None, :]
+    keep = jnp.ones((m, n), bool)
+    if lower >= 0:
+        keep &= (i - j) <= lower
+    if upper >= 0:
+        keep &= (j - i) <= upper
+    return jnp.where(keep, a, jnp.zeros((), a.dtype))
+
+
+# normalization / image
+register_op("l2_normalize", lambda a, axis=-1, eps=1e-12:
+            a / jnp.maximum(jnp.linalg.norm(a, axis=axis, keepdims=True),
+                            eps))
+register_op("standardize", lambda a, axis=-1, eps=1e-8:
+            (a - jnp.mean(a, axis=axis, keepdims=True))
+            / (jnp.std(a, axis=axis, keepdims=True) + eps))
+register_op("moments", lambda a, axis=None, keepdims=False:
+            (jnp.mean(a, axis=_axis_tuple(axis), keepdims=keepdims),
+             jnp.var(a, axis=_axis_tuple(axis), keepdims=keepdims)))
+register_op("normalize_moments", lambda count, mean_ss, var_ss, shift=0.0:
+            (mean_ss / count + shift,
+             var_ss / count - (mean_ss / count) ** 2))
+register_op("resize_nearest", lambda a, size:
+            jax.image.resize(a, (a.shape[0],) + tuple(size)
+                             + (a.shape[-1],), "nearest"))
+register_op("resize_bilinear", lambda a, size:
+            jax.image.resize(a, (a.shape[0],) + tuple(size)
+                             + (a.shape[-1],), "bilinear"))
+register_op("space_to_depth", lambda a, block_size=2:
+            _space_to_depth(a, block_size))
+register_op("depth_to_space", lambda a, block_size=2:
+            _depth_to_space(a, block_size))
+
+
+def _space_to_depth(x, b):
+    B, H, W, C = x.shape
+    x = x.reshape(B, H // b, b, W // b, b, C)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(B, H // b, W // b,
+                                                 b * b * C)
+
+
+def _depth_to_space(x, b):
+    B, H, W, C = x.shape
+    x = x.reshape(B, H, W, b, b, C // (b * b))
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(B, H * b, W * b,
+                                                 C // (b * b))
+
+
+# cumulative / windowed
+register_op("cumprod", lambda a, axis=0: jnp.cumprod(a, axis=axis))
+register_op("cummax", lambda a, axis=0: lax.cummax(a, axis=axis))
+register_op("cummin", lambda a, axis=0: lax.cummin(a, axis=axis))
+register_op("count_nonzero", lambda a, axis=None:
+            jnp.count_nonzero(a, axis=_axis_tuple(axis)))
+register_op("bincount", lambda a, length: jnp.bincount(a, length=length))
+register_op("histogram_fixed_width", lambda a, lo, hi, nbins=100:
+            jnp.histogram(a, bins=nbins, range=(lo, hi))[0])
+register_op("clip_by_norm", lambda a, clip_norm, axis=None:
+            a * jnp.minimum(1.0, clip_norm / jnp.maximum(
+                jnp.linalg.norm(a, axis=axis, keepdims=axis is not None),
+                1e-12)))
+register_op("meshgrid", lambda *xs, indexing="xy":
+            jnp.meshgrid(*xs, indexing=indexing))
+register_op("linspace", lambda start, stop, num=50:
+            jnp.linspace(start, stop, num))
+register_op("arange", lambda start, stop=None, step=1, dtype="float32":
+            jnp.arange(start, stop, step, dtype=jnp.dtype(dtype)))
+register_op("full", lambda shape, value, dtype="float32":
+            jnp.full(tuple(shape), value, jnp.dtype(dtype)))

@@ -16,3 +16,5 @@ from deeplearning4j_tpu.data.rr_iterator import (  # noqa: F401
 from deeplearning4j_tpu.data.datasets import (  # noqa: F401
     Cifar10DataSetIterator, EmnistDataSetIterator, IrisDataSetIterator,
     MnistDataSetIterator, SyntheticCifar10, SyntheticMnist, read_idx)
+from deeplearning4j_tpu.data.analysis import (  # noqa: F401
+    AnalyzeLocal, DataAnalysis, Join)

@@ -1,0 +1,223 @@
+"""Record joins + dataset analysis (DataVec's remaining ETL surface).
+
+Reference: `datavec-api/.../transform/join/Join.java` (keyed
+Inner/LeftOuter/RightOuter/FullOuter joins executed by Spark in
+`datavec-spark`) and `transform/analysis/{AnalyzeLocal,DataAnalysis,
+columns/*Analysis}.java`.
+
+Host-side numpy/python by design — ETL never competes with the device
+(SURVEY §3.3); the Spark executor role collapses to hash maps over
+in-memory record lists.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from collections import Counter, defaultdict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.data.transform import ColumnMeta, Schema
+
+Record = List[Any]
+
+
+class Join:
+    """Keyed join of two record sets (reference `Join.Builder`):
+
+        join = (Join.builder(Join.INNER)
+                .set_left_schema(left_schema).set_right_schema(right_schema)
+                .set_join_columns("id").build())
+        out_records = join.execute(left_records, right_records)
+        out_schema = join.output_schema()
+    """
+
+    INNER = "Inner"
+    LEFT_OUTER = "LeftOuter"
+    RIGHT_OUTER = "RightOuter"
+    FULL_OUTER = "FullOuter"
+
+    def __init__(self, join_type: str, left: Schema, right: Schema,
+                 left_keys: Sequence[str],
+                 right_keys: Optional[Sequence[str]] = None):
+        if join_type not in (self.INNER, self.LEFT_OUTER, self.RIGHT_OUTER,
+                             self.FULL_OUTER):
+            raise ValueError(f"Unknown join type '{join_type}'")
+        self.join_type = join_type
+        self.left = left
+        self.right = right
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys or left_keys)
+        if len(self.left_keys) != len(self.right_keys):
+            raise ValueError("left/right key column counts differ")
+        self._l_idx = [left.index_of(k) for k in self.left_keys]
+        self._r_idx = [right.index_of(k) for k in self.right_keys]
+        # right non-key columns appended after all left columns
+        self._r_keep = [i for i in range(len(right.columns))
+                        if i not in self._r_idx]
+
+    class Builder:
+        def __init__(self, join_type: str):
+            self._type = join_type
+            self._left: Optional[Schema] = None
+            self._right: Optional[Schema] = None
+            self._lk: Optional[List[str]] = None
+            self._rk: Optional[List[str]] = None
+
+        def set_left_schema(self, s: Schema):
+            self._left = s
+            return self
+
+        def set_right_schema(self, s: Schema):
+            self._right = s
+            return self
+
+        def set_join_columns(self, *names: str):
+            self._lk = list(names)
+            return self
+
+        def set_join_columns_right(self, *names: str):
+            self._rk = list(names)
+            return self
+
+        def build(self) -> "Join":
+            if self._left is None or self._right is None or not self._lk:
+                raise ValueError("Join needs both schemas and key columns")
+            return Join(self._type, self._left, self._right, self._lk,
+                        self._rk)
+
+    @staticmethod
+    def builder(join_type: str) -> "Join.Builder":
+        return Join.Builder(join_type)
+
+    def output_schema(self) -> Schema:
+        cols = [dataclasses.replace(c) for c in self.left.columns]
+        cols += [dataclasses.replace(self.right.columns[i])
+                 for i in self._r_keep]
+        return Schema(cols)
+
+    def _null_left(self) -> Record:
+        return [None] * len(self.left.columns)
+
+    def execute(self, left_records: Sequence[Record],
+                right_records: Sequence[Record]) -> List[Record]:
+        right_by_key: Dict[Tuple, List[Record]] = defaultdict(list)
+        for r in right_records:
+            right_by_key[tuple(r[i] for i in self._r_idx)].append(r)
+        out: List[Record] = []
+        matched_right: set = set()
+        for l in left_records:
+            key = tuple(l[i] for i in self._l_idx)
+            matches = right_by_key.get(key, [])
+            if matches:
+                matched_right.add(key)
+                for r in matches:
+                    out.append(list(l) + [r[i] for i in self._r_keep])
+            elif self.join_type in (self.LEFT_OUTER, self.FULL_OUTER):
+                out.append(list(l) + [None] * len(self._r_keep))
+        if self.join_type in (self.RIGHT_OUTER, self.FULL_OUTER):
+            for key, rs in right_by_key.items():
+                if key in matched_right:
+                    continue
+                for r in rs:
+                    row = self._null_left()
+                    for ki, li in zip(range(len(key)), self._l_idx):
+                        row[li] = key[ki]       # keys surface on left cols
+                    out.append(row + [r[i] for i in self._r_keep])
+        return out
+
+
+# ---------------------------------------------------------------------------
+# analysis (reference AnalyzeLocal / DataAnalysis)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class NumericalColumnAnalysis:
+    count: int
+    count_missing: int
+    min: float
+    max: float
+    mean: float
+    stdev: float
+
+    def __str__(self):
+        return (f"count={self.count} missing={self.count_missing} "
+                f"min={self.min:.6g} max={self.max:.6g} "
+                f"mean={self.mean:.6g} stdev={self.stdev:.6g}")
+
+
+@dataclasses.dataclass
+class CategoricalColumnAnalysis:
+    count: int
+    counts: Dict[str, int]
+
+    def __str__(self):
+        return f"count={self.count} categories={dict(self.counts)}"
+
+
+@dataclasses.dataclass
+class StringColumnAnalysis:
+    count: int
+    unique: int
+    min_length: int
+    max_length: int
+    mean_length: float
+
+    def __str__(self):
+        return (f"count={self.count} unique={self.unique} "
+                f"len=[{self.min_length},{self.max_length}] "
+                f"meanLen={self.mean_length:.3g}")
+
+
+class DataAnalysis:
+    """Per-column analysis results (reference `DataAnalysis`)."""
+
+    def __init__(self, schema: Schema, analyses: Dict[str, Any]):
+        self.schema = schema
+        self._analyses = analyses
+
+    def get_column_analysis(self, name: str):
+        return self._analyses[name]
+
+    def __str__(self):
+        lines = ["DataAnalysis:"]
+        for c in self.schema.columns:
+            lines.append(f"  {c.name} ({c.kind}): "
+                         f"{self._analyses[c.name]}")
+        return "\n".join(lines)
+
+
+class AnalyzeLocal:
+    """Single-pass local analysis (reference `AnalyzeLocal.analyze`)."""
+
+    @staticmethod
+    def analyze(schema: Schema, records: Sequence[Record]) -> DataAnalysis:
+        analyses: Dict[str, Any] = {}
+        for idx, col in enumerate(schema.columns):
+            values = [r[idx] for r in records]
+            if col.kind in ("double", "integer", "time"):
+                present = [float(v) for v in values
+                           if v is not None
+                           and not (isinstance(v, float) and math.isnan(v))]
+                arr = np.asarray(present, np.float64)
+                analyses[col.name] = NumericalColumnAnalysis(
+                    count=len(present),
+                    count_missing=len(values) - len(present),
+                    min=float(arr.min()) if len(arr) else float("nan"),
+                    max=float(arr.max()) if len(arr) else float("nan"),
+                    mean=float(arr.mean()) if len(arr) else float("nan"),
+                    stdev=float(arr.std(ddof=1)) if len(arr) > 1 else 0.0)
+            elif col.kind == "categorical":
+                cnt = Counter(str(v) for v in values if v is not None)
+                analyses[col.name] = CategoricalColumnAnalysis(
+                    count=sum(cnt.values()), counts=dict(cnt))
+            else:                                   # string
+                lens = [len(str(v)) for v in values if v is not None]
+                analyses[col.name] = StringColumnAnalysis(
+                    count=len(lens),
+                    unique=len({str(v) for v in values if v is not None}),
+                    min_length=min(lens) if lens else 0,
+                    max_length=max(lens) if lens else 0,
+                    mean_length=(sum(lens) / len(lens)) if lens else 0.0)
+        return DataAnalysis(schema, analyses)

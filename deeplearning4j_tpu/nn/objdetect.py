@@ -49,12 +49,8 @@ class SpaceToDepthLayer(Layer):
         return {}, {}, InputType.convolutional(h // b, w // b, c * b * b)
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
-        b = self.block_size
-        B, H, W, C = x.shape
-        x = x.reshape(B, H // b, b, W // b, b, C)
-        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(B, H // b, W // b,
-                                                  b * b * C)
-        return x, state
+        from deeplearning4j_tpu.autodiff.ops import _space_to_depth
+        return _space_to_depth(x, self.block_size), state
 
 
 @dataclasses.dataclass(kw_only=True)

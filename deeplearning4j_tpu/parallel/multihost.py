@@ -137,6 +137,44 @@ def child_env(coordinator: str, num_processes: int, process_id: int,
     return env
 
 
+class ElasticLocalRunner:
+    """Failure detection + elastic restart (SURVEY §5.3; reference analog:
+    Spark task retry around SharedTraining workers).
+
+    Failure DETECTION is the `jax.distributed` coordination service's
+    heartbeat: when any rank dies, every surviving rank is killed with a
+    "peer task died" fatal within the service timeout — exactly the
+    reference Aeron mesh's session-timeout role.  This runner supervises
+    on top: it relaunches the whole gang after a failure, and the worker
+    script resumes from its latest checkpoint (checkpoint/resume is exact,
+    utils.serialization), giving crash-restart elasticity without any
+    parameter-server state."""
+
+    def __init__(self, num_processes: int, devices_per_process: int = 1,
+                 platform: str = "cpu", max_restarts: int = 2):
+        self.num_processes = num_processes
+        self.devices_per_process = devices_per_process
+        self.platform = platform
+        self.max_restarts = max_restarts
+        self.restarts = 0
+
+    def run(self, script: str, args: Sequence[str] = (),
+            timeout: float = 300.0) -> List[str]:
+        last_error: Optional[RuntimeError] = None
+        for attempt in range(self.max_restarts + 1):
+            launcher = LocalLauncher(self.num_processes,
+                                     self.devices_per_process,
+                                     self.platform)
+            try:
+                return launcher.run(script, args, timeout)
+            except RuntimeError as e:
+                last_error = e
+                self.restarts = min(attempt + 1, self.max_restarts)
+        raise RuntimeError(
+            f"training failed after {self.max_restarts} restarts"
+        ) from last_error
+
+
 class LocalLauncher:
     """Spawn an SPMD worker script across N localhost processes and wait.
 

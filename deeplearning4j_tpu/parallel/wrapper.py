@@ -217,6 +217,8 @@ class ParallelWrapper:
             with self.mesh:
                 for _ in range(epochs):
                     m.fit(x, y)
+                    if epochs > 1:
+                        m.epoch += 1
             return self
         if hasattr(data, "features"):              # bare DataSet/MultiDataSet
             for _ in range(epochs):

@@ -29,13 +29,31 @@ MANIFEST_JSON = "manifest.json"
 NORMALIZER_BIN = "normalizer.bin"
 
 
+def _to_host(leaf) -> np.ndarray:
+    """Device array -> host numpy, including multi-process global arrays:
+    a replicated array spans non-addressable (remote) devices, but every
+    process holds a complete local copy — read that shard.  Partition-
+    sharded leaves must be all-gathered first (parallel.multihost
+    .allgather_params), same contract as the reference's Spark
+    driver-side param sync before ModelSerializer."""
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        shard = leaf.addressable_data(0)
+        if shard.shape != leaf.shape:
+            raise ValueError(
+                "Cannot checkpoint a partition-sharded array from one "
+                "process — gather it first (multihost.allgather_params)")
+        return np.asarray(shard)
+    return np.asarray(leaf)
+
+
 def _tree_to_flat(tree: Any):
     leaves = jax.tree_util.tree_leaves(tree)
     if not leaves:
         return b"", []
-    manifest = [{"shape": list(np.shape(l)), "dtype": str(np.asarray(l).dtype)}
-                for l in leaves]
-    buf = b"".join(np.ascontiguousarray(np.asarray(l)).tobytes() for l in leaves)
+    host = [_to_host(l) for l in leaves]
+    manifest = [{"shape": list(l.shape), "dtype": str(l.dtype)}
+                for l in host]
+    buf = b"".join(np.ascontiguousarray(l).tobytes() for l in host)
     return buf, manifest
 
 

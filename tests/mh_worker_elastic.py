@@ -1,0 +1,73 @@
+"""Elastic-training worker (spawned by test_multihost via
+ElasticLocalRunner — NOT a pytest file).
+
+Trains an MLN across processes with per-step checkpoints; on the FIRST
+launch, rank 1 deliberately crashes partway (marker file guards the
+one-shot crash).  The relaunch must resume from the checkpoint and finish
+all steps — proving failure detection (coordination-service heartbeat
+kills the gang) + elastic restart + exact resume."""
+import os
+import sys
+
+import numpy as np
+
+from deeplearning4j_tpu.parallel import multihost
+
+multihost.initialize()
+
+import jax  # noqa: E402
+
+from deeplearning4j_tpu.nn import (DenseLayer, InputType,  # noqa: E402
+                                   MultiLayerNetwork, NeuralNetConfiguration,
+                                   OutputLayer)
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper  # noqa: E402
+from deeplearning4j_tpu.train import Sgd  # noqa: E402
+
+work_dir = sys.argv[1]
+total_steps = int(sys.argv[2])
+crash_at = int(sys.argv[3])
+rank = multihost.process_index()
+ckpt = os.path.join(work_dir, "ckpt.zip")
+crash_marker = os.path.join(work_dir, "crashed_once")
+
+rng = np.random.default_rng(0)
+X = rng.standard_normal((16, 10)).astype(np.float32)
+Y = np.eye(2, dtype=np.float32)[(X.sum(1) > 0).astype(int)]
+per = X.shape[0] // multihost.process_count()
+xl = X[rank * per:(rank + 1) * per]
+yl = Y[rank * per:(rank + 1) * per]
+
+if os.path.exists(ckpt):
+    net = MultiLayerNetwork.load(ckpt)
+    print(f"rank {rank}: resumed at iteration {net.iteration}", flush=True)
+else:
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.1))
+            .list([DenseLayer(n_out=16, activation="tanh"),
+                   OutputLayer(n_out=2, loss="mcxent",
+                               activation="softmax")])
+            .set_input_type(InputType.feed_forward(10)).build())
+    net = MultiLayerNetwork(conf).init()
+
+mesh = multihost.global_mesh()
+pw = ParallelWrapper(net, mesh)
+while net.iteration < total_steps:
+    if (net.iteration == crash_at and rank == 1
+            and not os.path.exists(crash_marker)):
+        open(crash_marker, "w").write("1")
+        print(f"rank {rank}: simulating crash at {net.iteration}",
+              flush=True)
+        os._exit(1)
+    pw.fit_host_local(xl, yl)
+    # materialize the step on EVERY rank before the next loop turn: jax
+    # dispatch is async, so without this a crashing rank can take down
+    # collectives that logically "happened" steps ago
+    jax.block_until_ready(net.params_)
+    if rank == 0:
+        # atomic checkpoint: a mid-write kill must not corrupt the file
+        net.save(ckpt + ".tmp")
+        os.replace(ckpt + ".tmp", ckpt)
+if rank == 0:
+    np.savez(os.path.join(work_dir, "final.npz"),
+             params=np.asarray(net.params()),
+             iteration=np.int64(net.iteration))
+print(f"rank {rank}: done at iteration {net.iteration}", flush=True)

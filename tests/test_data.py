@@ -273,3 +273,59 @@ def test_synthetic_cifar_trains():
     it = SyntheticCifar10(16, n_batches=4)
     net.fit(it, epochs=2)
     assert np.isfinite(net.score())
+
+
+# ---------------------------------------------------------------------------
+# DataVec joins + analysis (SURVEY §2 L5 gap rows)
+# ---------------------------------------------------------------------------
+
+def _join_fixtures():
+    from deeplearning4j_tpu.data.transform import Schema
+    left = (Schema.builder().add_column_integer("id")
+            .add_column_string("name").build())
+    right = (Schema.builder().add_column_integer("id")
+             .add_column_double("score").build())
+    lrec = [[0, "zero"], [1, "one"], [2, "two"]]
+    rrec = [[1, 0.5], [2, 0.7], [2, 0.9], [3, 0.1]]
+    return left, right, lrec, rrec
+
+
+def test_join_inner_and_outer_variants():
+    from deeplearning4j_tpu.data import Join
+    left, right, lrec, rrec = _join_fixtures()
+
+    def run(jt):
+        j = (Join.builder(jt).set_left_schema(left)
+             .set_right_schema(right).set_join_columns("id").build())
+        return j, j.execute(lrec, rrec)
+
+    j, inner = run(Join.INNER)
+    assert j.output_schema().names() == ["id", "name", "score"]
+    assert sorted(inner) == [[1, "one", 0.5], [2, "two", 0.7],
+                             [2, "two", 0.9]]
+    _, louter = run(Join.LEFT_OUTER)
+    assert [0, "zero", None] in louter and len(louter) == 4
+    _, router = run(Join.RIGHT_OUTER)
+    assert [3, None, 0.1] in router and len(router) == 4
+    _, full = run(Join.FULL_OUTER)
+    assert len(full) == 5
+    assert [0, "zero", None] in full and [3, None, 0.1] in full
+
+
+def test_analyze_local_column_stats():
+    from deeplearning4j_tpu.data import AnalyzeLocal
+    from deeplearning4j_tpu.data.transform import Schema
+    schema = (Schema.builder().add_column_double("x")
+              .add_column_categorical("cat", ["a", "b"])
+              .add_column_string("s").build())
+    records = [[1.0, "a", "hello"], [3.0, "b", "hi"],
+               [None, "a", "hello"], [5.0, "a", None]]
+    an = AnalyzeLocal.analyze(schema, records)
+    xa = an.get_column_analysis("x")
+    assert xa.count == 3 and xa.count_missing == 1
+    assert xa.min == 1.0 and xa.max == 5.0 and abs(xa.mean - 3.0) < 1e-9
+    ca = an.get_column_analysis("cat")
+    assert ca.counts == {"a": 3, "b": 1}
+    sa = an.get_column_analysis("s")
+    assert sa.unique == 2 and sa.min_length == 2 and sa.max_length == 5
+    assert "x (double)" in str(an)

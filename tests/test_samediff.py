@@ -342,3 +342,52 @@ def test_cross_scope_variable_rejected():
     with pytest.raises(ValueError, match="different SameDiff scope"):
         sd.cond(1.0, lambda s, a: s.op("mul", a, w),
                 lambda s, a: a, x)
+
+
+def test_extended_op_coverage():
+    """Spot-check the extended declarable-op set through the graph engine
+    (reference: generic op CustomOpTests)."""
+    rng = np.random.default_rng(0)
+    a_np = rng.standard_normal((4, 4)).astype(np.float32)
+    spd = a_np @ a_np.T + 4 * np.eye(4, dtype=np.float32)
+
+    sd = SameDiff.create()
+    a = sd.var("a", a_np)
+    s = sd.var("s", spd)
+    data = sd.var("data", rng.standard_normal((6, 3)).astype(np.float32))
+    ids = sd.constant("ids", np.array([0, 0, 1, 2, 1, 0]))
+
+    vs = [sd.op("sort", a, axis=-1),
+          sd.op("tril", a),
+          sd.op("trace", a),
+          sd.op("cholesky", s),
+          sd.op("matrix_inverse", s),
+          sd.op("segment_sum", data, ids, num_segments=3),
+          sd.op("l2_normalize", a, axis=-1),
+          sd.op("cumprod", a, axis=1),
+          sd.op("squared_difference", a, a.mul(2.0)),
+          sd.op("mish", a)]
+    outs = sd.output({}, *vs)
+    vals = [np.asarray(outs[v.name]) for v in vs]
+    np.testing.assert_allclose(vals[0], np.sort(a_np, -1), rtol=1e-6)
+    np.testing.assert_allclose(vals[1], np.tril(a_np))
+    np.testing.assert_allclose(vals[2], np.trace(a_np), rtol=1e-6)
+    L = vals[3]
+    np.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(vals[4] @ spd, np.eye(4), atol=1e-4)
+    assert vals[5].shape == (3, 3)
+    np.testing.assert_allclose(np.linalg.norm(vals[6], axis=-1),
+                               1.0, rtol=1e-5)
+    np.testing.assert_allclose(vals[7], np.cumprod(a_np, 1), rtol=1e-5)
+    np.testing.assert_allclose(vals[8], a_np * a_np, rtol=1e-5)
+    assert np.isfinite(vals[9]).all()
+
+
+def test_scatter_and_gather_nd():
+    sd = SameDiff.create()
+    base = sd.var("base", np.zeros((5, 2), np.float32))
+    upd = sd.constant("upd", np.ones((2, 2), np.float32))
+    idx = sd.constant("idx", np.array([1, 3]))
+    out = sd.op("scatter_add", base, idx, upd)
+    r = np.asarray(out.eval({}))
+    assert r[1].sum() == 2 and r[3].sum() == 2 and r[0].sum() == 0
