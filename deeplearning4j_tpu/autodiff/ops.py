@@ -642,3 +642,16 @@ register_op("arange", lambda start, stop=None, step=1, dtype="float32":
             jnp.arange(start, stop, step, dtype=jnp.dtype(dtype)))
 register_op("full", lambda shape, value, dtype="float32":
             jnp.full(tuple(shape), value, jnp.dtype(dtype)))
+
+
+@register_op("depthwise_conv2d")
+def _depthwise_conv2d(x, w, stride=(1, 1), padding="SAME",
+                      dilation=(1, 1)):
+    """NHWC x, HWIO w with I=1 grouping per input channel (TF
+    DepthwiseConv2dNative filter layout [H, W, C, mult] reshaped by the
+    importer to [H, W, 1, C*mult])."""
+    return lax.conv_general_dilated(
+        x, w, window_strides=tuple(stride), padding=padding,
+        rhs_dilation=tuple(dilation),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=x.shape[-1])

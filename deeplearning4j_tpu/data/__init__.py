@@ -18,3 +18,5 @@ from deeplearning4j_tpu.data.datasets import (  # noqa: F401
     MnistDataSetIterator, SyntheticCifar10, SyntheticMnist, read_idx)
 from deeplearning4j_tpu.data.analysis import (  # noqa: F401
     AnalyzeLocal, DataAnalysis, Join)
+from deeplearning4j_tpu.data.audio import (  # noqa: F401
+    SpectrogramRecordReader, WavFileRecordReader, read_wav, spectrogram)

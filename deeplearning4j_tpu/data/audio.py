@@ -1,0 +1,103 @@
+"""Audio record readers (reference `datavec-data/datavec-data-audio/.../
+{WavFileRecordReader,NativeAudioRecordReader}.java`).
+
+The reference wraps jlayer/FFmpeg; here PCM WAV decoding is stdlib `wave`
++ numpy (zero-egress image has no media libs), and the spectrogram
+front-end is a numpy STFT — ETL stays host-side (SURVEY §3.3), the device
+sees fixed-shape float batches."""
+from __future__ import annotations
+
+import os
+import wave
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.data.records import RecordReader
+
+
+def read_wav(path: str) -> tuple:
+    """PCM WAV -> (float32 waveform [n_samples, n_channels] in [-1, 1],
+    sample_rate)."""
+    with wave.open(path, "rb") as w:
+        n = w.getnframes()
+        width = w.getsampwidth()
+        channels = w.getnchannels()
+        rate = w.getframerate()
+        raw = w.readframes(n)
+    if width == 1:                      # unsigned 8-bit
+        x = (np.frombuffer(raw, np.uint8).astype(np.float32) - 128.0) / 128.0
+    elif width == 2:
+        x = np.frombuffer(raw, "<i2").astype(np.float32) / 32768.0
+    elif width == 4:
+        x = np.frombuffer(raw, "<i4").astype(np.float32) / 2147483648.0
+    else:
+        raise ValueError(f"{path}: unsupported sample width {width}")
+    return x.reshape(-1, channels), rate
+
+
+class WavFileRecordReader(RecordReader):
+    """One record per .wav file: the mono waveform as a float list
+    (reference `WavFileRecordReader`)."""
+
+    def __init__(self, paths: Optional[List[str]] = None,
+                 directory: Optional[str] = None,
+                 max_samples: Optional[int] = None):
+        if directory is not None:
+            paths = sorted(
+                os.path.join(directory, f) for f in os.listdir(directory)
+                if f.lower().endswith(".wav"))
+        if not paths:
+            raise ValueError("No .wav inputs")
+        self.paths = list(paths)
+        self.max_samples = max_samples
+
+    def __iter__(self) -> Iterator[list]:
+        for p in self.paths:
+            x, _ = read_wav(p)
+            mono = x.mean(axis=1)
+            if self.max_samples is not None:
+                mono = mono[: self.max_samples]
+            yield list(mono.astype(np.float32))
+
+
+def spectrogram(waveform: np.ndarray, frame_length: int = 256,
+                hop: int = 128, log: bool = True,
+                eps: float = 1e-10) -> np.ndarray:
+    """Magnitude (optionally log) STFT spectrogram [frames, bins] via a
+    Hann-windowed numpy rFFT — the datavec-data-audio front-end role."""
+    x = np.asarray(waveform, np.float32).reshape(-1)
+    if len(x) < frame_length:
+        x = np.pad(x, (0, frame_length - len(x)))
+    n_frames = 1 + (len(x) - frame_length) // hop
+    idx = (np.arange(frame_length)[None, :]
+           + hop * np.arange(n_frames)[:, None])
+    frames = x[idx] * np.hanning(frame_length)[None, :]
+    mag = np.abs(np.fft.rfft(frames, axis=1)).astype(np.float32)
+    return np.log(mag + eps) if log else mag
+
+
+class SpectrogramRecordReader(RecordReader):
+    """One record per .wav file: flattened log-spectrogram features with a
+    fixed frame count (pad/truncate), ready for dense/conv layers."""
+
+    def __init__(self, paths: Optional[List[str]] = None,
+                 directory: Optional[str] = None, frame_length: int = 256,
+                 hop: int = 128, n_frames: int = 64):
+        self._wav = WavFileRecordReader(paths, directory)
+        self.frame_length = frame_length
+        self.hop = hop
+        self.n_frames = n_frames
+
+    def output_shape(self) -> tuple:
+        return (self.n_frames, self.frame_length // 2 + 1)
+
+    def __iter__(self) -> Iterator[list]:
+        for p in self._wav.paths:
+            x, _ = read_wav(p)
+            spec = spectrogram(x.mean(axis=1), self.frame_length, self.hop)
+            if spec.shape[0] < self.n_frames:
+                spec = np.pad(spec,
+                              ((0, self.n_frames - spec.shape[0]), (0, 0)),
+                              constant_values=np.log(1e-10))
+            yield list(spec[: self.n_frames].reshape(-1))

@@ -147,6 +147,36 @@ def _conv2d(sd, n, ins):
                  padding=padding, name=n.name)
 
 
+def _static_shape_of(sd, var):
+    """Resolve a variable's static shape through identity chains to its
+    constant (frozen-graph weight paths go Const -> Identity('.../read'))."""
+    node = sd._nodes[var.name]
+    while node.kind == "op" and node.op == "identity":
+        node = sd._nodes[node.inputs[0]]
+    if node.kind in ("constant", "variable") and node.shape is not None:
+        return tuple(node.shape)
+    raise UnmappedTFOpException(
+        f"cannot resolve a static shape for '{var.name}' "
+        f"(kind={node.kind}, op={node.op})")
+
+
+@R("DepthwiseConv2dNative")
+def _depthwise_conv2d_tf(sd, n, ins):
+    if n.attr["data_format"].s not in (b"", b"NHWC"):
+        raise UnmappedTFOpException("DepthwiseConv2dNative: only NHWC "
+                                    "supported (TPU-native layout)")
+    strides = list(n.attr["strides"].list.i)
+    dil = list(n.attr["dilations"].list.i) or [1, 1, 1, 1]
+    # TF filter [H, W, C, mult] -> grouped HWIO [H, W, 1, C*mult],
+    # reshaped IN-GRAPH (no weight duplication; works through Identity)
+    h, wd, c, mult = _static_shape_of(sd, ins[1])
+    w_g = sd.op("reshape", ins[1], shape=[h, wd, 1, c * mult])
+    return sd.op("depthwise_conv2d", ins[0], w_g,
+                 stride=(int(strides[1]), int(strides[2])),
+                 dilation=(int(dil[1]), int(dil[2])),
+                 padding=n.attr["padding"].s.decode(), name=n.name)
+
+
 @R("MaxPool")
 def _maxpool(sd, n, ins):
     k = list(n.attr["ksize"].list.i)

@@ -13,3 +13,7 @@ from deeplearning4j_tpu.parallel.wrapper import (  # noqa: F401
     ParallelInference, ParallelWrapper)
 from deeplearning4j_tpu.parallel.sharding import (  # noqa: F401
     ShardingRules, shard_model_params)
+from deeplearning4j_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_apply, sequential_apply, stack_stage_params)
+from deeplearning4j_tpu.parallel.multihost import (  # noqa: F401
+    ElasticLocalRunner, LocalLauncher)

@@ -342,3 +342,22 @@ def test_tf_fused_batchnorm_and_split_import():
     out_name = gd.node[-1].name
     got = np.asarray(sd.output({"x": x}, out_name)[out_name])
     np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_tf_depthwise_conv_import():
+    k = tf.constant(np.random.RandomState(0).randn(3, 3, 2, 2)
+                    .astype(np.float32) * 0.2)
+
+    def f(x):
+        y = tf.nn.depthwise_conv2d(x, k, strides=[1, 1, 1, 1],
+                                   padding="SAME")
+        return tf.nn.relu(y)
+
+    gd, frozen = _freeze(f, tf.TensorSpec((2, 6, 6, 2), tf.float32))
+    assert "DepthwiseConv2dNative" in {n.op for n in gd.node}
+    sd = import_graph_def(gd)
+    x = np.random.RandomState(1).randn(2, 6, 6, 2).astype(np.float32)
+    expected = frozen(tf.constant(x))[0].numpy()
+    out = gd.node[-1].name
+    got = np.asarray(sd.output({"x": x}, out)[out])
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
