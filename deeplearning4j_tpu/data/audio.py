@@ -15,6 +15,8 @@ import numpy as np
 
 from deeplearning4j_tpu.data.records import RecordReader
 
+_SILENCE_EPS = 1e-10      # log-spectrogram silence floor (shared w/ pads)
+
 
 def read_wav(path: str) -> tuple:
     """PCM WAV -> (float32 waveform [n_samples, n_channels] in [-1, 1],
@@ -43,6 +45,8 @@ class WavFileRecordReader(RecordReader):
     def __init__(self, paths: Optional[List[str]] = None,
                  directory: Optional[str] = None,
                  max_samples: Optional[int] = None):
+        if paths is not None and directory is not None:
+            raise ValueError("pass either paths or directory, not both")
         if directory is not None:
             paths = sorted(
                 os.path.join(directory, f) for f in os.listdir(directory)
@@ -63,10 +67,16 @@ class WavFileRecordReader(RecordReader):
 
 def spectrogram(waveform: np.ndarray, frame_length: int = 256,
                 hop: int = 128, log: bool = True,
-                eps: float = 1e-10) -> np.ndarray:
+                eps: float = _SILENCE_EPS) -> np.ndarray:
     """Magnitude (optionally log) STFT spectrogram [frames, bins] via a
-    Hann-windowed numpy rFFT — the datavec-data-audio front-end role."""
-    x = np.asarray(waveform, np.float32).reshape(-1)
+    Hann-windowed numpy rFFT — the datavec-data-audio front-end role.
+    Multi-channel [n, c] input is mixed down to mono (never interleaved)."""
+    x = np.asarray(waveform, np.float32)
+    if x.ndim == 2:
+        x = x.mean(axis=1)
+    elif x.ndim != 1:
+        raise ValueError(f"waveform must be 1-D or [n, channels], "
+                         f"got shape {x.shape}")
     if len(x) < frame_length:
         x = np.pad(x, (0, frame_length - len(x)))
     n_frames = 1 + (len(x) - frame_length) // hop
@@ -99,5 +109,5 @@ class SpectrogramRecordReader(RecordReader):
             if spec.shape[0] < self.n_frames:
                 spec = np.pad(spec,
                               ((0, self.n_frames - spec.shape[0]), (0, 0)),
-                              constant_values=np.log(1e-10))
+                              constant_values=np.log(_SILENCE_EPS))
             yield list(spec[: self.n_frames].reshape(-1))

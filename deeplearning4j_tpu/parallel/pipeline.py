@@ -51,6 +51,11 @@ def pipeline_apply(block_fn: Callable, stacked_params, x: jnp.ndarray,
     — the ICI-neighbor transfer pattern.
     """
     S = mesh.shape[axis]
+    n_stages = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if n_stages != S:
+        raise ValueError(
+            f"{n_stages} stacked stages but mesh axis '{axis}' has {S} "
+            "devices — stage count must equal the pipe-axis size")
     M = num_microbatches or S
     B = x.shape[0]
     if B % M:
