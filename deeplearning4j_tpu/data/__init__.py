@@ -20,3 +20,6 @@ from deeplearning4j_tpu.data.analysis import (  # noqa: F401
     AnalyzeLocal, DataAnalysis, Join)
 from deeplearning4j_tpu.data.audio import (  # noqa: F401
     SpectrogramRecordReader, WavFileRecordReader, read_wav, spectrogram)
+from deeplearning4j_tpu.data.arrow import (  # noqa: F401
+    ArrowRecordReader, records_to_table, schema_from_arrow,
+    table_to_records, write_records_to_file)

@@ -1,0 +1,101 @@
+"""Arrow/Parquet record IO (reference `datavec-arrow/.../arrow/
+{ArrowRecordReader,ArrowConverter}.java`).
+
+Columnar files map onto the record/Schema model: Arrow schema types become
+ColumnMeta kinds, record batches become row lists.  pyarrow does the
+format work; this module is the Schema/Record bridge the reference's
+ArrowConverter plays."""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from deeplearning4j_tpu.data.records import RecordReader
+from deeplearning4j_tpu.data.transform import ColumnMeta, Schema
+
+
+def _require_pyarrow():
+    try:
+        import pyarrow  # noqa: F401
+        return pyarrow
+    except ImportError as e:
+        raise ImportError(
+            "pyarrow is required for Arrow/Parquet record IO "
+            "(reference datavec-arrow role)") from e
+
+
+def schema_from_arrow(arrow_schema) -> Schema:
+    """Arrow types -> ColumnMeta kinds (the ArrowConverter mapping)."""
+    import pyarrow as pa
+    cols = []
+    for field in arrow_schema:
+        t = field.type
+        if pa.types.is_floating(t):
+            kind = "double"
+        elif pa.types.is_integer(t) or pa.types.is_boolean(t):
+            kind = "integer"
+        elif pa.types.is_timestamp(t) or pa.types.is_date(t):
+            kind = "time"
+        elif pa.types.is_dictionary(t):
+            kind = "categorical"
+        else:
+            kind = "string"
+        cols.append(ColumnMeta(field.name, kind))
+    return Schema(cols)
+
+
+def table_to_records(table) -> List[list]:
+    """Arrow Table -> row-major records (None for nulls)."""
+    cols = [c.to_pylist() for c in table.columns]
+    return [list(row) for row in zip(*cols)] if cols else []
+
+
+def records_to_table(schema: Schema, records) :
+    """Records + Schema -> Arrow Table (the write half of ArrowConverter)."""
+    pa = _require_pyarrow()
+    arrays = []
+    for i, col in enumerate(schema.columns):
+        values = [r[i] for r in records]
+        if col.kind == "double":
+            arrays.append(pa.array(values, pa.float64()))
+        elif col.kind == "integer":
+            arrays.append(pa.array(values, pa.int64()))
+        elif col.kind == "time":
+            arrays.append(pa.array(values, pa.timestamp("ms")))
+        elif col.kind == "categorical":
+            arrays.append(pa.array(
+                [None if v is None else str(v) for v in values]
+            ).dictionary_encode())
+        else:
+            arrays.append(pa.array(
+                [None if v is None else str(v) for v in values]))
+    return pa.table(dict(zip(schema.names(), arrays)))
+
+
+class ArrowRecordReader(RecordReader):
+    """Read .arrow / .feather / .parquet files as records (reference
+    `ArrowRecordReader`).  `schema` is derived from the file."""
+
+    def __init__(self, path: str):
+        pa = _require_pyarrow()
+        if path.endswith(".parquet"):
+            import pyarrow.parquet as pq
+            self._table = pq.read_table(path)
+        else:
+            with pa.ipc.open_file(path) as reader:
+                self._table = reader.read_all()
+        self.schema = schema_from_arrow(self._table.schema)
+
+    def __iter__(self) -> Iterator[list]:
+        yield from table_to_records(self._table)
+
+
+def write_records_to_file(schema: Schema, records, path: str) -> None:
+    """Write records as .feather (arrow IPC) or .parquet by extension."""
+    pa = _require_pyarrow()
+    table = records_to_table(schema, records)
+    if path.endswith(".parquet"):
+        import pyarrow.parquet as pq
+        pq.write_table(table, path)
+    else:
+        with pa.ipc.new_file(path, table.schema) as writer:
+            writer.write_table(table)

@@ -105,7 +105,7 @@ class SpectrogramRecordReader(RecordReader):
     def __iter__(self) -> Iterator[list]:
         for p in self._wav.paths:
             x, _ = read_wav(p)
-            spec = spectrogram(x.mean(axis=1), self.frame_length, self.hop)
+            spec = spectrogram(x, self.frame_length, self.hop)
             if spec.shape[0] < self.n_frames:
                 spec = np.pad(spec,
                               ((0, self.n_frames - spec.shape[0]), (0, 0)),

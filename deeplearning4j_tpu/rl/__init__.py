@@ -7,3 +7,4 @@ from deeplearning4j_tpu.rl.qlearning import (  # noqa: F401
     QLearningConfiguration, QLearningDiscrete)
 from deeplearning4j_tpu.rl.async_learning import (  # noqa: F401
     A3CDiscrete, AsyncConfiguration, AsyncNStepQLearningDiscrete)
+from deeplearning4j_tpu.rl.gym_adapter import GymMDP  # noqa: F401

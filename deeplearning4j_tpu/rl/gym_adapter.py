@@ -1,0 +1,55 @@
+"""Gymnasium environment adapter (reference `rl4j-gym/.../mdp/gym/
+GymEnv.java` — the reference bridges OpenAI Gym over a JSON HTTP client;
+here gymnasium is in-process).
+
+Wraps any discrete-action gymnasium env in the `rl.mdp.MDP` protocol so
+QLearningDiscrete / A3CDiscrete / AsyncNStepQLearningDiscrete train on it
+unchanged."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.rl.mdp import MDP
+
+
+class GymMDP(MDP):
+    """`GymMDP("CartPole-v1")` (reference `GymEnv(envId)`)."""
+
+    def __init__(self, env_id: str, seed: Optional[int] = None, **kwargs):
+        try:
+            import gymnasium
+        except ImportError as e:
+            raise ImportError(
+                "gymnasium is required for GymMDP (reference rl4j-gym "
+                "role)") from e
+        self.env = gymnasium.make(env_id, **kwargs)
+        if not hasattr(self.env.action_space, "n"):
+            raise ValueError(
+                f"{env_id}: only discrete action spaces are supported "
+                "(reference rl4j discrete learners)")
+        self.n_actions = int(self.env.action_space.n)
+        self.observation_size = int(
+            np.prod(self.env.observation_space.shape))
+        self._seed = seed
+        self._done = False
+
+    def reset(self) -> np.ndarray:
+        obs, _ = self.env.reset(seed=self._seed)
+        if self._seed is not None:
+            self._seed += 1          # vary episodes, stay reproducible
+        self._done = False
+        return np.asarray(obs, np.float32).reshape(-1)
+
+    def step(self, action: int) -> Tuple[np.ndarray, float, bool, dict]:
+        obs, reward, terminated, truncated, info = self.env.step(int(action))
+        self._done = bool(terminated or truncated)
+        return (np.asarray(obs, np.float32).reshape(-1), float(reward),
+                self._done, dict(info))
+
+    def is_done(self) -> bool:
+        return self._done
+
+    def close(self):
+        self.env.close()

@@ -379,3 +379,29 @@ def test_spectrogram_peaks_at_tone_frequency(tmp_path):
     rr = SpectrogramRecordReader(directory=str(tmp_path), n_frames=16)
     rec = next(iter(rr))
     assert len(rec) == 16 * 129
+
+
+# ---------------------------------------------------------------------------
+# Arrow/Parquet record IO (reference datavec-arrow)
+# ---------------------------------------------------------------------------
+
+def test_arrow_roundtrip_feather_and_parquet(tmp_path):
+    pytest.importorskip("pyarrow")
+    from deeplearning4j_tpu.data import (ArrowRecordReader,
+                                         write_records_to_file)
+    from deeplearning4j_tpu.data.transform import Schema
+    schema = (Schema.builder().add_column_integer("id")
+              .add_column_double("x").add_column_string("name")
+              .add_column_categorical("cat", ["u", "v"]).build())
+    records = [[1, 0.5, "a", "u"], [2, 1.5, "b", "v"],
+               [3, None, None, "u"]]
+    for ext in ("feather", "parquet"):
+        p = str(tmp_path / f"t.{ext}")
+        write_records_to_file(schema, records, p)
+        rr = ArrowRecordReader(p)
+        assert rr.schema.names() == ["id", "x", "name", "cat"]
+        assert [c.kind for c in rr.schema.columns] == [
+            "integer", "double", "string", "categorical"]
+        back = list(rr)
+        assert back[0] == [1, 0.5, "a", "u"]
+        assert back[2][1] is None and back[2][2] is None

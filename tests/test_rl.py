@@ -1,5 +1,6 @@
 """RL tests (reference rl4j tests: `QLearningDiscreteTest`,
 policy/replay unit tests; convergence on a toy MDP)."""
+import pytest
 import numpy as np
 
 from deeplearning4j_tpu.rl import (CartPole, EpsGreedy, ExpReplay,
@@ -98,3 +99,25 @@ def test_async_nstep_q_learns_lineworld():
     agent.train(lambda: LineWorld(8))
     score = np.mean([agent.play(LineWorld(8)) for _ in range(5)])
     assert score > 0.5, score
+
+
+def test_gym_adapter_trains_cartpole():
+    """Reference rl4j-gym role: a gymnasium env drives the same learners."""
+    pytest.importorskip("gymnasium")
+    from deeplearning4j_tpu.rl import (A3CDiscrete, AsyncConfiguration,
+                                       GymMDP)
+    probe = GymMDP("CartPole-v1", seed=0)
+    assert probe.n_actions == 2 and probe.observation_size == 4
+    obs = probe.reset()
+    assert obs.shape == (4,)
+    obs2, r, done, _ = probe.step(0)
+    assert r == 1.0 and obs2.shape == (4,)
+    probe.close()
+    conf = AsyncConfiguration(seed=0, max_step=30000, n_step=8, num_envs=8,
+                              learning_rate=3e-2, entropy_coef=0.01,
+                              hidden=(64,))
+    agent = A3CDiscrete(obs_size=4, n_actions=2, conf=conf)
+    agent.train(lambda: GymMDP("CartPole-v1"))
+    score = np.mean([agent.play(GymMDP("CartPole-v1", seed=100 + i))
+                     for i in range(3)])
+    assert score > 100, score     # random policy averages ~20
