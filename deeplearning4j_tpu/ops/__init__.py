@@ -1,3 +1,5 @@
 from deeplearning4j_tpu.ops.activations import ACTIVATIONS, get_activation  # noqa: F401
 from deeplearning4j_tpu.ops.initializers import init_weights  # noqa: F401
 from deeplearning4j_tpu.ops.losses import LOSSES, get_loss  # noqa: F401
+from deeplearning4j_tpu.ops.norm_kernels import (  # noqa: F401
+    fused_layer_norm, layer_norm_reference)

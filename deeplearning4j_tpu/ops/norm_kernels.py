@@ -1,0 +1,179 @@
+"""Pallas fused LayerNorm — the platform-helper pattern beyond attention.
+
+Reference analog: `libnd4j/include/ops/declarable/platform/cudnn/**` —
+vendor-tuned kernels behind a dispatch check.  XLA already fuses layer-norm
+chains well; this kernel exists for the long-sequence transformer path
+where keeping the (mean, rstd) statistics in VMEM between forward and
+backward avoids an HBM round-trip, and as the second instance (after
+`attention_kernels.fused_attention`) of the measured-dispatch pattern:
+`fused_layer_norm` uses the Pallas kernel only when shapes tile cleanly on
+TPU, else the plain jnp composition.
+
+custom_vjp wires the Pallas backward; gradients match the jnp reference
+(tests run the kernel in interpret mode on CPU)."""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def layer_norm_reference(x, gain, bias=None, eps: float = 1e-5):
+    """The canonical jnp layer norm (single impl: autodiff.ops)."""
+    from deeplearning4j_tpu.autodiff.ops import OP_TABLE
+    args = (x, gain) if bias is None else (x, gain, bias)
+    return OP_TABLE["layer_norm"](*args, eps=eps)
+
+
+# -- forward kernel ---------------------------------------------------------
+
+def _ln_fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean((x - mean) ** 2, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    y = (x - mean) * rstd * g_ref[...] + b_ref[...]
+    y_ref[...] = y.astype(y_ref.dtype)
+    mean_ref[...] = mean[..., 0]
+    rstd_ref[...] = rstd[..., 0]
+
+
+def _ln_bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref,
+                   dx_ref, dg_ref, db_ref):
+    x = x_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    g = g_ref[...]
+    mean = mean_ref[...][..., None]
+    rstd = rstd_ref[...][..., None]
+    xhat = (x - mean) * rstd
+    dg_ref[...] = jnp.sum(dy * xhat, axis=0)[None, :]
+    db_ref[...] = jnp.sum(dy, axis=0)[None, :]
+    wdy = dy * g
+    c1 = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
+    c2 = jnp.mean(wdy, axis=-1, keepdims=True)
+    dx = (wdy - xhat * c1 - c2) * rstd
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def _rows_of(x):
+    rows = 1
+    for d in x.shape[:-1]:
+        rows *= d
+    return rows
+
+
+def layer_norm_tpu(x, gain, bias=None, eps: float = 1e-5,
+                   block_rows: int = 256, interpret: bool = False):
+    """Pallas layer norm over the last axis.  x: [..., F]."""
+    F = x.shape[-1]
+    bias_ = jnp.zeros((F,), jnp.float32) if bias is None else bias
+    rows = _rows_of(x)
+    x2 = x.reshape(rows, F)
+    blk = min(block_rows, rows)
+    if rows % blk:
+        raise ValueError(f"rows {rows} not divisible by block {blk}")
+    grid = (rows // blk,)
+    y, mean, rstd = pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[pl.BlockSpec((blk, F), lambda i: (i, 0)),
+                  pl.BlockSpec((F,), lambda i: (0,)),
+                  pl.BlockSpec((F,), lambda i: (0,))],
+        out_specs=[pl.BlockSpec((blk, F), lambda i: (i, 0)),
+                   pl.BlockSpec((blk,), lambda i: (i,)),
+                   pl.BlockSpec((blk,), lambda i: (i,))],
+        out_shape=[jax.ShapeDtypeStruct((rows, F), x.dtype),
+                   jax.ShapeDtypeStruct((rows,), jnp.float32),
+                   jax.ShapeDtypeStruct((rows,), jnp.float32)],
+        interpret=interpret,
+    )(x2, gain.astype(jnp.float32), bias_.astype(jnp.float32))
+    return y.reshape(x.shape), mean, rstd
+
+
+def layer_norm_bwd_tpu(x, gain, mean, rstd, dy, block_rows: int = 256,
+                       interpret: bool = False):
+    F = x.shape[-1]
+    rows = _rows_of(x)
+    x2 = x.reshape(rows, F)
+    dy2 = dy.reshape(rows, F)
+    blk = min(block_rows, rows)
+    if rows % blk:
+        raise ValueError(f"rows {rows} not divisible by block {blk}")
+    grid = (rows // blk,)
+    dx, dg_part, db_part = pl.pallas_call(
+        _ln_bwd_kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((blk, F), lambda i: (i, 0)),
+                  pl.BlockSpec((F,), lambda i: (0,)),
+                  pl.BlockSpec((blk,), lambda i: (i,)),
+                  pl.BlockSpec((blk,), lambda i: (i,)),
+                  pl.BlockSpec((blk, F), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((blk, F), lambda i: (i, 0)),
+                   pl.BlockSpec((1, F), lambda i: (i, 0)),
+                   pl.BlockSpec((1, F), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((rows, F), x.dtype),
+                   jax.ShapeDtypeStruct((grid[0], F), jnp.float32),
+                   jax.ShapeDtypeStruct((grid[0], F), jnp.float32)],
+        interpret=interpret,
+    )(x2, gain.astype(jnp.float32), mean, rstd, dy2)
+    return (dx.reshape(x.shape), dg_part.sum(0).astype(gain.dtype),
+            db_part.sum(0))
+
+
+# -- custom_vjp dispatcher --------------------------------------------------
+
+# Pending on-hardware measurement (the fused_attention _FLASH_MIN_SEQ
+# analog): below this row count XLA's fused chain wins on overhead alone.
+_LN_MIN_ROWS = 1024
+
+
+def _can_tile(x, block_rows: int = 256) -> bool:
+    """Kernel-lowering feasibility (also the interpret-mode gate)."""
+    rows = _rows_of(x)
+    return rows % min(block_rows, rows) == 0 and x.shape[-1] % 128 == 0
+
+
+def _worth_it(x) -> bool:
+    """Dispatch heuristic: big enough to beat XLA's fused chain."""
+    return _rows_of(x) >= _LN_MIN_ROWS
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _fused_ln(x, gain, bias, eps, interpret):
+    y, _, _ = layer_norm_tpu(x, gain, bias, eps, interpret=interpret)
+    return y
+
+
+def _fused_ln_fwd(x, gain, bias, eps, interpret):
+    y, mean, rstd = layer_norm_tpu(x, gain, bias, eps, interpret=interpret)
+    return y, (x, gain, bias, mean, rstd)
+
+
+def _fused_ln_bwd(eps, interpret, res, dy):
+    x, gain, bias, mean, rstd = res
+    dx, dg, db = layer_norm_bwd_tpu(x, gain, mean, rstd, dy,
+                                    interpret=interpret)
+    return dx, dg, db.astype(bias.dtype)
+
+
+_fused_ln.defvjp(_fused_ln_fwd, _fused_ln_bwd)
+
+
+def fused_layer_norm(x, gain, bias=None, eps: float = 1e-5,
+                     interpret: Optional[bool] = None):
+    """Measured-dispatch layer norm (the `fused_attention` pattern): Pallas
+    kernel when on TPU (or interpret=True) and shapes tile; jnp reference
+    otherwise."""
+    if interpret is None:
+        on_tpu = jax.default_backend() == "tpu"
+        if not on_tpu or not _can_tile(x) or not _worth_it(x):
+            return layer_norm_reference(x, gain, bias, eps)
+        interpret = False
+    elif not _can_tile(x):        # interpret mode: correctness gate only
+        return layer_norm_reference(x, gain, bias, eps)
+    bias_arg = jnp.zeros((x.shape[-1],), jnp.float32) if bias is None \
+        else bias
+    return _fused_ln(x, gain, bias_arg, eps, interpret)

@@ -13,3 +13,4 @@ from deeplearning4j_tpu.zoo.graphs import (  # noqa: F401
 from deeplearning4j_tpu.zoo.bert import BertConfig, BertModel  # noqa: F401
 from deeplearning4j_tpu.zoo.vision import (  # noqa: F401
     InceptionResNetV1, TinyYOLO, Xception, YOLO2)
+from deeplearning4j_tpu.zoo.nasnet import NASNet  # noqa: F401

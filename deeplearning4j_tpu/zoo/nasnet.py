@@ -1,0 +1,166 @@
+"""NASNet-A (reference `deeplearning4j-zoo/.../zoo/model/NASNet.java`;
+Zoph et al. 2018 "Learning Transferable Architectures").
+
+Cell wiring follows the NASNet-A search result: five add-blocks per cell
+over the two incoming hidden states (h = previous cell, hp = cell before
+that), separable convs + 3x3 pools, all block outputs concatenated.
+Reduction cells run their first ops at stride 2 and double the filter
+count.  Incoming states pass through 1x1 conv+BN "adjusters" (strided
+when the spatial shapes differ — the factorized-reduction role).
+
+Depthwise-separable convs dominate the FLOPs and lower to grouped+1x1
+convs on the MXU, as in Xception."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+from deeplearning4j_tpu.nn import (
+    ActivationLayer, BatchNormalizationLayer, ComputationGraph,
+    ComputationGraphConfiguration, ConvolutionLayer, DropoutLayer,
+    ElementWiseVertex, GlobalPoolingLayer, GraphBuilder, InputType,
+    MergeVertex, OutputLayer, SeparableConvolution2DLayer,
+    SubsamplingLayer)
+from deeplearning4j_tpu.zoo.base import ZooModel, zoo_model
+
+
+@zoo_model
+@dataclasses.dataclass
+class NASNet(ZooModel):
+    """NASNet-A.  `cells_per_stack` (the paper's N) and `filters` scale the
+    model: mobile is N=4/filters=44, large is N=6/filters=168; tests use
+    smaller settings (architecture is size-agnostic)."""
+
+    input_shape: Tuple[int, ...] = (224, 224, 3)
+    cells_per_stack: int = 4
+    filters: int = 44
+    stem_filters: int = 32
+
+    # -- primitive ops ------------------------------------------------------
+    def _sep(self, b, name, inp, n, k, s=1) -> str:
+        """relu -> sepconv(k) -> BN, twice (the paper's sep-conv block);
+        the second conv keeps stride 1."""
+        x = inp
+        for i, stride in enumerate((s, 1)):
+            b.add_layer(f"{name}_relu{i}",
+                        ActivationLayer(activation="relu"), x)
+            b.add_layer(f"{name}_sc{i}",
+                        SeparableConvolution2DLayer(
+                            n_out=n, kernel_size=k, stride=stride,
+                            convolution_mode="Same",
+                            activation="identity", has_bias=False),
+                        f"{name}_relu{i}")
+            b.add_layer(f"{name}_bn{i}", BatchNormalizationLayer(
+                activation="identity"), f"{name}_sc{i}")
+            x = f"{name}_bn{i}"
+        return x
+
+    def _pool(self, b, name, inp, kind, s=1) -> str:
+        b.add_layer(name, SubsamplingLayer(
+            pooling_type=kind, kernel_size=3, stride=s,
+            convolution_mode="Same"), inp)
+        return name
+
+    def _adjust(self, b, name, inp, n, s=1) -> str:
+        """1x1 conv+BN input adjuster (strided = factorized reduction)."""
+        b.add_layer(f"{name}_relu", ActivationLayer(activation="relu"), inp)
+        b.add_layer(f"{name}_c", ConvolutionLayer(
+            n_out=n, kernel_size=1, stride=s, convolution_mode="Same",
+            activation="identity", has_bias=False), f"{name}_relu")
+        b.add_layer(f"{name}_bn", BatchNormalizationLayer(
+            activation="identity"), f"{name}_c")
+        return f"{name}_bn"
+
+    def _add(self, b, name, a_, b_) -> str:
+        b.add_vertex(name, ElementWiseVertex(op="Add"), a_, b_)
+        return name
+
+    # -- cells --------------------------------------------------------------
+    def _normal_cell(self, b, name, h, hp, n, hp_stride=1) -> str:
+        h = self._adjust(b, f"{name}_ah", h, n)
+        hp = self._adjust(b, f"{name}_ahp", hp, n, s=hp_stride)
+        y1 = self._add(b, f"{name}_y1",
+                       self._sep(b, f"{name}_s3h", h, n, 3), h)
+        y2 = self._add(b, f"{name}_y2",
+                       self._sep(b, f"{name}_s3hp", hp, n, 3),
+                       self._sep(b, f"{name}_s5h", h, n, 5))
+        y3 = self._add(b, f"{name}_y3",
+                       self._pool(b, f"{name}_avh", h, "AVG"), hp)
+        y4 = self._add(b, f"{name}_y4",
+                       self._pool(b, f"{name}_av1", hp, "AVG"),
+                       self._pool(b, f"{name}_av2", hp, "AVG"))
+        y5 = self._add(b, f"{name}_y5",
+                       self._sep(b, f"{name}_s5hp", hp, n, 5),
+                       self._sep(b, f"{name}_s3hp2", hp, n, 3))
+        # reference normal cell concatenates the adjusted previous state
+        # too -> 6n output channels
+        b.add_vertex(f"{name}_out", MergeVertex(), hp, y1, y2, y3, y4, y5)
+        return f"{name}_out"
+
+    def _reduction_cell(self, b, name, h, hp, n, hp_stride=1) -> str:
+        h = self._adjust(b, f"{name}_ah", h, n)
+        hp = self._adjust(b, f"{name}_ahp", hp, n, s=hp_stride)
+        y1 = self._add(b, f"{name}_y1",
+                       self._sep(b, f"{name}_s7hp", hp, n, 7, s=2),
+                       self._sep(b, f"{name}_s5h", h, n, 5, s=2))
+        y2 = self._add(b, f"{name}_y2",
+                       self._pool(b, f"{name}_mxh", h, "MAX", s=2),
+                       self._sep(b, f"{name}_s7hp2", hp, n, 7, s=2))
+        y3 = self._add(b, f"{name}_y3",
+                       self._pool(b, f"{name}_avh", h, "AVG", s=2),
+                       self._sep(b, f"{name}_s5hp", hp, n, 5, s=2))
+        y4 = self._add(b, f"{name}_y4",
+                       self._pool(b, f"{name}_mxh2", h, "MAX", s=2),
+                       self._sep(b, f"{name}_s3y1", y1, n, 3))
+        y5 = self._add(b, f"{name}_y5",
+                       self._pool(b, f"{name}_avy1", y1, "AVG"), y2)
+        b.add_vertex(f"{name}_out", MergeVertex(), y2, y3, y4, y5)
+        return f"{name}_out"
+
+    # -- network ------------------------------------------------------------
+    def conf(self) -> ComputationGraphConfiguration:
+        h_img, w_img, c = self.input_shape
+        N, F = self.cells_per_stack, self.filters
+        b = (GraphBuilder().seed(self.seed).updater(self._updater())
+             .weight_init("RELU").add_inputs("input")
+             .set_input_types(InputType.convolutional(h_img, w_img, c)))
+        b.add_layer("stem_conv", ConvolutionLayer(
+            n_out=self.stem_filters, kernel_size=3, stride=2,
+            convolution_mode="Same", activation="identity",
+            has_bias=False), "input")
+        b.add_layer("stem_bn", BatchNormalizationLayer(
+            activation="identity"), "stem_conv")
+        hp, h = "stem_bn", "stem_bn"
+        f = F
+        cell = 0
+        for stack in range(3):
+            if stack > 0:
+                f *= 2
+                out = self._reduction_cell(b, f"red{stack}", h, hp, f,
+                                           hp_stride=self._hp_stride(hp, h))
+                hp, h = h, out
+            for i in range(N):
+                out = self._normal_cell(b, f"c{cell}", h, hp, f,
+                                        hp_stride=self._hp_stride(hp, h))
+                hp, h = h, out
+                cell += 1
+        b.add_layer("final_relu", ActivationLayer(activation="relu"), h)
+        b.add_layer("gap", GlobalPoolingLayer(pooling_type="AVG"),
+                    "final_relu")
+        b.add_layer("drop", DropoutLayer(dropout=0.5), "gap")
+        b.add_layer("output", OutputLayer(n_out=self.n_classes,
+                                          loss="mcxent",
+                                          activation="softmax"), "drop")
+        b.set_outputs("output")
+        return b.build()
+
+    def _hp_stride(self, hp_name: str, h_name: str) -> int:
+        """hp needs a strided adjuster exactly when it predates the last
+        reduction (tracked by name bookkeeping in conf())."""
+        # the previous-previous state lags one reduction right after a
+        # reduction cell: detect via the naming convention
+        return 2 if (h_name.startswith("red") and
+                     not hp_name.startswith("red")) else 1
+
+    def init_model(self) -> ComputationGraph:
+        return self._net(ComputationGraph, self.conf())

@@ -177,3 +177,56 @@ def test_flash_lse_matches_reference():
     ref_lse = jax.scipy.special.logsumexp(scores, axis=-1).reshape(1, 256)
     np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
                                rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Pallas fused LayerNorm (ops/norm_kernels.py) — interpret-mode correctness
+# vs the jnp reference, values and gradients
+# ---------------------------------------------------------------------------
+
+def test_pallas_layer_norm_matches_reference():
+    from deeplearning4j_tpu.ops.norm_kernels import (fused_layer_norm,
+                                                     layer_norm_reference)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((32, 256)).astype(np.float32))
+    g = jnp.asarray(rng.standard_normal(256).astype(np.float32) * 0.5 + 1)
+    b = jnp.asarray(rng.standard_normal(256).astype(np.float32) * 0.1)
+    want = layer_norm_reference(x, g, b)
+    got = fused_layer_norm(x, g, b, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_layer_norm_gradients_match():
+    from deeplearning4j_tpu.ops.norm_kernels import (fused_layer_norm,
+                                                     layer_norm_reference)
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((16, 128)).astype(np.float32))
+    g = jnp.asarray(rng.standard_normal(128).astype(np.float32) + 1.0)
+    b = jnp.asarray(rng.standard_normal(128).astype(np.float32) * 0.2)
+    t = jnp.asarray(rng.standard_normal((16, 128)).astype(np.float32))
+
+    def loss_k(x_, g_, b_):
+        return jnp.mean((fused_layer_norm(x_, g_, b_, interpret=True) - t)
+                        ** 2)
+
+    def loss_r(x_, g_, b_):
+        return jnp.mean((layer_norm_reference(x_, g_, b_) - t) ** 2)
+
+    gk = jax.grad(loss_k, argnums=(0, 1, 2))(x, g, b)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(x, g, b)
+    for a, bb in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_fused_layer_norm_dispatch_fallback():
+    """Ragged shapes must fall back to the jnp reference silently."""
+    from deeplearning4j_tpu.ops.norm_kernels import (fused_layer_norm,
+                                                     layer_norm_reference)
+    x = jnp.asarray(np.random.default_rng(2)
+                    .standard_normal((5, 37)).astype(np.float32))
+    g = jnp.ones(37, jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(fused_layer_norm(x, g)),
+        np.asarray(layer_norm_reference(x, g)), rtol=1e-6)

@@ -185,3 +185,21 @@ def test_space_to_depth_layer():
     # first output pixel packs the 2x2 spatial block of channel-major cells
     np.testing.assert_array_equal(np.asarray(out)[0, 0, 0, :3], x[0, 0, 0])
     np.testing.assert_array_equal(np.asarray(out)[0, 0, 0, 3:6], x[0, 0, 1])
+
+
+def test_nasnet_forward_and_structure():
+    from deeplearning4j_tpu.zoo import NASNet
+    m = NASNet(n_classes=5, input_shape=(32, 32, 3), cells_per_stack=1,
+               filters=12, stem_filters=8)
+    conf = m.conf()
+    # 3 normal cells + 2 reduction cells
+    assert "c0_out" in conf.vertices and "c2_out" in conf.vertices
+    assert "red1_out" in conf.vertices and "red2_out" in conf.vertices
+    net = m.init_model()
+    x = np.random.RandomState(0).rand(2, 32, 32, 3).astype(np.float32)
+    (out,) = net.output(x)
+    assert out.shape == (2, 5)
+    assert np.allclose(np.asarray(out).sum(1), 1.0, atol=1e-4)
+    y = np.eye(5, dtype=np.float32)[[0, 3]]
+    net.fit([x], [y])
+    assert np.isfinite(net.score())
