@@ -103,3 +103,81 @@ def test_loss_gradients(loss, act):
     ], InputType.feed_forward(3))
     assert check_gradients(score_fn_for(net, x, y), net.params_,
                            max_params_per_leaf=None, verbose=True)
+
+
+# ---------------------------------------------------------------------------
+# Extended-layer gradient checks (conv3d, locally-connected, PReLU, center
+# loss, separable conv) — the GradientCheckTests family widened
+# ---------------------------------------------------------------------------
+
+def test_conv3d_gradients():
+    from deeplearning4j_tpu.nn import Convolution3DLayer, Subsampling3DLayer
+    rng = np.random.default_rng(10)
+    x = rng.normal(size=(2, 4, 4, 4, 2))
+    y = np.eye(2)[rng.integers(0, 2, 2)]
+    net = build_net([
+        Convolution3DLayer(n_out=3, kernel_size=2, convolution_mode="Same",
+                           activation="tanh"),
+        Subsampling3DLayer(pooling_type="AVG", kernel_size=2, stride=2),
+        OutputLayer(n_out=2, loss="mcxent", activation="softmax"),
+    ], InputType.convolutional3d(4, 4, 4, 2))
+    assert check_gradients(score_fn_for(net, x, y), net.params_,
+                           max_params_per_leaf=20)
+
+
+def test_locally_connected_gradients():
+    from deeplearning4j_tpu.nn import LocallyConnected2DLayer
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(3, 5, 5, 2))
+    y = np.eye(2)[rng.integers(0, 2, 3)]
+    net = build_net([
+        LocallyConnected2DLayer(n_out=3, kernel_size=2, activation="tanh"),
+        OutputLayer(n_out=2, loss="mcxent", activation="softmax"),
+    ], InputType.convolutional(5, 5, 2))
+    assert check_gradients(score_fn_for(net, x, y), net.params_,
+                           max_params_per_leaf=20)
+
+
+def test_prelu_gradients():
+    from deeplearning4j_tpu.nn import PReLULayer
+    rng = np.random.default_rng(12)
+    x = rng.normal(size=(6, 4))
+    y = np.eye(2)[rng.integers(0, 2, 6)]
+    net = build_net([
+        DenseLayer(n_out=5, activation="identity"),
+        PReLULayer(alpha_init=0.3),
+        OutputLayer(n_out=2, loss="mcxent", activation="softmax"),
+    ], InputType.feed_forward(4))
+    assert check_gradients(score_fn_for(net, x, y), net.params_,
+                           max_params_per_leaf=None)
+
+
+def test_center_loss_gradients():
+    from deeplearning4j_tpu.nn import CenterLossOutputLayer
+    rng = np.random.default_rng(13)
+    x = rng.normal(size=(6, 4))
+    y = np.eye(3)[rng.integers(0, 3, 6)]
+    net = build_net([
+        DenseLayer(n_out=5, activation="tanh"),
+        CenterLossOutputLayer(n_out=3, lambda_=0.3),
+    ], InputType.feed_forward(4))
+    # seed centers off zero so their gradient is informative
+    net.params_["layer_1"]["centers"] = jnp.asarray(
+        rng.normal(size=(3, 5)) * 0.1)
+    assert check_gradients(score_fn_for(net, x, y), net.params_,
+                           max_params_per_leaf=None)
+
+
+def test_separable_conv_gradients():
+    from deeplearning4j_tpu.nn import SeparableConvolution2DLayer
+    rng = np.random.default_rng(14)
+    x = rng.normal(size=(2, 5, 5, 2))
+    y = np.eye(2)[rng.integers(0, 2, 2)]
+    net = build_net([
+        SeparableConvolution2DLayer(n_out=3, kernel_size=3,
+                                    convolution_mode="Same",
+                                    activation="tanh"),
+        OutputLayer(n_out=2, loss="mcxent", activation="softmax"),
+    ], InputType.convolutional(5, 5, 2))
+    assert check_gradients(score_fn_for(net, x, y), net.params_,
+                           max_params_per_leaf=20)
