@@ -1,6 +1,6 @@
 from deeplearning4j_tpu.train.evaluation import (  # noqa: F401
-    Evaluation, EvaluationCalibration, RegressionEvaluation, ROC,
-    ROCBinary, ROCMultiClass)
+    Evaluation, EvaluationBinary, EvaluationCalibration,
+    RegressionEvaluation, ROC, ROCBinary, ROCMultiClass)
 from deeplearning4j_tpu.train.schedules import (  # noqa: F401
     CycleSchedule, ExponentialSchedule, FixedSchedule, InverseSchedule,
     ISchedule, MapSchedule, PolySchedule, RampSchedule, SigmoidSchedule,

@@ -347,3 +347,75 @@ class EvaluationCalibration:
             lines.append(
                 f"  class {k}: ECE={self.expected_calibration_error(k):.4f}")
         return "\n".join(lines)
+
+
+class EvaluationBinary:
+    """Per-output binary classification metrics at a fixed threshold
+    (reference `org.nd4j.evaluation.classification.EvaluationBinary`):
+    independent sigmoid outputs, tp/fp/tn/fn accumulated per column."""
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+        self._tp: Optional[np.ndarray] = None
+        self._fp: Optional[np.ndarray] = None
+        self._tn: Optional[np.ndarray] = None
+        self._fn: Optional[np.ndarray] = None
+
+    def eval(self, labels: np.ndarray, predictions: np.ndarray):
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 1:
+            labels = labels[:, None]
+            predictions = predictions[:, None]
+        pred = predictions >= self.threshold
+        lab = labels >= 0.5
+        if self._tp is None:
+            k = labels.shape[1]
+            self._tp = np.zeros(k)
+            self._fp = np.zeros(k)
+            self._tn = np.zeros(k)
+            self._fn = np.zeros(k)
+        self._tp += np.sum(pred & lab, axis=0)
+        self._fp += np.sum(pred & ~lab, axis=0)
+        self._tn += np.sum(~pred & ~lab, axis=0)
+        self._fn += np.sum(~pred & lab, axis=0)
+
+    def num_labels(self) -> int:
+        return 0 if self._tp is None else len(self._tp)
+
+    def _counts(self, i):
+        if self._tp is None:
+            return 0.0, 0.0, 0.0, 0.0    # no data -> metrics return NaN
+        return self._tp[i], self._fp[i], self._tn[i], self._fn[i]
+
+    def accuracy(self, output: int) -> float:
+        tp, fp, tn, fn = self._counts(output)
+        total = tp + fp + tn + fn
+        return float((tp + tn) / total) if total else float("nan")
+
+    def precision(self, output: int) -> float:
+        tp, fp, _, _ = self._counts(output)
+        return float(tp / (tp + fp)) if tp + fp else float("nan")
+
+    def recall(self, output: int) -> float:
+        tp, _, _, fn = self._counts(output)
+        return float(tp / (tp + fn)) if tp + fn else float("nan")
+
+    def f1(self, output: int) -> float:
+        p, r = self.precision(output), self.recall(output)
+        return 2 * p * r / (p + r) if p + r else float("nan")
+
+    def true_positives(self, output: int) -> int:
+        return 0 if self._tp is None else int(self._tp[output])
+
+    def false_positives(self, output: int) -> int:
+        return 0 if self._fp is None else int(self._fp[output])
+
+    def stats(self) -> str:
+        lines = [f"EvaluationBinary (threshold={self.threshold}):"]
+        for k in range(self.num_labels()):
+            lines.append(
+                f"  output {k}: acc={self.accuracy(k):.4f} "
+                f"prec={self.precision(k):.4f} rec={self.recall(k):.4f} "
+                f"f1={self.f1(k):.4f}")
+        return "\n".join(lines)

@@ -15,7 +15,9 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
-from deeplearning4j_tpu.ui.stats import InMemoryStatsStorage, render_html
+from deeplearning4j_tpu.ui.stats import (FileStatsStorage,
+                                          InMemoryStatsStorage,
+                                          render_html)
 
 
 class UIServer:
@@ -26,6 +28,7 @@ class UIServer:
 
     def __init__(self):
         self._storages: List[InMemoryStatsStorage] = []
+        self._paths: List[str] = []
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.refresh_seconds = 5
@@ -40,15 +43,28 @@ class UIServer:
         self._storages.append(storage)
         return self
 
+    def attach_file(self, path: str) -> "UIServer":
+        """Monitor a FileStatsStorage written by ANOTHER process (the
+        training job); the file is re-read on every render, so the page
+        follows the live run."""
+        self._paths.append(path)
+        return self
+
     def detach(self, storage: InMemoryStatsStorage) -> "UIServer":
         self._storages = [s for s in self._storages if s is not storage]
         return self
 
     def _render(self) -> str:
-        if not self._storages:
+        storages = list(self._storages)
+        for p in self._paths:
+            try:
+                storages.append(FileStatsStorage.load(p))
+            except (FileNotFoundError, OSError):
+                pass                     # run not started yet
+        if not storages:
             return ("<html><body><h1>deeplearning4j_tpu UI</h1>"
                     "<p>No StatsStorage attached.</p></body></html>")
-        html = "\n<hr/>\n".join(render_html(s) for s in self._storages)
+        html = "\n<hr/>\n".join(render_html(s) for s in storages)
         tag = (f'<meta http-equiv="refresh" '
                f'content="{self.refresh_seconds}">')
         return html.replace("<head>", "<head>" + tag, 1)

@@ -77,7 +77,10 @@ class FileStatsStorage(InMemoryStatsStorage):
         st = InMemoryStatsStorage()
         with open(path) as f:
             for line in f:
-                d = json.loads(line)
+                try:
+                    d = json.loads(line)
+                except json.JSONDecodeError:
+                    continue     # torn tail from a concurrent writer
                 if d["t"] == "score":
                     st.put_score(d["i"], d["v"])
                 else:

@@ -1,0 +1,74 @@
+"""Evaluation-suite tests (reference `org.nd4j.evaluation` test family):
+ROCBinary, EvaluationCalibration, EvaluationBinary.  Core Evaluation /
+RegressionEvaluation / ROC coverage lives with the training-loop tests."""
+import numpy as np
+
+
+
+# ---------------------------------------------------------------------------
+# ROCBinary + EvaluationCalibration (VERDICT §2 evaluation gaps)
+# ---------------------------------------------------------------------------
+
+def test_roc_binary_per_output_auc():
+    from deeplearning4j_tpu.train import ROCBinary
+    rng = np.random.RandomState(0)
+    n = 400
+    labels = rng.randint(0, 2, (n, 3)).astype(np.float32)
+    preds = np.zeros((n, 3), np.float32)
+    preds[:, 0] = np.clip(labels[:, 0] * 0.8 + 0.1
+                          + rng.randn(n) * 0.05, 0, 1)   # strong signal
+    preds[:, 1] = rng.rand(n)                            # random
+    preds[:, 2] = np.clip(1 - labels[:, 2] + rng.randn(n) * 0.1, 0, 1)
+    roc = ROCBinary()
+    roc.eval(labels[:200], preds[:200])
+    roc.eval(labels[200:], preds[200:])                  # accumulates
+    assert roc.num_labels() == 3
+    assert roc.calculate_auc(0) > 0.95
+    assert 0.4 < roc.calculate_auc(1) < 0.6
+    assert roc.calculate_auc(2) < 0.1                    # anti-correlated
+    assert "AUC" in roc.stats()
+
+
+def test_evaluation_calibration_ece_and_histograms():
+    from deeplearning4j_tpu.train import EvaluationCalibration
+    rng = np.random.RandomState(1)
+    n = 5000
+    # perfectly calibrated predictor: P(label=1) == predicted p
+    p = rng.rand(n)
+    labels1 = (rng.rand(n) < p).astype(np.float32)
+    labels = np.stack([1 - labels1, labels1], 1)
+    preds = np.stack([1 - p, p], 1).astype(np.float32)
+    ec = EvaluationCalibration(reliability_bins=10)
+    ec.eval(labels, preds)
+    assert ec.expected_calibration_error(1) < 0.05
+    mean_p, obs = ec.reliability_diagram(1)
+    valid = ~np.isnan(mean_p)
+    np.testing.assert_allclose(mean_p[valid], obs[valid], atol=0.12)
+    # a maximally overconfident predictor has large ECE
+    ec2 = EvaluationCalibration()
+    always1 = np.stack([np.zeros(n), np.ones(n)], 1).astype(np.float32)
+    ec2.eval(labels, always1)
+    assert ec2.expected_calibration_error(1) > 0.4
+    assert ec.get_residual_plot_all_classes().sum() == 2 * n
+    assert ec.get_probability_histogram(1).sum() == n
+    assert "ECE" in ec.stats()
+
+
+def test_evaluation_binary_per_output_metrics():
+    from deeplearning4j_tpu.train import EvaluationBinary
+    ev = EvaluationBinary()
+    labels = np.array([[1, 0], [1, 1], [0, 0], [0, 1]], np.float32)
+    preds = np.array([[0.9, 0.2], [0.8, 0.4], [0.3, 0.1], [0.6, 0.7]],
+                     np.float32)
+    ev.eval(labels[:2], preds[:2])
+    ev.eval(labels[2:], preds[2:])     # accumulates
+    assert ev.num_labels() == 2
+    # output 0: tp=2 fp=1 tn=1 fn=0
+    assert ev.true_positives(0) == 2 and ev.false_positives(0) == 1
+    assert abs(ev.accuracy(0) - 0.75) < 1e-9
+    assert abs(ev.precision(0) - 2 / 3) < 1e-9
+    assert abs(ev.recall(0) - 1.0) < 1e-9
+    # output 1: tp=1 fp=0 tn=2 fn=1
+    assert abs(ev.recall(1) - 0.5) < 1e-9
+    assert "f1=" in ev.stats()
+

@@ -119,3 +119,26 @@ def test_ui_server_serves_live_stats():
     finally:
         server.stop()
     assert UIServer.get_instance() is UIServer.get_instance()
+
+
+def test_ui_server_attach_file_follows_other_process(tmp_path):
+    """Cross-process monitoring: the server re-reads a FileStatsStorage
+    written elsewhere on every request."""
+    import urllib.request
+    from deeplearning4j_tpu.ui import FileStatsStorage, UIServer
+    path = str(tmp_path / "stats.jsonl")
+    server = UIServer().attach_file(path)
+    port = server.start(port=0)
+    try:
+        html = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=10).read().decode()
+        assert "No StatsStorage attached" in html      # file absent yet
+        st = FileStatsStorage(path)                    # "the training job"
+        st.put_score(0, 2.0)
+        st.put_score(1, 1.0)
+        st.close()
+        html2 = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=10).read().decode()
+        assert "Score vs iteration" in html2
+    finally:
+        server.stop()
