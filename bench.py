@@ -110,6 +110,98 @@ def bench_bert_base(batch=64, steps=10, t=128, compute_dtype="bfloat16"):
     return batch * t * steps / dt
 
 
+def bench_bert_tf_import(batch=32, steps=5, t=128, layers=12,
+                         hidden=768, heads=12, vocab=30522):
+    """BASELINE config 3 AS WRITTEN: BERT-base fine-tune via SameDiff TF
+    import — build the frozen GraphDef in TF, import through
+    modelimport.tf_import, attach a trainable head, measure the jitted
+    SameDiff fine-tune step."""
+    import tensorflow as tf
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+
+    from deeplearning4j_tpu.autodiff import TrainingConfig
+    from deeplearning4j_tpu.modelimport import import_graph_def
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    rs = np.random.RandomState(0)
+    H, NH, L, T, B = hidden, heads, layers, t, batch
+    p = {"tok_emb": tf.constant(rs.randn(vocab, H).astype(np.float32)
+                                * 0.02),
+         "pos_emb": tf.constant(rs.randn(T, H).astype(np.float32) * 0.02)}
+    for l in range(L):
+        for w in ["wq", "wk", "wv", "wo"]:
+            p[f"{l}.{w}"] = tf.constant(
+                rs.randn(H, H).astype(np.float32) * 0.02)
+        p[f"{l}.w1"] = tf.constant(rs.randn(H, 4 * H).astype(np.float32)
+                                   * 0.02)
+        p[f"{l}.w2"] = tf.constant(rs.randn(4 * H, H).astype(np.float32)
+                                   * 0.02)
+        p[f"{l}.g1"] = tf.constant(np.ones(H, np.float32))
+        p[f"{l}.b1"] = tf.constant(np.zeros(H, np.float32))
+        p[f"{l}.g2"] = tf.constant(np.ones(H, np.float32))
+        p[f"{l}.b2"] = tf.constant(np.zeros(H, np.float32))
+
+    def ln(x, g, b):
+        mean = tf.reduce_mean(x, axis=-1, keepdims=True)
+        var = tf.reduce_mean(tf.math.squared_difference(x, mean), axis=-1,
+                             keepdims=True)
+        return (x - mean) * tf.math.rsqrt(var + 1e-6) * g + b
+
+    def gelu(x):
+        return 0.5 * x * (1.0 + tf.math.erf(
+            x / np.sqrt(2.0).astype(np.float32)))
+
+    def f(ids):
+        x = tf.gather(p["tok_emb"], ids, axis=0) + p["pos_emb"]
+        for l in range(L):
+            def heads_of(w):
+                y = tf.matmul(tf.reshape(x, [B * T, H]), w)
+                return tf.transpose(tf.reshape(y, [B, T, NH, H // NH]),
+                                    [0, 2, 1, 3])
+            q, k, v = (heads_of(p[f"{l}.wq"]), heads_of(p[f"{l}.wk"]),
+                       heads_of(p[f"{l}.wv"]))
+            s = tf.matmul(q, k, adjoint_b=True) / np.float32(
+                np.sqrt(H // NH))
+            ctx = tf.matmul(tf.nn.softmax(s, axis=-1), v)
+            ctx = tf.reshape(tf.transpose(ctx, [0, 2, 1, 3]), [B, T, H])
+            a = tf.matmul(tf.reshape(ctx, [B * T, H]), p[f"{l}.wo"])
+            x = ln(x + tf.reshape(a, [B, T, H]), p[f"{l}.g1"],
+                   p[f"{l}.b1"])
+            h = gelu(tf.matmul(tf.reshape(x, [B * T, H]), p[f"{l}.w1"]))
+            h = tf.matmul(h, p[f"{l}.w2"])
+            x = ln(x + tf.reshape(h, [B, T, H]), p[f"{l}.g2"],
+                   p[f"{l}.b2"])
+        return x
+
+    cf = tf.function(f).get_concrete_function(
+        tf.TensorSpec((B, T), tf.int32))
+    gd = convert_variables_to_constants_v2(cf).graph.as_graph_def()
+    sd = import_graph_def(gd)
+    enc = gd.node[-1].name
+
+    # trainable MLM head over the imported (constant) encoder
+    import jax
+    import jax.numpy as jnp
+    w_head = sd.var("head_w", "XAVIER", H, vocab)
+    logits = sd.op("matmul", sd.get_variable(enc), w_head, name="logits")
+    lab = sd.placeholder("lab", (B, T))
+    sd.loss.sparse_softmax_cross_entropy(lab, logits, name="loss")
+    sd.set_loss_variables("loss")
+    sd.set_training_config(TrainingConfig(
+        updater=Adam(1e-4), data_set_feature_mapping=["ids"],
+        data_set_label_mapping=["lab"]))
+    ids = jnp.asarray(rs.randint(0, vocab, (B, T)).astype(np.int32))
+    lab_v = jnp.asarray(rs.randint(0, vocab, (B, T)).astype(np.int32))
+
+    def step():
+        sd.fit(ids, lab_v)
+
+    dt = _time_steps(step, n_warmup=2, n_steps=steps,
+                     sync_fn=lambda: sd.score())
+    return B * T * steps / dt
+
+
 def bench_lstm_charlm(batch=64, steps=10, t=64, vocab=77):
     import jax
     from deeplearning4j_tpu.zoo import TextGenLSTM
@@ -149,6 +241,9 @@ def main():
             bench_lstm_charlm(steps=3 if quick else 10), 1)
         extras["bert_base_mlm_tokens_sec"] = round(
             bench_bert_base(steps=3 if quick else 10), 1)
+        if not quick:
+            extras["bert_tf_import_finetune_tokens_sec"] = round(
+                bench_bert_tf_import(), 1)
     except Exception as e:  # extras must never break the headline line
         print(f"extra benches failed: {e}", file=sys.stderr)
     if extras:

@@ -375,6 +375,11 @@ class EvaluationBinary:
             self._fp = np.zeros(k)
             self._tn = np.zeros(k)
             self._fn = np.zeros(k)
+        elif labels.shape[1] != len(self._tp):
+            raise ValueError(
+                f"EvaluationBinary: batch has {labels.shape[1]} outputs, "
+                f"accumulator has {len(self._tp)} (reference throws the "
+                "same)")
         self._tp += np.sum(pred & lab, axis=0)
         self._fp += np.sum(pred & ~lab, axis=0)
         self._tn += np.sum(~pred & ~lab, axis=0)
@@ -402,8 +407,9 @@ class EvaluationBinary:
         return float(tp / (tp + fn)) if tp + fn else float("nan")
 
     def f1(self, output: int) -> float:
-        p, r = self.precision(output), self.recall(output)
-        return 2 * p * r / (p + r) if p + r else float("nan")
+        tp, fp, _, fn = self._counts(output)
+        denom = 2 * tp + fp + fn
+        return float(2 * tp / denom) if denom else float("nan")
 
     def true_positives(self, output: int) -> int:
         return 0 if self._tp is None else int(self._tp[output])

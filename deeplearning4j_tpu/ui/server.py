@@ -54,6 +54,10 @@ class UIServer:
         self._storages = [s for s in self._storages if s is not storage]
         return self
 
+    def detach_file(self, path: str) -> "UIServer":
+        self._paths = [p for p in self._paths if p != path]
+        return self
+
     def _render(self) -> str:
         storages = list(self._storages)
         for p in self._paths:

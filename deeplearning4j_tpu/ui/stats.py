@@ -68,6 +68,7 @@ class FileStatsStorage(InMemoryStatsStorage):
         super().put_layer(iteration, layer, p_norm, u_norm)
         self._f.write(json.dumps({"t": "layer", "i": iteration, "l": layer,
                                   "p": p_norm, "u": u_norm}) + "\n")
+        self._f.flush()
 
     def close(self):
         self._f.close()
