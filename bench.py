@@ -174,11 +174,13 @@ def bench_bert_tf_import(batch=32, steps=5, t=128, layers=12,
                    p[f"{l}.b2"])
         return x
 
-    cf = tf.function(f).get_concrete_function(
-        tf.TensorSpec((B, T), tf.int32))
-    gd = convert_variables_to_constants_v2(cf).graph.as_graph_def()
+    frozen = convert_variables_to_constants_v2(
+        tf.function(f).get_concrete_function(
+            tf.TensorSpec((B, T), tf.int32)))
+    gd = frozen.graph.as_graph_def()
     sd = import_graph_def(gd)
-    enc = gd.node[-1].name
+    # the frozen fn's structured output tensor names the true graph output
+    enc = frozen.outputs[0].name.split(":")[0]
 
     # trainable MLM head over the imported (constant) encoder
     import jax

@@ -764,6 +764,40 @@ class SameDiff:
         s = getattr(self, "_score", None)
         return float(s) if s is not None else float("nan")
 
+    def evaluate(self, iterator, output_name, evaluation=None,
+                 label_index: int = 0):
+        """Classification eval over a DataSetIterator (reference
+        `sd.evaluate(iterator, outputVariable, new Evaluation())`): feeds
+        come from the TrainingConfig mappings, predictions from the named
+        output."""
+        from deeplearning4j_tpu.train.evaluation import Evaluation
+        if self.training_config is None:
+            raise ValueError("set_training_config(...) first — evaluate "
+                             "uses its feature/label mappings")
+        output_name = output_name.name if isinstance(output_name,
+                                                     SDVariable) \
+            else output_name
+        ev = evaluation or Evaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            feeds = self._map_dataset(ds)
+            labels = ds.labels[label_index] \
+                if isinstance(ds.labels, (list, tuple)) else ds.labels
+            # drop label placeholders the forward pass doesn't need
+            preds = self.output(
+                {k: v for k, v in feeds.items()
+                 if k not in self.training_config.data_set_label_mapping},
+                output_name)[output_name]
+            lmask = getattr(ds, "labels_mask", None)
+            if lmask is None:
+                lmasks = getattr(ds, "labels_masks", None)
+                if lmasks is not None:
+                    lmask = lmasks[label_index]
+            ev.eval(np.asarray(labels), np.asarray(preds),
+                    mask=None if lmask is None else np.asarray(lmask))
+        return ev
+
     def calculate_gradients(self, feeds: Dict[str, Any],
                             *wrt) -> Dict[str, np.ndarray]:
         """Analytic gradients of the summed loss wrt named variables

@@ -93,6 +93,7 @@ class _Step:
     name: str
     schema_fn: Callable[[Schema], Schema]
     record_fn: Callable[[Schema, Record], Optional[Record]]
+    spec: Optional[dict] = None        # declarative form for JSON serde
 
 
 class TransformProcess:
@@ -104,6 +105,45 @@ class TransformProcess:
     def __init__(self, initial_schema: Schema, steps: List[_Step]):
         self.initial_schema = initial_schema
         self.steps = steps
+
+    def to_json(self) -> str:
+        """Serialize (reference `TransformProcess.toJson`).  Steps built
+        from arbitrary Python callables (filter_by_condition,
+        transform_column) have no declarative form and refuse to
+        serialize — same constraint the reference has for non-registered
+        custom transforms."""
+        specs = []
+        for st in self.steps:
+            if st.spec is None:
+                raise ValueError(
+                    f"step '{st.name}' wraps a Python callable and cannot "
+                    "be serialized; rebuild it from declarative builder "
+                    "ops or reattach it after from_json")
+            specs.append(st.spec)
+        return json.dumps({
+            "format": "deeplearning4j_tpu.TransformProcess.v1",
+            "schema": json.loads(self.initial_schema.to_json()),
+            "steps": specs}, indent=2)
+
+    SERIALIZABLE_OPS = frozenset({
+        "remove_columns", "keep_columns", "rename_column",
+        "categorical_to_integer", "categorical_to_one_hot",
+        "string_to_double", "math_op_double"})
+
+    @staticmethod
+    def from_json(s: str) -> "TransformProcess":
+        d = json.loads(s)
+        schema = Schema.from_json(json.dumps(d["schema"]))
+        b = TransformProcess.Builder(schema)
+        for spec in d["steps"]:
+            op = spec.get("op")
+            if op not in TransformProcess.SERIALIZABLE_OPS:
+                raise ValueError(
+                    f"Unknown transform op '{op}' in serialized "
+                    f"TransformProcess (known: "
+                    f"{sorted(TransformProcess.SERIALIZABLE_OPS)})")
+            getattr(b, op)(*spec.get("args", []))
+        return b.build()
 
     def final_schema(self) -> Schema:
         s = self.initial_schema
@@ -134,8 +174,8 @@ class TransformProcess:
             self._schema = schema
             self._steps: List[_Step] = []
 
-        def _add(self, name, schema_fn, record_fn):
-            self._steps.append(_Step(name, schema_fn, record_fn))
+        def _add(self, name, schema_fn, record_fn, spec=None):
+            self._steps.append(_Step(name, schema_fn, record_fn, spec))
             return self
 
         def remove_columns(self, *names):
@@ -147,7 +187,9 @@ class TransformProcess:
             def rfn(s: Schema, r: Record):
                 return [v for c, v in zip(s.columns, r)
                         if c.name not in names]
-            return self._add(f"remove{sorted(names)}", sfn, rfn)
+            return self._add(f"remove{sorted(names)}", sfn, rfn,
+                             {"op": "remove_columns",
+                              "args": sorted(names)})
 
         def keep_columns(self, *names):
             keep = list(names)
@@ -157,7 +199,8 @@ class TransformProcess:
 
             def rfn(s: Schema, r: Record):
                 return [r[s.index_of(n)] for n in keep]
-            return self._add(f"keep{keep}", sfn, rfn)
+            return self._add(f"keep{keep}", sfn, rfn,
+                             {"op": "keep_columns", "args": keep})
 
         def rename_column(self, old: str, new: str):
             def sfn(s: Schema):
@@ -166,7 +209,9 @@ class TransformProcess:
 
             def rfn(s, r):
                 return r
-            return self._add(f"rename {old}->{new}", sfn, rfn)
+            return self._add(f"rename {old}->{new}", sfn, rfn,
+                             {"op": "rename_column",
+                              "args": [old, new]})
 
         def categorical_to_integer(self, *names):
             """Category string -> index (reference
@@ -186,7 +231,9 @@ class TransformProcess:
                             raise ValueError(f"{c.name} is not categorical")
                         out[i] = c.categories.index(str(r[i]))
                 return out
-            return self._add(f"cat2int{sorted(names_set)}", sfn, rfn)
+            return self._add(f"cat2int{sorted(names_set)}", sfn, rfn,
+                             {"op": "categorical_to_integer",
+                              "args": sorted(names_set)})
 
         def categorical_to_one_hot(self, name: str):
             def sfn(s: Schema):
@@ -202,7 +249,9 @@ class TransformProcess:
                 cats = s.columns[i].categories
                 onehot = [1.0 if str(r[i]) == cat else 0.0 for cat in cats]
                 return list(r[:i]) + onehot + list(r[i + 1:])
-            return self._add(f"onehot {name}", sfn, rfn)
+            return self._add(f"onehot {name}", sfn, rfn,
+                             {"op": "categorical_to_one_hot",
+                              "args": [name]})
 
         def string_to_double(self, *names):
             names_set = set(names)
@@ -215,7 +264,9 @@ class TransformProcess:
             def rfn(s: Schema, r: Record):
                 return [float(v) if c.name in names_set else v
                         for c, v in zip(s.columns, r)]
-            return self._add(f"str2double{sorted(names_set)}", sfn, rfn)
+            return self._add(f"str2double{sorted(names_set)}", sfn, rfn,
+                             {"op": "string_to_double",
+                              "args": sorted(names_set)})
 
         def math_op_double(self, name: str, op: str, scalar: float):
             """Reference `DoubleMathOpTransform`: Add|Subtract|Multiply|
@@ -234,7 +285,9 @@ class TransformProcess:
                 out = list(r)
                 out[i] = f(float(r[i]))
                 return out
-            return self._add(f"{op}({name},{scalar})", lambda s: s, rfn)
+            return self._add(f"{op}({name},{scalar})", lambda s: s, rfn,
+                             {"op": "math_op_double",
+                              "args": [name, op, scalar]})
 
         def filter_by_condition(self, pred: Callable[[Schema, Record], bool],
                                 name: str = "filter"):

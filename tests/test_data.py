@@ -405,3 +405,35 @@ def test_arrow_roundtrip_feather_and_parquet(tmp_path):
         back = list(rr)
         assert back[0] == [1, 0.5, "a", "u"]
         assert back[2][1] is None and back[2][2] is None
+
+
+def test_transform_process_json_roundtrip():
+    """Reference TransformProcess.toJson/fromJson contract."""
+    from deeplearning4j_tpu.data.transform import Schema, TransformProcess
+    schema = (Schema.builder().add_column_string("height")
+              .add_column_categorical("color", ["red", "blue"])
+              .add_column_double("score").add_column_string("junk").build())
+    tp = (TransformProcess.builder(schema)
+          .remove_columns("junk")
+          .string_to_double("height")
+          .math_op_double("score", "Multiply", 2.0)
+          .categorical_to_one_hot("color")
+          .build())
+    js = tp.to_json()
+    tp2 = TransformProcess.from_json(js)
+    records = [["1.8", "red", 3.0, "x"], ["1.6", "blue", 1.0, "y"]]
+    out1 = tp.execute(records)
+    out2 = tp2.execute(records)
+    assert out1 == out2
+    assert out1[0] == [1.8, 1.0, 0.0, 6.0]
+    assert tp2.final_schema().names() == tp.final_schema().names()
+
+
+def test_transform_process_custom_step_refuses_serialization():
+    from deeplearning4j_tpu.data.transform import Schema, TransformProcess
+    import pytest as _pytest
+    schema = Schema.builder().add_column_double("x").build()
+    tp = (TransformProcess.builder(schema)
+          .filter_by_condition(lambda s, r: r[0] > 0).build())
+    with _pytest.raises(ValueError, match="cannot be serialized"):
+        tp.to_json()

@@ -391,3 +391,32 @@ def test_scatter_and_gather_nd():
     out = sd.op("scatter_add", base, idx, upd)
     r = np.asarray(out.eval({}))
     assert r[1].sum() == 2 and r[3].sum() == 2 and r[0].sum() == 0
+
+
+def test_samediff_evaluate_iterator():
+    """Reference `sd.evaluate(DataSetIterator, output, Evaluation)`."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+
+    rng = np.random.default_rng(4)
+    x_all = rng.standard_normal((60, 4)).astype(np.float32)
+    labels = ((x_all[:, 0] > 0).astype(int)
+              + (x_all[:, 1] > 0).astype(int))
+    y_all = np.eye(3, dtype=np.float32)[labels]
+
+    class It:
+        def reset(self):
+            pass
+
+        def __iter__(self):
+            for i in range(0, 60, 20):
+                yield DataSet(x_all[i:i + 20], y_all[i:i + 20])
+
+    sd = _mlp_sd()
+    sd.set_training_config(TrainingConfig(
+        updater=Adam(0.05), data_set_feature_mapping=["input"],
+        data_set_label_mapping=["label"]))
+    for _ in range(60):
+        sd.fit(x_all, y_all)
+    ev = sd.evaluate(It(), "out")
+    assert ev.accuracy() > 0.85
+    assert ev.confusion.sum() == 60
