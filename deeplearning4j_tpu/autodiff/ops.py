@@ -655,3 +655,636 @@ def _depthwise_conv2d(x, w, stride=(1, 1), padding="SAME",
         rhs_dilation=tuple(dilation),
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         feature_group_count=x.shape[-1])
+
+
+# ---------------------------------------------------------------------------
+# Wider declarable-op inventory, round 2 (reference: `libnd4j/include/ops/
+# declarable/generic/{random,bitwise,broadcastable,images,transforms,
+# compat,nn}/**` + `headers/*.h`).  Grouped as upstream groups them.
+# ---------------------------------------------------------------------------
+
+# ---- random (reference generic/random/**; rng is an explicit jax PRNG key,
+# the functional replacement for libnd4j's RandomGenerator state) ----
+register_op("random_uniform", lambda rng, shape, minval=0.0, maxval=1.0,
+            dtype="float32": jax.random.uniform(
+                rng, tuple(shape), jnp.dtype(dtype), minval, maxval))
+register_op("random_normal", lambda rng, shape, mean=0.0, stddev=1.0,
+            dtype="float32": mean + stddev * jax.random.normal(
+                rng, tuple(shape), jnp.dtype(dtype)))
+register_op("random_bernoulli", lambda rng, shape, p=0.5:
+            jax.random.bernoulli(rng, p, tuple(shape)))
+register_op("random_exponential", lambda rng, shape, lam=1.0,
+            dtype="float32": jax.random.exponential(
+                rng, tuple(shape), jnp.dtype(dtype)) / lam)
+register_op("random_gamma", lambda rng, shape, alpha=1.0, beta=1.0,
+            dtype="float32": jax.random.gamma(
+                rng, alpha, tuple(shape), jnp.dtype(dtype)) / beta)
+register_op("random_poisson", lambda rng, shape, lam=1.0:
+            jax.random.poisson(rng, lam, tuple(shape)))
+register_op("random_shuffle", lambda rng, a, axis=0:
+            jax.random.permutation(rng, a, axis=axis))
+register_op("multinomial", lambda rng, logits, num_samples:
+            jnp.swapaxes(jax.random.categorical(
+                rng, logits, axis=-1,
+                shape=(num_samples,) + logits.shape[:-1]), 0, -1))
+register_op("dropout_inverted", lambda x, rng, p=0.5:
+            jnp.where(jax.random.bernoulli(rng, 1.0 - p, x.shape),
+                      x / (1.0 - p), 0.0))
+
+# ---- bitwise (reference generic/bitwise/**) ----
+register_op("bitwise_and", jnp.bitwise_and)
+register_op("bitwise_or", jnp.bitwise_or)
+register_op("bitwise_xor", jnp.bitwise_xor)
+register_op("bitwise_not", jnp.bitwise_not)
+register_op("shift_left", jnp.left_shift)
+register_op("shift_right", jnp.right_shift)
+@register_op("cyclic_shift_left")
+def _cyclic_shift_left(a, n):
+    """Rotate bits left by a static int `n` (a full-width logical shift is
+    undefined in HLO, so n ≡ 0 (mod width) short-circuits)."""
+    bits = a.dtype.itemsize * 8
+    n = int(n) % bits
+    if n == 0:
+        return a
+    return (a << n) | lax.shift_right_logical(
+        a, jnp.asarray(bits - n, a.dtype))
+register_op("bits_hamming_distance", lambda a, b: jnp.sum(
+    jax.lax.population_count(jnp.bitwise_xor(a, b))))
+register_op("toggle_bits", jnp.bitwise_not)
+
+# ---- unsorted segment reductions (reference generic/transforms/
+# unsorted_segment_*.cpp) ----
+register_op("unsorted_segment_sum", lambda data, ids, num_segments:
+            jax.ops.segment_sum(data, ids, num_segments,
+                                indices_are_sorted=False))
+register_op("unsorted_segment_max", lambda data, ids, num_segments:
+            jax.ops.segment_max(data, ids, num_segments,
+                                indices_are_sorted=False))
+register_op("unsorted_segment_min", lambda data, ids, num_segments:
+            jax.ops.segment_min(data, ids, num_segments,
+                                indices_are_sorted=False))
+register_op("unsorted_segment_prod", lambda data, ids, num_segments:
+            jax.ops.segment_prod(data, ids, num_segments,
+                                 indices_are_sorted=False))
+
+
+@register_op("unsorted_segment_mean")
+def _unsorted_segment_mean(data, ids, num_segments):
+    s = jax.ops.segment_sum(data, ids, num_segments)
+    n = jax.ops.segment_sum(jnp.ones(data.shape[0], data.dtype), ids,
+                            num_segments)
+    return s / jnp.maximum(n, 1.0).reshape((-1,) + (1,) * (data.ndim - 1))
+
+
+@register_op("unsorted_segment_sqrt_n")
+def _unsorted_segment_sqrt_n(data, ids, num_segments):
+    s = jax.ops.segment_sum(data, ids, num_segments)
+    n = jax.ops.segment_sum(jnp.ones(data.shape[0], data.dtype), ids,
+                            num_segments)
+    return s / jnp.sqrt(jnp.maximum(n, 1.0)).reshape(
+        (-1,) + (1,) * (data.ndim - 1))
+
+
+# ---- scatter breadth (reference generic/transforms/scatter_*.cpp) ----
+register_op("scatter_sub", lambda a, idx, updates: a.at[idx].add(-updates))
+register_op("scatter_mul", lambda a, idx, updates:
+            a.at[idx].multiply(updates))
+register_op("scatter_div", lambda a, idx, updates:
+            a.at[idx].divide(updates))
+register_op("scatter_nd", lambda idx, updates, shape:
+            jnp.zeros(tuple(shape), updates.dtype)
+            .at[tuple(jnp.moveaxis(idx, -1, 0))].add(updates))
+register_op("scatter_nd_add", lambda a, idx, updates:
+            a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(updates))
+register_op("scatter_nd_sub", lambda a, idx, updates:
+            a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(-updates))
+register_op("scatter_nd_update", lambda a, idx, updates:
+            a.at[tuple(jnp.moveaxis(idx, -1, 0))].set(updates))
+
+
+@register_op("dynamic_stitch")
+def _dynamic_stitch(indices, data):
+    """TF DynamicStitch: merge `data[i]` rows at positions `indices[i]`
+    (lists of equal length).  Output length is max(index)+1 when the
+    indices are graph-time constants (the TF norm); under a jit trace the
+    data-dependent size is unknowable, so it falls back to the total index
+    count (correct whenever indices form a permutation)."""
+    try:
+        n = max(int(jnp.max(i)) for i in indices) + 1
+    except jax.errors.ConcretizationTypeError:
+        n = sum(int(i.size) for i in indices)
+    first = data[0]
+    out = jnp.zeros((n,) + first.shape[1:], first.dtype)
+    for idx, d in zip(indices, data):
+        out = out.at[idx.reshape(-1)].set(
+            d.reshape((-1,) + first.shape[1:]))
+    return out
+
+
+# ---- reduce3 / distance ops (reference `libnd4j/include/loops/reduce3.h`:
+# the pairwise-reduction family) ----
+register_op("euclidean_distance", lambda a, b, axis=None:
+            jnp.sqrt(jnp.sum((a - b) ** 2, axis=_axis_tuple(axis))))
+register_op("manhattan_distance", lambda a, b, axis=None:
+            jnp.sum(jnp.abs(a - b), axis=_axis_tuple(axis)))
+register_op("cosine_similarity", lambda a, b, axis=-1, eps=1e-12:
+            jnp.sum(a * b, axis=axis)
+            / jnp.maximum(jnp.linalg.norm(a, axis=axis)
+                          * jnp.linalg.norm(b, axis=axis), eps))
+register_op("jaccard_distance", lambda a, b, axis=None:
+            1.0 - jnp.sum(jnp.minimum(a, b), axis=_axis_tuple(axis))
+            / jnp.maximum(jnp.sum(jnp.maximum(a, b),
+                                  axis=_axis_tuple(axis)), 1e-12))
+register_op("hamming_distance", lambda a, b, axis=None:
+            jnp.sum((a != b).astype(jnp.float32), axis=_axis_tuple(axis)))
+
+# ---- reduction breadth (reference loops/reduce_*.h + generic/reduce/**) ----
+register_op("amax", lambda a, axis=None, keepdims=False:
+            jnp.max(jnp.abs(a), axis=_axis_tuple(axis), keepdims=keepdims))
+register_op("amin", lambda a, axis=None, keepdims=False:
+            jnp.min(jnp.abs(a), axis=_axis_tuple(axis), keepdims=keepdims))
+register_op("asum", lambda a, axis=None, keepdims=False:
+            jnp.sum(jnp.abs(a), axis=_axis_tuple(axis), keepdims=keepdims))
+register_op("amean", lambda a, axis=None, keepdims=False:
+            jnp.mean(jnp.abs(a), axis=_axis_tuple(axis), keepdims=keepdims))
+register_op("norm1", lambda a, axis=None, keepdims=False:
+            jnp.sum(jnp.abs(a), axis=_axis_tuple(axis), keepdims=keepdims))
+register_op("norm_max", lambda a, axis=None, keepdims=False:
+            jnp.max(jnp.abs(a), axis=_axis_tuple(axis), keepdims=keepdims))
+register_op("reduce_any", lambda a, axis=None, keepdims=False:
+            jnp.any(a, axis=_axis_tuple(axis), keepdims=keepdims))
+register_op("reduce_all", lambda a, axis=None, keepdims=False:
+            jnp.all(a, axis=_axis_tuple(axis), keepdims=keepdims))
+register_op("entropy", lambda a, axis=None:
+            -jnp.sum(a * jnp.log(jnp.maximum(a, 1e-12)),
+                     axis=_axis_tuple(axis)))
+register_op("log_entropy", lambda a, axis=None:
+            jnp.log(-jnp.sum(a * jnp.log(jnp.maximum(a, 1e-12)),
+                             axis=_axis_tuple(axis))))
+register_op("shannon_entropy", lambda a, axis=None:
+            -jnp.sum(a * jnp.log2(jnp.maximum(a, 1e-12)),
+                     axis=_axis_tuple(axis)))
+register_op("zero_fraction", lambda a:
+            jnp.mean((a == 0).astype(jnp.float32)))
+register_op("square_sum", lambda a, axis=None, keepdims=False:
+            jnp.sum(a * a, axis=_axis_tuple(axis), keepdims=keepdims))
+
+
+@register_op("percentile")
+def _percentile(a, q, axis=None, interpolation="linear"):
+    return jnp.percentile(a, q, axis=_axis_tuple(axis),
+                          method=interpolation)
+
+
+register_op("median", lambda a, axis=None:
+            jnp.median(a, axis=_axis_tuple(axis)))
+
+
+@register_op("nth_element")
+def _nth_element(a, n, reverse=False):
+    s = jnp.sort(a, axis=-1)
+    if reverse:
+        s = jnp.flip(s, axis=-1)
+    return lax.index_in_dim(s, n, axis=-1, keepdims=False)
+
+
+# ---- image ops (reference generic/images/**: colorspace conversions,
+# crop_and_resize, extract_image_patches, non_max_suppression) ----
+@register_op("rgb_to_grs")
+def _rgb_to_grs(x):
+    w = jnp.asarray([0.2989, 0.5870, 0.1140], x.dtype)
+    return jnp.sum(x * w, axis=-1, keepdims=True)
+
+
+@register_op("rgb_to_hsv")
+def _rgb_to_hsv(x):
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    mx = jnp.max(x, axis=-1)
+    mn = jnp.min(x, axis=-1)
+    d = mx - mn
+    safe = jnp.where(d == 0, 1.0, d)
+    h = jnp.where(
+        mx == r, (g - b) / safe % 6.0,
+        jnp.where(mx == g, (b - r) / safe + 2.0, (r - g) / safe + 4.0))
+    h = jnp.where(d == 0, 0.0, h) / 6.0
+    s = jnp.where(mx == 0, 0.0, d / jnp.where(mx == 0, 1.0, mx))
+    return jnp.stack([h, s, mx], axis=-1)
+
+
+@register_op("hsv_to_rgb")
+def _hsv_to_rgb(x):
+    h, s, v = x[..., 0] * 6.0, x[..., 1], x[..., 2]
+    i = jnp.floor(h)
+    f = h - i
+    p, q, t = v * (1 - s), v * (1 - s * f), v * (1 - s * (1 - f))
+    i = i.astype(jnp.int32) % 6
+    r = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [v, q, p, p, t, v])
+    g = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [t, v, v, q, p, p])
+    b = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [p, p, t, v, v, q])
+    return jnp.stack([r, g, b], axis=-1)
+
+
+_YIQ = jnp.asarray([[0.299, 0.587, 0.114],
+                    [0.5959, -0.2746, -0.3213],
+                    [0.2115, -0.5227, 0.3112]], jnp.float32)
+register_op("rgb_to_yiq", lambda x: x @ _YIQ.T.astype(x.dtype))
+register_op("yiq_to_rgb", lambda x:
+            x @ jnp.linalg.inv(_YIQ).T.astype(x.dtype))
+_YUV = jnp.asarray([[0.299, 0.587, 0.114],
+                    [-0.14714119, -0.28886916, 0.43601035],
+                    [0.61497538, -0.51496512, -0.10001026]], jnp.float32)
+register_op("rgb_to_yuv", lambda x: x @ _YUV.T.astype(x.dtype))
+register_op("yuv_to_rgb", lambda x:
+            x @ jnp.linalg.inv(_YUV).T.astype(x.dtype))
+
+
+@register_op("adjust_hue")
+def _adjust_hue(x, delta):
+    hsv = _rgb_to_hsv(x)
+    h = (hsv[..., 0] + delta) % 1.0
+    return _hsv_to_rgb(jnp.stack([h, hsv[..., 1], hsv[..., 2]], axis=-1))
+
+
+@register_op("adjust_saturation")
+def _adjust_saturation(x, factor):
+    hsv = _rgb_to_hsv(x)
+    s = jnp.clip(hsv[..., 1] * factor, 0.0, 1.0)
+    return _hsv_to_rgb(jnp.stack([hsv[..., 0], s, hsv[..., 2]], axis=-1))
+
+
+@register_op("adjust_contrast")
+def _adjust_contrast(x, factor):
+    mean = jnp.mean(x, axis=(-3, -2), keepdims=True)
+    return (x - mean) * factor + mean
+
+
+@register_op("crop_and_resize")
+def _crop_and_resize(image, boxes, box_indices, crop_size,
+                     method="bilinear"):
+    """[B,H,W,C] image + normalized [N,4] (y1,x1,y2,x2) boxes (TF/reference
+    CropAndResize semantics)."""
+    ch, cw = crop_size
+
+    def one(box, bi):
+        y1, x1, y2, x2 = box[0], box[1], box[2], box[3]
+        img = image[bi]
+        h, w = image.shape[1], image.shape[2]
+        ys = y1 * (h - 1) + jnp.arange(ch) / max(ch - 1, 1) \
+            * (y2 - y1) * (h - 1)
+        xs = x1 * (w - 1) + jnp.arange(cw) / max(cw - 1, 1) \
+            * (x2 - x1) * (w - 1)
+        if method == "nearest":
+            yi = jnp.clip(jnp.round(ys).astype(jnp.int32), 0, h - 1)
+            xi = jnp.clip(jnp.round(xs).astype(jnp.int32), 0, w - 1)
+            return img[yi][:, xi]
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wy = (ys - y0)[:, None, None]
+        wx = (xs - x0)[None, :, None]
+        return ((1 - wy) * (1 - wx) * img[y0][:, x0]
+                + (1 - wy) * wx * img[y0][:, x1i]
+                + wy * (1 - wx) * img[y1i][:, x0]
+                + wy * wx * img[y1i][:, x1i])
+
+    return jax.vmap(one)(boxes, box_indices.astype(jnp.int32))
+
+
+@register_op("extract_image_patches")
+def _extract_image_patches(x, ksizes, strides=(1, 1), rates=(1, 1),
+                           padding="VALID"):
+    """NHWC → [B, OH, OW, kh*kw*C] (TF ExtractImagePatches / the im2col
+    declarable op's public face)."""
+    kh, kw = ksizes
+    c = x.shape[-1]
+    ident = jnp.eye(kh * kw * c, dtype=x.dtype).reshape(
+        kh, kw, c, kh * kw * c)
+    return lax.conv_general_dilated(
+        x, ident, window_strides=tuple(strides), padding=padding,
+        rhs_dilation=tuple(rates),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+@register_op("non_max_suppression")
+def _non_max_suppression(boxes, scores, max_output_size,
+                         iou_threshold=0.5, score_threshold=-jnp.inf):
+    """Greedy NMS over [N,4] (y1,x1,y2,x2) boxes; returns fixed-size index
+    array padded with -1 (static shapes for jit)."""
+    n = boxes.shape[0]
+    y1, x1, y2, x2 = (boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3])
+    area = jnp.maximum(y2 - y1, 0) * jnp.maximum(x2 - x1, 0)
+
+    def iou(i, j):
+        yy1 = jnp.maximum(y1[i], y1[j])
+        xx1 = jnp.maximum(x1[i], x1[j])
+        yy2 = jnp.minimum(y2[i], y2[j])
+        xx2 = jnp.minimum(x2[i], x2[j])
+        inter = jnp.maximum(yy2 - yy1, 0) * jnp.maximum(xx2 - xx1, 0)
+        return inter / jnp.maximum(area[i] + area[j] - inter, 1e-12)
+
+    live = scores > score_threshold
+
+    def body(state, _):
+        live, sel_scores = state
+        best = jnp.argmax(jnp.where(live, sel_scores, -jnp.inf))
+        ok = live[best]
+        ious = jax.vmap(lambda j: iou(best, j))(jnp.arange(n))
+        live = live & (ious <= iou_threshold)
+        live = live.at[best].set(False)
+        return (live, sel_scores), jnp.where(ok, best, -1)
+
+    (_, _), picked = lax.scan(body, (live, scores), None,
+                              length=max_output_size)
+    return picked
+
+
+# ---- spatial / shape breadth ----
+register_op("broadcast_to", lambda a, shape:
+            jnp.broadcast_to(a, tuple(shape)))
+register_op("repeat", lambda a, repeats, axis=None:
+            jnp.repeat(a, repeats, axis=axis))
+register_op("mirror_pad", lambda a, paddings, mode="REFLECT":
+            jnp.pad(a, tuple(tuple(p) for p in paddings),
+                    mode="reflect" if mode.upper() == "REFLECT"
+                    else "symmetric"))
+
+
+@register_op("sequence_mask")
+def _sequence_mask(lengths, maxlen, dtype="float32"):
+    return (jnp.arange(maxlen)[None, :]
+            < lengths.reshape(-1, 1)).astype(jnp.dtype(dtype))
+
+
+@register_op("space_to_batch")
+def _space_to_batch(x, block=2, paddings=((0, 0), (0, 0))):
+    B, H, W, C = x.shape
+    x = jnp.pad(x, ((0, 0), tuple(paddings[0]), tuple(paddings[1]),
+                    (0, 0)))
+    H2, W2 = x.shape[1], x.shape[2]
+    x = x.reshape(B, H2 // block, block, W2 // block, block, C)
+    return x.transpose(2, 4, 0, 1, 3, 5).reshape(
+        block * block * B, H2 // block, W2 // block, C)
+
+
+@register_op("batch_to_space")
+def _batch_to_space(x, block=2, crops=((0, 0), (0, 0))):
+    NB, H, W, C = x.shape
+    B = NB // (block * block)
+    x = x.reshape(block, block, B, H, W, C)
+    x = x.transpose(2, 3, 0, 4, 1, 5).reshape(B, H * block, W * block, C)
+    (ct, cb), (cl, cr) = crops
+    return x[:, ct:x.shape[1] - cb or None, cl:x.shape[2] - cr or None]
+
+
+@register_op("upsampling2d")
+def _upsampling2d(x, scale=2):
+    return jnp.repeat(jnp.repeat(x, scale, axis=1), scale, axis=2)
+
+
+@register_op("im2col")
+def _im2col(x, kh, kw, sh=1, sw=1, ph=0, pw=0, dh=1, dw=1):
+    """NHWC → [B, OH, OW, kh, kw, C] (reference generic/nn/im2col)."""
+    pads = "VALID" if (ph, pw) == (0, 0) else [(ph, ph), (pw, pw)]
+    patches = _extract_image_patches(
+        x, (kh, kw), (sh, sw), (dh, dw),
+        pads if isinstance(pads, str) else pads)
+    b, oh, ow, _ = patches.shape
+    return patches.reshape(b, oh, ow, kh, kw, x.shape[-1])
+
+
+# ---- nn breadth (conv3d/pool3d/deconv/lrn/prelu/gru) ----
+@register_op("conv3d")
+def _conv3d(x, w, b=None, stride=(1, 1, 1), padding="SAME",
+            dilation=(1, 1, 1)):
+    """NDHWC x, DHWIO w (reference generic/nn/convo/conv3d.cpp)."""
+    y = lax.conv_general_dilated(
+        x, w, window_strides=tuple(stride), padding=padding,
+        rhs_dilation=tuple(dilation),
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    return y if b is None else y + b
+
+
+@register_op("deconv2d")
+def _deconv2d(x, w, b=None, stride=(2, 2), padding="SAME"):
+    y = lax.conv_transpose(x, w, strides=tuple(stride), padding=padding,
+                           dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return y if b is None else y + b
+
+
+@register_op("max_pooling3d")
+def _max_pool3d(x, kernel=(2, 2, 2), stride=(2, 2, 2), padding="VALID"):
+    return lax.reduce_window(x, -jnp.inf, lax.max,
+                             (1,) + tuple(kernel) + (1,),
+                             (1,) + tuple(stride) + (1,), padding)
+
+
+@register_op("avg_pooling3d")
+def _avg_pool3d(x, kernel=(2, 2, 2), stride=(2, 2, 2), padding="VALID"):
+    dims = (1,) + tuple(kernel) + (1,)
+    strides = (1,) + tuple(stride) + (1,)
+    s = lax.reduce_window(x, 0.0, lax.add, dims, strides, padding)
+    c = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, dims, strides,
+                          padding)
+    return s / c
+
+
+@register_op("lrn")
+def _lrn(x, k=2.0, n=5, alpha=1e-4, beta=0.75):
+    half = n // 2
+    sq = x * x
+    padded = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
+    win = sum(padded[..., i:i + x.shape[-1]] for i in range(n))
+    return x / (k + alpha * win) ** beta
+
+
+register_op("prelu", lambda x, alpha:
+            jnp.where(x >= 0, x, alpha * x))
+register_op("log_sigmoid", jax.nn.log_sigmoid)
+register_op("hard_swish", jax.nn.hard_swish)
+register_op("celu", lambda a, alpha=1.0: jax.nn.celu(a, alpha))
+register_op("glu", lambda a, axis=-1: jax.nn.glu(a, axis))
+
+
+@register_op("gru_cell")
+def _gru_cell(x, h, w_ih, w_hh, b_ih=None, b_hh=None):
+    """Single GRU step, gate order [reset, update, new] (reference
+    generic/nn/recurrent/gruCell.cpp)."""
+    gi = x @ w_ih + (0 if b_ih is None else b_ih)
+    gh = h @ w_hh + (0 if b_hh is None else b_hh)
+    H = h.shape[-1]
+    r = jax.nn.sigmoid(gi[..., :H] + gh[..., :H])
+    z = jax.nn.sigmoid(gi[..., H:2 * H] + gh[..., H:2 * H])
+    n = jnp.tanh(gi[..., 2 * H:] + r * gh[..., 2 * H:])
+    return (1 - z) * n + z * h
+
+
+@register_op("lstm_cell")
+def _lstm_cell(x, h, c, w_ih, w_hh, b=None):
+    """Single LSTM step, IFCO gate order (reference lstmCell; the layer-level
+    scan lives in `nn/recurrent.py`)."""
+    g = x @ w_ih + h @ w_hh + (0 if b is None else b)
+    H = h.shape[-1]
+    i = jax.nn.sigmoid(g[..., :H])
+    f = jax.nn.sigmoid(g[..., H:2 * H])
+    cc = jnp.tanh(g[..., 2 * H:3 * H])
+    o = jax.nn.sigmoid(g[..., 3 * H:])
+    c_new = f * c + i * cc
+    return o * jnp.tanh(c_new), c_new
+
+
+# ---- special functions (reference generic/parity_ops + transforms) ----
+register_op("betainc", jax.scipy.special.betainc)
+register_op("polygamma", lambda n, x: jax.scipy.special.polygamma(n, x))
+register_op("zeta", lambda x, q: jax.scipy.special.zeta(x, q))
+register_op("igamma", jax.scipy.special.gammainc)
+register_op("igammac", jax.scipy.special.gammaincc)
+
+
+# ---- matrix breadth ----
+@register_op("matrix_diag")
+def _matrix_diag(d):
+    return d[..., :, None] * jnp.eye(d.shape[-1], dtype=d.dtype)
+
+
+register_op("matrix_diag_part", lambda a:
+            jnp.diagonal(a, axis1=-2, axis2=-1))
+
+
+@register_op("matrix_set_diag")
+def _matrix_set_diag(a, d):
+    m, n = a.shape[-2], a.shape[-1]
+    k = min(m, n)
+    dk = d[..., :k]
+    if n > k:
+        dk = jnp.concatenate(
+            [dk, jnp.zeros(dk.shape[:-1] + (n - k,), dk.dtype)], axis=-1)
+    # at (i, j) with i == j the broadcast row picks dk[..., j] == d[..., i]
+    return jnp.where(jnp.eye(m, n, dtype=bool), dk[..., None, :], a)
+
+
+register_op("lu", jax.scipy.linalg.lu)
+register_op("pinv", jnp.linalg.pinv)
+register_op("expm", jax.scipy.linalg.expm)
+register_op("einsum", lambda eq, *xs: jnp.einsum(eq, *xs))
+register_op("norm_fro", lambda a: jnp.linalg.norm(a))
+
+
+# ---- compare / classification helpers (reference compat/** + parity) ----
+@register_op("is_max")
+def _is_max(a, axis=-1):
+    return (a == jnp.max(a, axis=axis, keepdims=True)).astype(a.dtype)
+
+
+@register_op("in_top_k")
+def _in_top_k(predictions, targets, k=1):
+    target_logits = jnp.take_along_axis(
+        predictions, targets[:, None].astype(jnp.int32), axis=-1)
+    return jnp.sum((predictions > target_logits).astype(jnp.int32),
+                   axis=-1) < k
+
+
+@register_op("confusion_matrix")
+def _confusion_matrix(labels, predictions, num_classes, weights=None):
+    idx = labels.astype(jnp.int32) * num_classes \
+        + predictions.astype(jnp.int32)
+    w = jnp.ones_like(idx, jnp.float32) if weights is None else weights
+    flat = jnp.zeros(num_classes * num_classes, w.dtype).at[idx].add(w)
+    return flat.reshape(num_classes, num_classes)
+
+
+register_op("assign", lambda a, b: jnp.broadcast_to(b, a.shape))
+register_op("compare_and_set", lambda a, compare, set_val, eps=1e-7:
+            jnp.where(jnp.abs(a - compare) < eps, set_val, a))
+register_op("clip_by_value", lambda a, lo, hi: jnp.clip(a, lo, hi))
+register_op("clip_by_global_norm", lambda norm_cap, *xs: tuple(
+    x * jnp.minimum(1.0, norm_cap / jnp.maximum(
+        jnp.sqrt(sum(jnp.sum(y * y) for y in xs)), 1e-12)) for x in xs))
+
+
+# ---- loss breadth (reference SDLoss / generic/loss/**) ----
+@register_op("hinge_loss")
+def _hinge_loss(labels, logits):
+    """labels in {0,1} (reference hingeLoss converts to ±1)."""
+    signed = 2.0 * labels - 1.0
+    return jnp.mean(jnp.maximum(0.0, 1.0 - signed * logits))
+
+
+@register_op("weighted_cross_entropy_with_logits")
+def _weighted_xent(labels, logits, pos_weight):
+    log_w = 1.0 + (pos_weight - 1.0) * labels
+    return jnp.mean((1 - labels) * logits + log_w * (
+        jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        + jnp.maximum(-logits, 0.0)))
+
+
+@register_op("poisson_loss")
+def _poisson_loss(labels, preds, log_input=False, eps=1e-8):
+    if log_input:
+        return jnp.mean(jnp.exp(preds) - labels * preds)
+    return jnp.mean(preds - labels * jnp.log(preds + eps))
+
+
+@register_op("kl_divergence")
+def _kl_divergence(labels, preds, eps=1e-12):
+    p = jnp.clip(labels, eps, 1.0)
+    q = jnp.clip(preds, eps, 1.0)
+    return jnp.sum(p * (jnp.log(p) - jnp.log(q)), axis=-1).mean()
+
+
+@register_op("ctc_loss")
+def _ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0):
+    """CTC negative log-likelihood via the standard log-space alpha
+    recursion (reference generic/loss/ctcLoss.cpp).  `log_probs` is
+    [B, T, C] log-softmaxed; `labels` [B, S] int; returns [B] losses."""
+    B, T, C = log_probs.shape
+    S = labels.shape[1]
+    L = 2 * S + 1
+    NEG = jnp.asarray(-1e30, log_probs.dtype)
+    lab = labels.astype(jnp.int32)
+    ext = jnp.full((B, L), blank, jnp.int32).at[:, 1::2].set(lab)
+    # skip-transition allowed where ext[s] != blank and ext[s] != ext[s-2]
+    can_skip = jnp.concatenate(
+        [jnp.zeros((B, 2), bool),
+         (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2])], axis=1)
+
+    def emit(t):
+        return jnp.take_along_axis(log_probs[:, t], ext, axis=-1)
+
+    alpha0 = jnp.full((B, L), NEG)
+    alpha0 = alpha0.at[:, 0].set(emit(0)[:, 0])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(S > 0, emit(0)[:, 1], NEG))
+
+    def lse(*xs):
+        m = xs[0]
+        for x in xs[1:]:
+            m = jnp.maximum(m, x)
+        m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+        s = sum(jnp.exp(x - m_safe) for x in xs)
+        return jnp.where(jnp.isfinite(m), m_safe + jnp.log(s), NEG)
+
+    def step(alpha, t):
+        prev1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]],
+                                axis=1)
+        prev2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]],
+                                axis=1)
+        prev2 = jnp.where(can_skip, prev2, NEG)
+        new = lse(alpha, prev1, prev2) + emit(t)
+        # freeze past each sequence's input length so the final read at
+        # t = input_length - 1 is preserved
+        new = jnp.where((t < input_lengths)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    last = 2 * label_lengths.astype(jnp.int32)
+    final = lse(
+        jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0],
+        jnp.where(label_lengths > 0,
+                  jnp.take_along_axis(
+                      alpha, jnp.maximum(last - 1, 0)[:, None],
+                      axis=1)[:, 0], NEG))
+    return -final

@@ -687,8 +687,15 @@ class SameDiff:
 
     def _build_train_step(self):
         cfg = self.training_config
+        has_rng = RNG_FEED in self._nodes   # static at trace time; the step
+        # cache is invalidated whenever the graph mutates
 
-        def step(variables, opt_state, feeds, iteration, epoch):
+        def step(variables, opt_state, feeds, rng, iteration, epoch):
+            if has_rng:
+                rng, sub = jax.random.split(rng)
+                feeds = dict(feeds)
+                feeds[RNG_FEED] = sub
+
             def loss_fn(vs):
                 return self._total_loss(vs, feeds)
             loss, grads = jax.value_and_grad(loss_fn)(variables)
@@ -696,7 +703,7 @@ class SameDiff:
                                              epoch, params=variables)
             new_vars = jax.tree_util.tree_map(lambda p, u: p - u,
                                               variables, upd)
-            return new_vars, new_opt, loss
+            return new_vars, new_opt, loss, rng, iteration + 1
 
         return jax.jit(step, donate_argnums=(0, 1))
 
@@ -749,16 +756,15 @@ class SameDiff:
         return feeds
 
     def _fit_feeds(self, feeds: Dict[str, Any]):
+        from deeplearning4j_tpu.utils.counters import advance, device_counters
         feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
-        if RNG_FEED in self._nodes:
-            self._key, sub = jax.random.split(self._key)
-            feeds[RNG_FEED] = sub
-        self.variables_, self.opt_state_, loss = self._train_step(
-            self.variables_, self.opt_state_, feeds,
-            jnp.asarray(self.iteration, jnp.int32),
-            jnp.asarray(self.epoch, jnp.int32))
+        it_dev, ep_dev = device_counters(self)
+        (self.variables_, self.opt_state_, loss, self._key,
+         new_it) = self._train_step(
+            self.variables_, self.opt_state_, feeds, self._key,
+            it_dev, ep_dev)
         self._score = loss
-        self.iteration += 1
+        advance(self, new_it)
 
     def score(self) -> float:
         s = getattr(self, "_score", None)

@@ -14,8 +14,9 @@ from deeplearning4j_tpu.data.normalizers import (  # noqa: F401
 from deeplearning4j_tpu.data.rr_iterator import (  # noqa: F401
     RecordReaderDataSetIterator, SequenceRecordReaderDataSetIterator)
 from deeplearning4j_tpu.data.datasets import (  # noqa: F401
-    Cifar10DataSetIterator, EmnistDataSetIterator, IrisDataSetIterator,
-    MnistDataSetIterator, SyntheticCifar10, SyntheticMnist, read_idx)
+    Cifar10DataSetIterator, EmnistDataSetIterator, ImdbReviewIterator,
+    IrisDataSetIterator, MnistDataSetIterator, SyntheticCifar10,
+    SyntheticImdb, SyntheticMnist, read_idx)
 from deeplearning4j_tpu.data.analysis import (  # noqa: F401
     AnalyzeLocal, DataAnalysis, Join)
 from deeplearning4j_tpu.data.audio import (  # noqa: F401

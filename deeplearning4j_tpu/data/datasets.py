@@ -237,3 +237,126 @@ class EmnistDataSetIterator(_ArrayDataSetIterator):
         self.y = np.eye(self.n_classes, dtype=np.float32)[labels]
         self._init_batching(batch_size, shuffle, seed)
 
+
+class ImdbReviewIterator(DataSetIterator):
+    """IMDB sentiment batches over the standard `aclImdb/` directory layout
+    (`{train|test}/{pos|neg}/*.txt`) — the reference's IMDB path is
+    `CnnSentenceDataSetIterator` over the aclImdb corpus
+    (`deeplearning4j-nlp/.../iterator/CnnSentenceDataSetIterator.java` +
+    dataset fetch in dl4j-examples).  Zero egress: reads an already-present
+    tree (IMDB_DIR env or `data_dir`).
+
+    Yields token-id features [B, T] (int32) with a [B, T] features mask and
+    one-hot [B, 2] labels (pos=1).  Builds its vocabulary from the training
+    text on first pass unless `vocab` is given."""
+
+    def __init__(self, batch_size: int, train: bool = True,
+                 data_dir: Optional[str] = None, max_len: int = 256,
+                 vocab: Optional[dict] = None, vocab_size: int = 20000,
+                 seed: int = 0, shuffle: bool = True):
+        root = data_dir or os.environ.get("IMDB_DIR", "")
+        part = os.path.join(root, "train" if train else "test")
+        if not os.path.isdir(part):
+            raise FileNotFoundError(
+                f"IMDB directory '{part}' not found — set IMDB_DIR to an "
+                "aclImdb/ tree (zero-egress environment: no auto-download; "
+                "use SyntheticImdb for tests)")
+        texts, labels = [], []
+        for label, sub in ((1, "pos"), (0, "neg")):
+            d = os.path.join(part, sub)
+            for fn in sorted(os.listdir(d)):
+                if fn.endswith(".txt"):
+                    with open(os.path.join(d, fn), encoding="utf-8",
+                              errors="replace") as f:
+                        texts.append(f.read())
+                    labels.append(label)
+        tokenized = [self._tokenize(t) for t in texts]
+        if vocab is None:
+            # vocabulary always comes from the TRAIN split so train/test
+            # token ids agree (pass the train iterator's .vocab explicitly
+            # to skip the extra pass)
+            if train:
+                source = tokenized
+            else:
+                train_dir = os.path.join(root, "train")
+                if not os.path.isdir(train_dir):
+                    raise FileNotFoundError(
+                        f"building a vocab for the test split needs "
+                        f"'{train_dir}' (or pass vocab=train_iter.vocab)")
+                source = []
+                for sub in ("pos", "neg"):
+                    d = os.path.join(train_dir, sub)
+                    for fn in sorted(os.listdir(d)):
+                        if fn.endswith(".txt"):
+                            with open(os.path.join(d, fn), encoding="utf-8",
+                                      errors="replace") as f:
+                                source.append(self._tokenize(f.read()))
+            from collections import Counter
+            counts = Counter(w for toks in source for w in toks)
+            # 0 = pad, 1 = unk
+            vocab = {w: i + 2 for i, (w, _) in
+                     enumerate(counts.most_common(vocab_size - 2))}
+        self.vocab = vocab
+        self.max_len = max_len
+        n = len(tokenized)
+        self.x = np.zeros((n, max_len), np.int32)
+        self.mask = np.zeros((n, max_len), np.float32)
+        for i, toks in enumerate(tokenized):
+            ids = [vocab.get(w, 1) for w in toks[:max_len]]
+            self.x[i, :len(ids)] = ids
+            self.mask[i, :len(ids)] = 1.0
+        self.y = np.eye(2, dtype=np.float32)[np.asarray(labels)]
+        self._bs = batch_size
+        self._shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+
+    @staticmethod
+    def _tokenize(text: str):
+        import re
+        return re.findall(r"[a-z0-9']+", text.lower())
+
+    def batch_size(self) -> int:
+        return self._bs
+
+    def __iter__(self) -> Iterator[DataSet]:
+        idx = np.arange(len(self.x))
+        if self._shuffle:
+            self._rng.shuffle(idx)
+        for i in range(0, len(idx) - self._bs + 1, self._bs):
+            sl = idx[i:i + self._bs]
+            yield DataSet(self.x[sl], self.y[sl],
+                          features_mask=self.mask[sl])
+
+
+class SyntheticImdb(DataSetIterator):
+    """IMDB-shaped synthetic sentiment data: class-dependent token
+    distributions over a small vocabulary (tests/benchmarks stand-in, same
+    contract as ImdbReviewIterator)."""
+
+    def __init__(self, batch_size: int, n_batches: int = 10,
+                 max_len: int = 64, vocab_size: int = 500, seed: int = 0):
+        self._bs = batch_size
+        self._n = n_batches
+        self._t = max_len
+        self._v = vocab_size
+        self._seed = seed
+
+    def batch_size(self) -> int:
+        return self._bs
+
+    def __iter__(self) -> Iterator[DataSet]:
+        rng = np.random.default_rng(self._seed)
+        half = self._v // 2
+        for _ in range(self._n):
+            y_cls = rng.integers(0, 2, self._bs)
+            lens = rng.integers(self._t // 4, self._t + 1, self._bs)
+            x = np.zeros((self._bs, self._t), np.int32)
+            mask = np.zeros((self._bs, self._t), np.float32)
+            for i in range(self._bs):
+                # positive reviews skew toward the upper half of the vocab
+                lo, hi = (2, half) if y_cls[i] == 0 else (half, self._v)
+                x[i, :lens[i]] = rng.integers(lo, hi, lens[i])
+                mask[i, :lens[i]] = 1.0
+            yield DataSet(x, np.eye(2, dtype=np.float32)[y_cls],
+                          features_mask=mask)
+

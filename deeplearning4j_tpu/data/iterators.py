@@ -24,10 +24,45 @@ from deeplearning4j_tpu.data.dataset import DataSet
 
 class DataSetIterator:
     """Iterator protocol (reference `DataSetIterator`): iterable over
-    DataSet batches, with `reset()`, `batch_size()`, `total_examples()`."""
+    DataSet batches, with `reset()`, `batch_size()`, and
+    `set_pre_processor()` (reference `setPreProcessor(DataSetPreProcessor)`
+    — normalizers/augmenters applied to every batch on the way out)."""
 
     def __iter__(self) -> Iterator[DataSet]:
         raise NotImplementedError
+
+    def __init_subclass__(cls, **kw):
+        # Aspect-wrap each subclass's __iter__ so an attached pre-processor
+        # runs on every yielded batch (the reference applies preProcess in
+        # BaseDatasetIterator.next()); subclasses stay oblivious.
+        super().__init_subclass__(**kw)
+        raw = cls.__dict__.get("__iter__")
+        if raw is None:
+            return
+
+        def wrapped(self):
+            import copy
+            pp = getattr(self, "_pre_processor", None)
+            for ds in raw(self):
+                if pp is not None:
+                    # shallow-copy first: normalizers REBIND ds.features on
+                    # the copy, so iterators that yield cached DataSet
+                    # objects (ListDataSetIterator) don't get re-normalized
+                    # on the next epoch
+                    ds = copy.copy(ds)
+                    out = pp.pre_process(ds) if hasattr(pp, "pre_process") \
+                        else pp.transform(ds)
+                    ds = out if out is not None else ds
+                yield ds
+
+        cls.__iter__ = wrapped
+
+    def set_pre_processor(self, pp) -> "DataSetIterator":
+        self._pre_processor = pp
+        return self
+
+    def pre_processor(self):
+        return getattr(self, "_pre_processor", None)
 
     def reset(self):
         pass

@@ -572,8 +572,12 @@ class ComputationGraph:
 
         def step(params, state, opt_state, inputs, labels, lmasks, rng,
                  iteration, epoch):
+            # split inside the compiled step (see MultiLayerNetwork._fit_batch:
+            # device-resident rng/iteration carries, no per-step H2D)
+            rng, srng = jax.random.split(rng)
+
             def loss_fn(p):
-                return self._loss(p, state, inputs, labels, rng, lmasks)
+                return self._loss(p, state, inputs, labels, srng, lmasks)
 
             (loss, new_state), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
@@ -609,7 +613,7 @@ class ComputationGraph:
                         layer.regularizable_mask(params[name]), lr * wd)
                 new_params[name] = jax.tree_util.tree_map(
                     lambda p_, u_: p_ - u_, params[name], upd)
-            return new_params, new_state, new_opt, loss
+            return new_params, new_state, new_opt, loss, rng, iteration + 1
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
@@ -660,15 +664,16 @@ class ComputationGraph:
 
     def _fit_batch(self, inputs: Dict[str, jnp.ndarray],
                    labels: List[jnp.ndarray], lmasks=None):
+        from deeplearning4j_tpu.utils.counters import advance, device_counters
         step = self._get_train_step()
-        self._rng, rng = jax.random.split(self._rng)
-        self.params_, self.state_, self.opt_state_, loss = step(
+        it_dev, ep_dev = device_counters(self)
+        (self.params_, self.state_, self.opt_state_, loss, self._rng,
+         new_it) = step(
             self.params_, self.state_, self.opt_state_, inputs, labels,
-            lmasks, rng, jnp.asarray(self.iteration, jnp.int32),
-            jnp.asarray(self.epoch, jnp.int32))
+            lmasks, self._rng, it_dev, ep_dev)
         self._score = loss
         self._last_batch_size = int(next(iter(inputs.values())).shape[0])
-        self.iteration += 1
+        advance(self, new_it)
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
 

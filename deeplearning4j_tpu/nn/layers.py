@@ -620,8 +620,20 @@ class BatchNormalizationLayer(Layer):
         # state shapes/dtypes are step-stable
         xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
         if train:
-            mean = jnp.mean(xf, axis=axes)
-            var = jnp.var(xf, axis=axes)
+            # one-pass moments (E[xs], E[xs^2]): both reductions read the
+            # activation once and fuse into a single multi-output kernel —
+            # jnp.var's centered form would re-read x after computing the
+            # mean, doubling BN's HBM traffic (measured ~5ms/step of
+            # reduce_sum on ResNet-50 b64 before this change).  Shifting by
+            # the running mean keeps E[xs]^2 << E[xs^2] so the f32
+            # subtraction doesn't cancel catastrophically on large-mean
+            # activations (shifted-moments trick; the shift is a per-channel
+            # constant that fuses into the same kernel).
+            shift = state["mean"].astype(xf.dtype)
+            xs = xf - shift
+            m1 = jnp.mean(xs, axis=axes)
+            mean = m1 + shift
+            var = jnp.maximum(jnp.mean(xs * xs, axis=axes) - m1 * m1, 0.0)
             new_state = {
                 "mean": (self.decay * state["mean"]
                          + (1 - self.decay) * mean.astype(state["mean"].dtype)),

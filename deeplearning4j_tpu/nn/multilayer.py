@@ -348,8 +348,14 @@ class MultiLayerNetwork:
 
         def step(params, state, opt_state, x, y, fmask, lmask, rng,
                  iteration, epoch):
+            # split inside the compiled step: keeps the per-step host work at
+            # zero device round-trips (the carry key + iteration counter live
+            # on device and flow step→step without fresh H2D transfers)
+            rng, srng = jax.random.split(rng)
+
             def loss_fn(p):
-                loss, new_state = self._loss(p, state, x, y, rng, fmask, lmask)
+                loss, new_state = self._loss(p, state, x, y, srng, fmask,
+                                             lmask)
                 return loss, new_state
 
             (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -388,7 +394,7 @@ class MultiLayerNetwork:
                         layer.regularizable_mask(params[name]), lr * wd)
                 new_params[name] = jax.tree_util.tree_map(
                     lambda p_, u_: p_ - u_, params[name], upd)
-            return new_params, new_state, new_opt, loss
+            return new_params, new_state, new_opt, loss, rng, iteration + 1
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
@@ -422,15 +428,16 @@ class MultiLayerNetwork:
         return self
 
     def _fit_batch(self, x, y, fmask=None, lmask=None):
+        from deeplearning4j_tpu.utils.counters import advance, device_counters
         step = self._get_train_step()
-        self._rng, rng = jax.random.split(self._rng)
-        self.params_, self.state_, self.opt_state_, loss = step(
+        it_dev, ep_dev = device_counters(self)
+        (self.params_, self.state_, self.opt_state_, loss, self._rng,
+         new_it) = step(
             self.params_, self.state_, self.opt_state_, x, y, fmask, lmask,
-            rng, jnp.asarray(self.iteration, jnp.int32),
-            jnp.asarray(self.epoch, jnp.int32))
+            self._rng, it_dev, ep_dev)
         self._score = loss
         self._last_batch_size = int(x.shape[0])
-        self.iteration += 1
+        advance(self, new_it)
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
 

@@ -1,1 +1,3 @@
 from deeplearning4j_tpu.utils.config import Config, get_config, set_config  # noqa: F401
+from deeplearning4j_tpu.utils.sanitize import (  # noqa: F401
+    BufferValidationError, assert_disjoint, assert_live, validate_network)

@@ -1,0 +1,37 @@
+"""Device-resident schedule counters shared by every compiled train step.
+
+The updaters' LR schedules consume `iteration`/`epoch` scalars inside the
+jitted step; transferring fresh host ints every step costs one H2D per
+counter per step through the (slow, remote) dispatch path.  Instead the
+step carries a device int32 forward (`iteration + 1` is a step output) and
+this helper only re-uploads when the host-side counter was changed
+externally (checkpoint restore, manual reset) — detected via a sync
+shadow.  Used by MultiLayerNetwork, ComputationGraph, SameDiff and
+BertModel.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def device_counters(model):
+    """Return (iteration_dev, epoch_dev) int32 scalars for `model`, cached
+    against its host `iteration`/`epoch` attributes.  After the step, the
+    caller assigns the step's returned counter via `advance(model, it)`."""
+    if getattr(model, "_iter_dev", None) is None \
+            or getattr(model, "_iter_sync", None) != model.iteration:
+        model._iter_dev = jnp.asarray(model.iteration, jnp.int32)
+        model._iter_sync = model.iteration
+    if getattr(model, "_epoch_sync", None) != model.epoch:
+        model._epoch_dev = jnp.asarray(model.epoch, jnp.int32)
+        model._epoch_sync = model.epoch
+    return model._iter_dev, model._epoch_dev
+
+
+def advance(model, new_iter_dev) -> None:
+    """Record a completed step: store the device-side `iteration + 1`
+    returned by the compiled step and advance the host shadow in lockstep
+    (no sync forced)."""
+    model._iter_dev = new_iter_dev
+    model.iteration += 1
+    model._iter_sync = model.iteration

@@ -1,0 +1,52 @@
+import time, numpy as np, jax, jax.numpy as jnp
+from deeplearning4j_tpu.train.updaters import Nesterovs
+from deeplearning4j_tpu.zoo import ResNet50
+
+def timeit(f, sync, warm=3, n=10):
+    for _ in range(warm): f()
+    sync()
+    t0=time.perf_counter()
+    for _ in range(n): f()
+    sync()
+    return (time.perf_counter()-t0)/n
+
+def setup(batch, image=224, classes=1000):
+    net = ResNet50(n_classes=classes, input_shape=(image,image,3),
+                   updater=Nesterovs(0.1,0.9), compute_dtype="bfloat16").init_model()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(batch,image,image,3).astype(np.float32))
+    y = jnp.asarray(np.eye(classes,dtype=np.float32)[rng.randint(0,classes,batch)])
+    return net, x, y
+
+# 1) train step b64
+net, x, y = setup(64)
+dt = timeit(lambda: net.fit(x,y), lambda: float(net.score()))
+print(f"train b64: {dt*1e3:.2f} ms/step, {64/dt:.0f} samples/s")
+
+# cost analysis of the compiled train step
+try:
+    step = net._train_step
+    if step is not None:
+        ca = step.lower(net.params_, net.state_, net.opt_state_,
+                        {"input": x}, [y], None, jax.random.PRNGKey(0),
+                        0, 0).compile().cost_analysis() if False else None
+except Exception as e:
+    print("cost_analysis path 1 failed:", e)
+
+# 2) fwd-only b64
+fwd = jax.jit(lambda p,s,xx: net._forward(p,s,{"input":xx},train=False,rng=None)[0]["output"])
+o = fwd(net.params_, net.state_, x); jax.block_until_ready(o)
+dtf = timeit(lambda: fwd(net.params_, net.state_, x), lambda: jax.block_until_ready(fwd(net.params_, net.state_, x)))
+print(f"fwd b64: {dtf*1e3:.2f} ms, {64/dtf:.0f} samples/s")
+try:
+    c = fwd.lower(net.params_, net.state_, x).compile()
+    ca = c.cost_analysis()
+    if isinstance(ca, list): ca = ca[0]
+    print("fwd flops:", ca.get("flops"), "bytes:", ca.get("bytes accessed"))
+except Exception as e:
+    print("fwd cost_analysis failed:", e)
+
+# 3) train b256
+net2, x2, y2 = setup(256)
+dt2 = timeit(lambda: net2.fit(x2,y2), lambda: float(net2.score()), warm=2, n=5)
+print(f"train b256: {dt2*1e3:.2f} ms/step, {256/dt2:.0f} samples/s")

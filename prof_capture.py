@@ -1,0 +1,15 @@
+import numpy as np, jax, jax.numpy as jnp
+from deeplearning4j_tpu.train.updaters import Nesterovs
+from deeplearning4j_tpu.zoo import ResNet50
+
+net = ResNet50(n_classes=1000, input_shape=(224,224,3),
+               updater=Nesterovs(0.1,0.9), compute_dtype="bfloat16").init_model()
+rng = np.random.RandomState(0)
+x = jnp.asarray(rng.rand(64,224,224,3).astype(np.float32))
+y = jnp.asarray(np.eye(1000,dtype=np.float32)[rng.randint(0,1000,64)])
+for _ in range(3): net.fit(x,y)
+print("warm score", float(net.score()))
+with jax.profiler.trace("/root/repo/bench_artifacts/trace_r50"):
+    for _ in range(10): net.fit(x,y)
+    print("traced score", float(net.score()))
+print("done")

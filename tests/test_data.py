@@ -437,3 +437,107 @@ def test_transform_process_custom_step_refuses_serialization():
           .filter_by_condition(lambda s, r: r[0] > 0).build())
     with _pytest.raises(ValueError, match="cannot be serialized"):
         tp.to_json()
+
+
+# ---------------------------------------------------------------------------
+# IMDB sentiment iterators (reference CnnSentenceDataSetIterator over the
+# aclImdb corpus)
+# ---------------------------------------------------------------------------
+
+def test_imdb_iterator_reads_acl_imdb_tree(tmp_path):
+    from deeplearning4j_tpu.data import ImdbReviewIterator
+    for sub, texts in (("pos", ["a great movie", "loved it, great fun"]),
+                       ("neg", ["terrible film", "a boring terrible mess"])):
+        d = tmp_path / "train" / sub
+        d.mkdir(parents=True)
+        for i, t in enumerate(texts):
+            (d / f"{i}_7.txt").write_text(t)
+    it = ImdbReviewIterator(2, train=True, data_dir=str(tmp_path),
+                            max_len=8, shuffle=False)
+    assert "great" in it.vocab and "terrible" in it.vocab
+    ds = next(iter(it))
+    assert ds.features.shape == (2, 8) and ds.features.dtype == np.int32
+    assert ds.features_mask.shape == (2, 8)
+    # first review "a great movie" -> 3 tokens masked in
+    assert ds.features_mask[0].sum() == 3
+    np.testing.assert_array_equal(np.argmax(ds.labels, 1), [1, 1])
+    # unknown words map to the unk id under a tiny foreign vocab
+    it2 = ImdbReviewIterator(2, train=True, data_dir=str(tmp_path),
+                             max_len=8, vocab={"great": 2}, shuffle=False)
+    ds2 = next(iter(it2))
+    row = ds2.features[0][ds2.features_mask[0] > 0]
+    assert set(row.tolist()) == {1, 2}      # unk, unk->'a','movie'; 'great'=2
+
+def test_synthetic_imdb_trains_classifier():
+    from deeplearning4j_tpu.data import SyntheticImdb
+    from deeplearning4j_tpu.nn import (EmbeddingSequenceLayer,
+                                       GlobalPoolingLayer, InputType,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.train.updaters import Adam
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(5e-2))
+            .list([EmbeddingSequenceLayer(n_in=500, n_out=16,
+                                          weight_init="NORMAL"),
+                   GlobalPoolingLayer(pooling_type="AVG"),
+                   OutputLayer(n_out=2, loss="mcxent",
+                               activation="softmax")])
+            .set_input_type(InputType.recurrent(1, 64)).build())
+    net = MultiLayerNetwork(conf).init()
+    it = SyntheticImdb(16, n_batches=6, max_len=64, vocab_size=500)
+    net.fit(it, epochs=6)
+    from deeplearning4j_tpu.train.evaluation import Evaluation
+    ev = net.evaluate(SyntheticImdb(16, n_batches=4, max_len=64,
+                                    vocab_size=500, seed=9), Evaluation())
+    assert ev.accuracy() > 0.8, ev.accuracy()
+
+
+def test_set_pre_processor_applies_per_batch():
+    """Reference DataSetIterator.setPreProcessor: attached normalizer runs
+    on every yielded batch."""
+    from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+    rng = np.random.RandomState(0)
+    x = (rng.randn(64, 5) * 4 + 10).astype(np.float32)
+    y = np.zeros((64, 1), np.float32)
+    it = ArrayDataSetIterator(x, y, batch_size=16)
+    nz = NormalizerStandardize().fit(it)
+    it.set_pre_processor(nz)
+    assert it.pre_processor() is nz
+    batches = list(it)
+    allx = np.concatenate([b.features for b in batches])
+    assert abs(allx.mean()) < 1e-4 and abs(allx.std() - 1.0) < 1e-2
+    # second epoch re-reads fresh slices -> stays normalized, not doubled
+    allx2 = np.concatenate([b.features for b in it])
+    np.testing.assert_allclose(allx2, allx, atol=1e-6)
+
+
+def test_pre_processor_does_not_double_apply_on_cached_datasets():
+    """ListDataSetIterator yields the SAME DataSet objects each epoch; the
+    pre-processor wrapper must not re-normalize them (code-review r2)."""
+    rng = np.random.RandomState(1)
+    x = (rng.randn(40, 3) * 5 + 20).astype(np.float32)
+    cached = [DataSet(x[i:i + 10], np.zeros((10, 1))) for i in range(0, 40, 10)]
+    it = ListDataSetIterator(cached)
+    nz = NormalizerStandardize().fit(it)
+    it.set_pre_processor(nz)
+    e1 = np.concatenate([b.features for b in it])
+    e2 = np.concatenate([b.features for b in it])       # second epoch
+    np.testing.assert_allclose(e2, e1, atol=1e-6)
+    # cached originals untouched (rebind-on-copy semantics)
+    np.testing.assert_allclose(cached[0].features, x[:10])
+
+
+def test_imdb_test_split_vocab_comes_from_train(tmp_path):
+    from deeplearning4j_tpu.data import ImdbReviewIterator
+    for part, sub, texts in (("train", "pos", ["great great movie"]),
+                             ("train", "neg", ["terrible film"]),
+                             ("test", "pos", ["brandnewword great"]),
+                             ("test", "neg", ["terrible brandnewword"])):
+        d = tmp_path / part / sub
+        d.mkdir(parents=True, exist_ok=True)
+        (d / "0_1.txt").write_text(texts[0])
+    tr = ImdbReviewIterator(1, train=True, data_dir=str(tmp_path), max_len=4,
+                            shuffle=False)
+    te = ImdbReviewIterator(1, train=False, data_dir=str(tmp_path), max_len=4,
+                            shuffle=False)
+    assert te.vocab == tr.vocab                   # ids agree across splits
+    assert "brandnewword" not in te.vocab         # test-only word -> unk

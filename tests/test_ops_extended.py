@@ -1,0 +1,378 @@
+"""Round-2 declarable-op additions (reference `libnd4j/include/ops/
+declarable/generic/{random,bitwise,images,transforms,loss,nn}/**`):
+forward values vs numpy/scipy oracles + grad spot-checks.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.ops import OP_TABLE
+
+rng = np.random.default_rng(0)
+
+
+def op(name):
+    assert name in OP_TABLE, f"op '{name}' not registered"
+    return OP_TABLE[name]
+
+
+# ---- random ----
+
+def test_random_ops_shapes_and_ranges():
+    key = jax.random.PRNGKey(0)
+    u = np.asarray(op("random_uniform")(key, (1000,), 2.0, 5.0))
+    assert u.shape == (1000,) and (u >= 2.0).all() and (u < 5.0).all()
+    n = np.asarray(op("random_normal")(key, (5000,), 1.0, 2.0))
+    assert abs(n.mean() - 1.0) < 0.2 and abs(n.std() - 2.0) < 0.2
+    b = np.asarray(op("random_bernoulli")(key, (1000,), 0.25))
+    assert 0.15 < b.mean() < 0.35
+    e = np.asarray(op("random_exponential")(key, (5000,), 2.0))
+    assert (e >= 0).all() and abs(e.mean() - 0.5) < 0.1
+    g = np.asarray(op("random_gamma")(key, (5000,), 3.0, 2.0))
+    assert abs(g.mean() - 1.5) < 0.2
+    p = np.asarray(op("random_poisson")(key, (5000,), 4.0))
+    assert abs(p.mean() - 4.0) < 0.3
+    a = np.arange(100)
+    sh = np.asarray(op("random_shuffle")(key, jnp.asarray(a)))
+    assert sorted(sh.tolist()) == a.tolist() and not (sh == a).all()
+    logits = jnp.log(jnp.asarray([[0.1, 0.9], [0.5, 0.5]]))
+    m = np.asarray(op("multinomial")(key, logits, 200))
+    assert m.shape == (2, 200) and m[0].mean() > 0.7
+
+
+# ---- bitwise ----
+
+def test_bitwise_ops():
+    a = jnp.asarray([0b1100, 0b1010], jnp.int32)
+    b = jnp.asarray([0b1010, 0b0110], jnp.int32)
+    np.testing.assert_array_equal(op("bitwise_and")(a, b), [0b1000, 0b0010])
+    np.testing.assert_array_equal(op("bitwise_or")(a, b), [0b1110, 0b1110])
+    np.testing.assert_array_equal(op("bitwise_xor")(a, b), [0b0110, 0b1100])
+    np.testing.assert_array_equal(op("shift_left")(a, 2), [0b110000, 0b101000])
+    np.testing.assert_array_equal(op("shift_right")(a, 2), [0b11, 0b10])
+    assert int(op("bits_hamming_distance")(a, b)) == 4
+
+
+# ---- segment / scatter ----
+
+def test_unsorted_segment_family():
+    data = jnp.asarray(rng.standard_normal((6, 3)).astype(np.float32))
+    ids = jnp.asarray([2, 0, 1, 0, 2, 2])
+    s = np.asarray(op("unsorted_segment_sum")(data, ids, 3))
+    m = np.asarray(op("unsorted_segment_mean")(data, ids, 3))
+    np.testing.assert_allclose(s[0], np.asarray(data)[[1, 3]].sum(0),
+                               rtol=1e-5)
+    np.testing.assert_allclose(m[2], np.asarray(data)[[0, 4, 5]].mean(0),
+                               rtol=1e-5)
+    sq = np.asarray(op("unsorted_segment_sqrt_n")(data, ids, 3))
+    np.testing.assert_allclose(
+        sq[2], np.asarray(data)[[0, 4, 5]].sum(0) / np.sqrt(3), rtol=1e-5)
+    p = np.asarray(op("unsorted_segment_prod")(data, ids, 3))
+    np.testing.assert_allclose(p[1], np.asarray(data)[2], rtol=1e-5)
+
+
+def test_scatter_breadth_and_dynamic_stitch():
+    base = jnp.ones((4, 2), jnp.float32)
+    idx = jnp.asarray([1, 3])
+    upd = jnp.full((2, 2), 3.0)
+    np.testing.assert_allclose(np.asarray(op("scatter_mul")(base, idx, upd))[1],
+                               3.0)
+    np.testing.assert_allclose(np.asarray(op("scatter_sub")(base, idx, upd))[3],
+                               -2.0)
+    nd_idx = jnp.asarray([[0, 1], [2, 0]])
+    out = np.asarray(op("scatter_nd")(nd_idx, jnp.asarray([5.0, 7.0]), (3, 2)))
+    assert out[0, 1] == 5.0 and out[2, 0] == 7.0 and out.sum() == 12.0
+    st = np.asarray(op("dynamic_stitch")(
+        [jnp.asarray([0, 2]), jnp.asarray([1, 3])],
+        [jnp.asarray([[1.], [3.]]), jnp.asarray([[2.], [4.]])]))
+    np.testing.assert_allclose(st[:, 0], [1, 2, 3, 4])
+
+
+# ---- distances / reductions ----
+
+def test_distance_ops():
+    a = jnp.asarray([[1.0, 0.0], [0.0, 1.0]])
+    b = jnp.asarray([[0.0, 0.0], [0.0, 2.0]])
+    np.testing.assert_allclose(op("euclidean_distance")(a, b, axis=-1),
+                               [1.0, 1.0])
+    np.testing.assert_allclose(op("manhattan_distance")(a, b, axis=-1),
+                               [1.0, 1.0])
+    np.testing.assert_allclose(op("cosine_similarity")(a, a, axis=-1),
+                               [1.0, 1.0], rtol=1e-6)
+    np.testing.assert_allclose(op("hamming_distance")(a, b, axis=-1),
+                               [1.0, 1.0])
+
+
+def test_reduction_breadth():
+    x = jnp.asarray([[-3.0, 1.0], [2.0, -4.0]])
+    np.testing.assert_allclose(op("amax")(x), 4.0)
+    np.testing.assert_allclose(op("asum")(x), 10.0)
+    np.testing.assert_allclose(op("norm1")(x, axis=1), [4.0, 6.0])
+    assert bool(op("reduce_any")(x > 1.5))
+    assert not bool(op("reduce_all")(x > 0.0))
+    p = jnp.asarray([0.5, 0.5])
+    np.testing.assert_allclose(op("entropy")(p), np.log(2), rtol=1e-6)
+    np.testing.assert_allclose(op("shannon_entropy")(p), 1.0, rtol=1e-6)
+    z = jnp.asarray([0.0, 1.0, 0.0, 2.0])
+    np.testing.assert_allclose(op("zero_fraction")(z), 0.5)
+    v = jnp.asarray(rng.standard_normal(101).astype(np.float32))
+    np.testing.assert_allclose(op("median")(v), np.median(np.asarray(v)),
+                               rtol=1e-6)
+    np.testing.assert_allclose(op("percentile")(v, 25.0),
+                               np.percentile(np.asarray(v), 25.0), rtol=1e-5)
+    np.testing.assert_allclose(
+        op("nth_element")(v, 3), np.sort(np.asarray(v))[3], rtol=1e-6)
+
+
+# ---- images ----
+
+def test_colorspace_roundtrips():
+    img = jnp.asarray(rng.random((2, 4, 4, 3)).astype(np.float32))
+    back = op("hsv_to_rgb")(op("rgb_to_hsv")(img))
+    np.testing.assert_allclose(np.asarray(back), np.asarray(img), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(op("yiq_to_rgb")(op("rgb_to_yiq")(img))),
+                               np.asarray(img), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(op("yuv_to_rgb")(op("rgb_to_yuv")(img))),
+                               np.asarray(img), atol=1e-4)
+    g = np.asarray(op("rgb_to_grs")(img))
+    assert g.shape == (2, 4, 4, 1)
+
+
+def test_adjust_ops():
+    img = jnp.asarray(rng.random((1, 4, 4, 3)).astype(np.float32))
+    same = op("adjust_hue")(img, 0.0)
+    np.testing.assert_allclose(np.asarray(same), np.asarray(img), atol=1e-4)
+    c = op("adjust_contrast")(img, 1.0)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(img), atol=1e-6)
+    s = op("adjust_saturation")(img, 1.0)
+    np.testing.assert_allclose(np.asarray(s), np.asarray(img), atol=1e-4)
+
+
+def test_crop_and_resize_identity():
+    img = jnp.asarray(rng.random((1, 8, 8, 2)).astype(np.float32))
+    boxes = jnp.asarray([[0.0, 0.0, 1.0, 1.0]])
+    out = op("crop_and_resize")(img, boxes, jnp.asarray([0]), (8, 8))
+    np.testing.assert_allclose(np.asarray(out)[0], np.asarray(img)[0],
+                               atol=1e-5)
+
+
+def test_extract_image_patches_and_im2col():
+    img = jnp.asarray(np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1))
+    patches = np.asarray(op("extract_image_patches")(img, (2, 2), (2, 2)))
+    assert patches.shape == (1, 2, 2, 4)
+    np.testing.assert_allclose(patches[0, 0, 0], [0, 1, 4, 5])
+    col = np.asarray(op("im2col")(img, 2, 2, 2, 2))
+    assert col.shape == (1, 2, 2, 2, 2, 1)
+
+
+def test_non_max_suppression():
+    boxes = jnp.asarray([[0, 0, 1, 1], [0, 0, 1, 1.04],
+                         [2, 2, 3, 3]], jnp.float32)
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+    picked = np.asarray(op("non_max_suppression")(boxes, scores, 3, 0.5))
+    assert picked[0] == 0 and 2 in picked.tolist()
+    assert 1 not in picked.tolist()
+
+
+# ---- spatial / shape ----
+
+def test_space_batch_roundtrip_and_misc():
+    x = jnp.asarray(rng.random((2, 4, 4, 3)).astype(np.float32))
+    rt = op("batch_to_space")(op("space_to_batch")(x, 2), 2)
+    np.testing.assert_allclose(np.asarray(rt), np.asarray(x))
+    up = np.asarray(op("upsampling2d")(x, 2))
+    assert up.shape == (2, 8, 8, 3)
+    assert up[0, 0, 0, 0] == up[0, 1, 1, 0]
+    m = np.asarray(op("sequence_mask")(jnp.asarray([1, 3]), 4))
+    np.testing.assert_allclose(m, [[1, 0, 0, 0], [1, 1, 1, 0]])
+    mp = np.asarray(op("mirror_pad")(jnp.asarray([[1.0, 2.0, 3.0]]),
+                                     [(0, 0), (1, 1)]))
+    np.testing.assert_allclose(mp[0], [2, 1, 2, 3, 2])
+    bt = np.asarray(op("broadcast_to")(jnp.asarray([1.0, 2.0]), (3, 2)))
+    assert bt.shape == (3, 2)
+
+
+# ---- nn breadth ----
+
+def test_conv3d_pool3d():
+    x = jnp.asarray(rng.standard_normal((1, 4, 4, 4, 2)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((2, 2, 2, 2, 3)).astype(np.float32)
+                    * 0.1)
+    y = op("conv3d")(x, w, stride=(1, 1, 1), padding="SAME")
+    assert y.shape == (1, 4, 4, 4, 3)
+    p = op("max_pooling3d")(x)
+    assert p.shape == (1, 2, 2, 2, 2)
+    a = np.asarray(op("avg_pooling3d")(x))
+    np.testing.assert_allclose(
+        a[0, 0, 0, 0, 0], np.asarray(x)[0, :2, :2, :2, 0].mean(), rtol=1e-5)
+
+
+def test_gru_lstm_cells():
+    B, I, H = 2, 3, 4
+    x = jnp.asarray(rng.standard_normal((B, I)).astype(np.float32))
+    h = jnp.zeros((B, H))
+    c = jnp.zeros((B, H))
+    w_ih3 = jnp.asarray(rng.standard_normal((I, 3 * H)).astype(np.float32)
+                        * 0.3)
+    w_hh3 = jnp.asarray(rng.standard_normal((H, 3 * H)).astype(np.float32)
+                        * 0.3)
+    h2 = op("gru_cell")(x, h, w_ih3, w_hh3)
+    assert h2.shape == (B, H) and np.isfinite(np.asarray(h2)).all()
+    w_ih4 = jnp.asarray(rng.standard_normal((I, 4 * H)).astype(np.float32)
+                        * 0.3)
+    w_hh4 = jnp.asarray(rng.standard_normal((H, 4 * H)).astype(np.float32)
+                        * 0.3)
+    h3, c3 = op("lstm_cell")(x, h, c, w_ih4, w_hh4)
+    assert h3.shape == (B, H) and np.isfinite(np.asarray(c3)).all()
+    # gradient flows through the cell
+    g = jax.grad(lambda w: jnp.sum(op("gru_cell")(x, h, w, w_hh3) ** 2))(
+        w_ih3)
+    assert np.isfinite(np.asarray(g)).all() and float(jnp.sum(g * g)) > 0
+
+
+def test_prelu_lrn_misc_activations():
+    x = jnp.asarray([[-2.0, 3.0]])
+    np.testing.assert_allclose(op("prelu")(x, jnp.asarray([0.1, 0.1])),
+                               [[-0.2, 3.0]], rtol=1e-6)
+    img = jnp.asarray(rng.random((1, 2, 2, 8)).astype(np.float32))
+    y = op("lrn")(img)
+    assert y.shape == img.shape and np.isfinite(np.asarray(y)).all()
+    assert np.isfinite(np.asarray(op("hard_swish")(x))).all()
+    assert np.isfinite(np.asarray(op("log_sigmoid")(x))).all()
+
+
+# ---- matrix ----
+
+def test_matrix_diag_family():
+    d = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    md = np.asarray(op("matrix_diag")(d))
+    assert md.shape == (2, 2, 2) and md[0, 0, 0] == 1.0 and md[0, 0, 1] == 0
+    np.testing.assert_allclose(np.asarray(op("matrix_diag_part")(md)), d)
+    a = jnp.ones((2, 2))
+    out = np.asarray(op("matrix_set_diag")(a, jnp.asarray([5.0, 6.0])))
+    np.testing.assert_allclose(out, [[5, 1], [1, 6]])
+    spd = jnp.asarray(np.array([[4.0, 1.0], [1.0, 3.0]], np.float32))
+    pl, l_, u_ = op("lu")(spd)
+    np.testing.assert_allclose(np.asarray(pl @ l_ @ u_), np.asarray(spd),
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(op("pinv")(spd) @ spd), np.eye(2), atol=1e-5)
+
+
+# ---- compare/classification ----
+
+def test_is_max_in_top_k_confusion():
+    a = jnp.asarray([[1.0, 3.0, 2.0]])
+    np.testing.assert_allclose(op("is_max")(a), [[0, 1, 0]])
+    preds = jnp.asarray([[0.1, 0.9], [0.8, 0.2]])
+    t = np.asarray(op("in_top_k")(preds, jnp.asarray([1, 1]), 1))
+    assert t.tolist() == [True, False]
+    cm = np.asarray(op("confusion_matrix")(
+        jnp.asarray([0, 1, 1]), jnp.asarray([0, 1, 0]), 2))
+    np.testing.assert_allclose(cm, [[1, 0], [1, 1]])
+
+
+# ---- losses ----
+
+def test_loss_breadth():
+    labels = jnp.asarray([1.0, 0.0, 1.0])
+    logits = jnp.asarray([2.0, -1.0, -0.5])
+    h = float(op("hinge_loss")(labels, logits))
+    np.testing.assert_allclose(h, np.mean([0.0, 0.0, 1.5]), rtol=1e-6)
+    w = float(op("weighted_cross_entropy_with_logits")(labels, logits, 2.0))
+    # oracle: TF formula
+    ref = np.mean((1 - np.asarray(labels)) * np.asarray(logits)
+                  + (1 + np.asarray(labels))
+                  * np.log1p(np.exp(-np.abs(np.asarray(logits))))
+                  + (1 + np.asarray(labels))
+                  * np.maximum(-np.asarray(logits), 0))
+    np.testing.assert_allclose(w, ref, rtol=1e-5)
+    p = float(op("poisson_loss")(jnp.asarray([2.0]), jnp.asarray([3.0])))
+    np.testing.assert_allclose(p, 3.0 - 2.0 * np.log(3.0 + 1e-8), rtol=1e-5)
+    kl = float(op("kl_divergence")(jnp.asarray([[0.5, 0.5]]),
+                                   jnp.asarray([[0.25, 0.75]])))
+    ref_kl = 0.5 * np.log(2) + 0.5 * np.log(2 / 3)
+    np.testing.assert_allclose(kl, ref_kl, rtol=1e-5)
+
+
+def test_ctc_loss_matches_torch():
+    torch = pytest.importorskip("torch")
+    B, T, C, S = 2, 8, 5, 3
+    logits = rng.standard_normal((B, T, C)).astype(np.float32)
+    log_probs = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)
+    labels = np.array([[1, 2, 1], [3, 3, 0]], np.int64)  # second has len 2
+    in_len = np.array([8, 6])
+    lab_len = np.array([3, 2])
+    ours = np.asarray(OP_TABLE["ctc_loss"](
+        log_probs, jnp.asarray(labels), jnp.asarray(in_len),
+        jnp.asarray(lab_len)))
+    t_lp = torch.from_numpy(np.asarray(log_probs)).permute(1, 0, 2)
+    ref = torch.nn.functional.ctc_loss(
+        t_lp, torch.from_numpy(labels), torch.from_numpy(in_len),
+        torch.from_numpy(lab_len), blank=0, reduction="none")
+    np.testing.assert_allclose(ours, ref.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_loss_grad_finite():
+    B, T, C = 1, 6, 4
+    logits = jnp.asarray(rng.standard_normal((B, T, C)).astype(np.float32))
+    labels = jnp.asarray([[1, 2]])
+    fn = lambda lg: jnp.sum(OP_TABLE["ctc_loss"](
+        jax.nn.log_softmax(lg, -1), labels, jnp.asarray([6]),
+        jnp.asarray([2])))
+    g = jax.grad(fn)(logits)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+# ---- special functions ----
+
+def test_special_functions():
+    sp = pytest.importorskip("scipy.special")
+    x = np.linspace(0.1, 3.0, 7).astype(np.float32)
+    np.testing.assert_allclose(op("igamma")(2.0, jnp.asarray(x)),
+                               sp.gammainc(2.0, x), rtol=1e-4)
+    np.testing.assert_allclose(op("igammac")(2.0, jnp.asarray(x)),
+                               sp.gammaincc(2.0, x), rtol=1e-4)
+    np.testing.assert_allclose(
+        op("betainc")(2.0, 3.0, jnp.asarray(x / 4)),
+        sp.betainc(2.0, 3.0, x / 4), rtol=1e-4)
+    np.testing.assert_allclose(op("zeta")(jnp.asarray([2.0]), 1.0),
+                               [np.pi ** 2 / 6], rtol=1e-4)
+
+
+def test_clip_by_global_norm():
+    xs = [jnp.asarray([3.0, 4.0]), jnp.asarray([0.0])]
+    out = op("clip_by_global_norm")(1.0, *xs)
+    total = np.sqrt(sum(float(jnp.sum(o * o)) for o in out))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+
+def test_op_count_vs_reference_inventory():
+    """Round-2 breadth: the registry should keep growing toward the ~500
+    reference declarable ops (VERDICT round 1: 113; round 2 target: 300+)."""
+    assert len(OP_TABLE) >= 300, len(OP_TABLE)
+
+
+def test_matrix_set_diag_rectangular():
+    a = jnp.ones((2, 3))
+    out = np.asarray(op("matrix_set_diag")(a, jnp.asarray([7.0, 8.0])))
+    np.testing.assert_allclose(out, [[7, 1, 1], [1, 8, 1]])
+    a2 = jnp.ones((3, 2))
+    out2 = np.asarray(op("matrix_set_diag")(a2, jnp.asarray([7.0, 8.0])))
+    np.testing.assert_allclose(out2, [[7, 1], [1, 8], [1, 1]])
+
+
+def test_dynamic_stitch_sizes_by_max_index():
+    out = np.asarray(op("dynamic_stitch")(
+        [jnp.asarray([0, 1]), jnp.asarray([1, 2])],
+        [jnp.asarray([[1.], [9.]]), jnp.asarray([[2.], [3.]])]))
+    assert out.shape == (3, 1)                     # max index + 1, not 4
+    np.testing.assert_allclose(out[:, 0], [1, 2, 3])  # later list wins at 1
+
+
+def test_cyclic_shift_identity_at_zero():
+    a = jnp.asarray([5, 9], jnp.int32)
+    np.testing.assert_array_equal(op("cyclic_shift_left")(a, 0), a)
+    np.testing.assert_array_equal(op("cyclic_shift_left")(a, 32), a)
+    np.testing.assert_array_equal(op("cyclic_shift_left")(a, 1), [10, 18])

@@ -1,0 +1,72 @@
+"""Donation/aliasing sanitizers (SURVEY §5.2: the workspace-misuse
+validation equivalent — named errors for use-after-donation and
+cross-network buffer sharing)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.utils.sanitize import (BufferValidationError,
+                                               assert_disjoint, assert_live,
+                                               validate_network)
+
+
+def _donate(tree):
+    """Run a donating jitted identity-ish step, deleting the input buffers."""
+    f = jax.jit(lambda t: jax.tree_util.tree_map(lambda a: a + 1.0, t),
+                donate_argnums=(0,))
+    return f(tree)
+
+
+def test_assert_live_passes_then_catches_donation():
+    tree = {"w": jnp.ones((4, 4)), "b": jnp.zeros(4)}
+    assert_live(tree, "model")          # fresh buffers: fine
+    _ = _donate(tree)
+    with pytest.raises(BufferValidationError, match="donated"):
+        assert_live(tree, "model")
+
+
+def test_assert_disjoint_detects_shared_buffer():
+    w = jnp.ones((3, 3))
+    a = {"w": w}
+    b = {"w": w}                         # alias — the transfer-learning bug
+    with pytest.raises(BufferValidationError, match="shared"):
+        assert_disjoint(a, b, "src vs dst")
+    c = {"w": jnp.copy(w)}               # deep copy — correct transplant
+    assert_disjoint(a, c, "src vs dst")
+
+
+def test_validate_network_names_the_attribute():
+    class Net:
+        pass
+
+    net = Net()
+    net.params_ = {"dense": {"W": jnp.ones((2, 2))}}
+    net.state_ = None
+    validate_network(net)
+    _ = _donate(net.params_)
+    with pytest.raises(BufferValidationError, match="params_"):
+        validate_network(net)
+
+
+def test_transfer_learning_nets_hold_disjoint_buffers():
+    """Regression guard for ADVICE r1 (transferlearning.py transplant by
+    reference): derived net must not share donated buffers with source."""
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.nn.transferlearning import TransferLearning
+
+    conf = (NeuralNetConfiguration.builder().seed(0)
+            .list([DenseLayer(n_out=8, activation="relu"),
+                   OutputLayer(n_out=3, loss="mcxent",
+                               activation="softmax")])
+            .set_input_type(InputType.feed_forward(5)).build())
+    src = MultiLayerNetwork(conf).init()
+    derived = TransferLearning.builder(src).set_feature_extractor(0).build()
+    assert_disjoint(src.params_, derived.params_, "src vs transfer")
+    x = np.random.RandomState(0).rand(4, 5).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]
+    derived.fit(x, y)
+    out = src.output(x)                  # source must survive derived's fit
+    assert np.isfinite(np.asarray(out)).all()
