@@ -771,7 +771,8 @@ def _dynamic_stitch(indices, data):
     count (correct whenever indices form a permutation)."""
     try:
         n = max(int(jnp.max(i)) for i in indices) + 1
-    except jax.errors.ConcretizationTypeError:
+    except (jax.errors.ConcretizationTypeError,
+            jax.errors.TracerIntegerConversionError):
         n = sum(int(i.size) for i in indices)
     first = data[0]
     out = jnp.zeros((n,) + first.shape[1:], first.dtype)
@@ -1242,6 +1243,12 @@ def _ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0):
     [B, T, C] log-softmaxed; `labels` [B, S] int; returns [B] losses."""
     B, T, C = log_probs.shape
     S = labels.shape[1]
+    if S == 0:
+        # empty targets: the only valid path emits blank everywhere
+        t_idx = jnp.arange(T)
+        live = t_idx[None, :] < input_lengths[:, None]
+        return -jnp.sum(jnp.where(live, log_probs[:, :, blank], 0.0),
+                        axis=1)
     L = 2 * S + 1
     NEG = jnp.asarray(-1e30, log_probs.dtype)
     lab = labels.astype(jnp.int32)

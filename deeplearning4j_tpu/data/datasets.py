@@ -38,7 +38,10 @@ def read_idx(path: str) -> np.ndarray:
 
 class _ArrayDataSetIterator(DataSetIterator):
     """Shared shuffled/drop-last batching over in-memory (x, y) arrays —
-    the common substrate of the MNIST/EMNIST/CIFAR iterators."""
+    the common substrate of the MNIST/EMNIST/CIFAR/IMDB iterators.  A
+    subclass may set `self.mask` to emit per-batch features masks."""
+
+    mask: Optional[np.ndarray] = None
 
     def _init_batching(self, batch_size: int, shuffle: bool, seed: int):
         self._bs = batch_size
@@ -54,7 +57,9 @@ class _ArrayDataSetIterator(DataSetIterator):
             self._rng.shuffle(idx)
         for i in range(0, len(idx) - self._bs + 1, self._bs):
             sl = idx[i:i + self._bs]
-            yield DataSet(self.x[sl], self.y[sl])
+            yield DataSet(self.x[sl], self.y[sl],
+                          features_mask=None if self.mask is None
+                          else self.mask[sl])
 
 
 class MnistDataSetIterator(_ArrayDataSetIterator):
@@ -238,7 +243,7 @@ class EmnistDataSetIterator(_ArrayDataSetIterator):
         self._init_batching(batch_size, shuffle, seed)
 
 
-class ImdbReviewIterator(DataSetIterator):
+class ImdbReviewIterator(_ArrayDataSetIterator):
     """IMDB sentiment batches over the standard `aclImdb/` directory layout
     (`{train|test}/{pos|neg}/*.txt`) — the reference's IMDB path is
     `CnnSentenceDataSetIterator` over the aclImdb corpus
@@ -306,26 +311,12 @@ class ImdbReviewIterator(DataSetIterator):
             self.x[i, :len(ids)] = ids
             self.mask[i, :len(ids)] = 1.0
         self.y = np.eye(2, dtype=np.float32)[np.asarray(labels)]
-        self._bs = batch_size
-        self._shuffle = shuffle
-        self._rng = np.random.default_rng(seed)
+        self._init_batching(batch_size, shuffle, seed)
 
     @staticmethod
     def _tokenize(text: str):
         import re
         return re.findall(r"[a-z0-9']+", text.lower())
-
-    def batch_size(self) -> int:
-        return self._bs
-
-    def __iter__(self) -> Iterator[DataSet]:
-        idx = np.arange(len(self.x))
-        if self._shuffle:
-            self._rng.shuffle(idx)
-        for i in range(0, len(idx) - self._bs + 1, self._bs):
-            sl = idx[i:i + self._bs]
-            yield DataSet(self.x[sl], self.y[sl],
-                          features_mask=self.mask[sl])
 
 
 class SyntheticImdb(DataSetIterator):

@@ -20,6 +20,9 @@ from deeplearning4j_tpu.nn.layers_extra import (  # noqa: F401
     Cropping2DLayer, Cropping3DLayer, Deconvolution3DLayer,
     LocallyConnected1DLayer, LocallyConnected2DLayer, PReLULayer,
     Subsampling1DLayer, Subsampling3DLayer)
+from deeplearning4j_tpu.nn.custom import (  # noqa: F401
+    CapsuleLayer, CapsuleStrengthLayer, LambdaLayer, PrimaryCapsules,
+    SameDiffLayer)
 from deeplearning4j_tpu.nn.multilayer import (  # noqa: F401
     MultiLayerConfiguration, MultiLayerNetwork, NeuralNetConfiguration)
 from deeplearning4j_tpu.nn.graph import (  # noqa: F401
@@ -44,6 +47,7 @@ _LAYER_CLASSES = [
     Cropping2DLayer, Cropping3DLayer, Deconvolution3DLayer,
     LocallyConnected1DLayer, LocallyConnected2DLayer, PReLULayer,
     Subsampling1DLayer, Subsampling3DLayer,
+    CapsuleLayer, CapsuleStrengthLayer, LambdaLayer, PrimaryCapsules,
 ]
 
 # Name -> class registry for config JSON round-trip (the reference's Jackson

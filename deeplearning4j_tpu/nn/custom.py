@@ -1,0 +1,204 @@
+"""User-defined layers — the SameDiff escape hatch and CapsNet.
+
+Reference: `deeplearning4j-nn/.../nn/conf/layers/samediff/
+{AbstractSameDiffLayer,SameDiffLayer,SameDiffLambdaLayer}.java` (subclass,
+declare parameters, define the forward in SameDiff ops) and
+`nn/conf/layers/{PrimaryCapsules,CapsuleLayer,CapsuleStrengthLayer}.java`
+(Sabour et al. 2017 dynamic routing, which the reference builds ON SameDiff
+layers — the canonical use of the escape hatch).
+
+TPU-native inversion: the "define your layer as a graph" contract becomes
+"define your layer as a jax-traceable function".  Subclasses write plain
+jnp/lax ops; XLA fuses them into the same compiled train step as the
+built-in layers.  Custom subclasses JSON-round-trip like any layer once
+registered (`deeplearning4j_tpu.nn.register_layer`), matching the
+reference's Jackson-by-class-name behavior.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.core import InputType, Layer
+from deeplearning4j_tpu.ops.initializers import init_weights
+
+ShapeSpec = Union[Tuple[int, ...], Tuple[Tuple[int, ...], str]]
+
+
+@dataclasses.dataclass(kw_only=True)
+class SameDiffLayer(Layer):
+    """Subclass-and-implement custom layer (reference `SameDiffLayer`):
+
+    - `define_parameters(input_type) -> {name: shape | (shape, init)}`
+      (the `defineParameters(SDLayerParams)` role; `init` is a WeightInit
+      scheme name, default this layer's `weight_init`)
+    - `define_layer(params, x, mask=None) -> y` with jnp ops
+      (the `defineLayer(sd, input, params, mask)` role)
+    - `get_output_type(input_type)` (defaults to same-as-input)
+    """
+
+    REGULARIZABLE: Tuple[str, ...] = ("W",)
+
+    def define_parameters(self, input_type: InputType) -> Dict[str, ShapeSpec]:
+        raise NotImplementedError
+
+    def define_layer(self, params, x, mask=None):
+        raise NotImplementedError
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        params = {}
+        for i, (name, spec) in enumerate(
+                sorted(self.define_parameters(input_type).items())):
+            if (isinstance(spec, tuple) and len(spec) == 2
+                    and isinstance(spec[1], str)):
+                shape, scheme = spec
+            else:
+                shape, scheme = spec, self.winit("XAVIER")
+            if scheme.upper() == "ZERO":
+                params[name] = jnp.zeros(tuple(shape), dtype)
+            else:
+                params[name] = init_weights(jax.random.fold_in(rng, i),
+                                            tuple(shape), scheme, dtype)
+        return params, {}, self.get_output_type(input_type)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_input_dropout(x, train, rng)
+        return self.act_fn()(self.define_layer(params, x, mask=mask)), state
+
+
+@dataclasses.dataclass(kw_only=True)
+class LambdaLayer(Layer):
+    """Parameter-free function layer (reference `SameDiffLambdaLayer`).
+    Quick inline use: `LambdaLayer(fn=lambda x: x * 2)`.  Inline callables
+    cannot survive config JSON (same as the reference's anonymous
+    subclasses); subclass and register for serializable models."""
+
+    fn: Optional[Callable[[Any], Any]] = None
+    REGULARIZABLE: Tuple[str, ...] = ()
+
+    def call(self, x):
+        if self.fn is None:
+            raise NotImplementedError("pass fn= or subclass and override call")
+        return self.fn(x)
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        return {}, {}, self.get_output_type(input_type)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return self.call(x), state
+
+    def to_json(self) -> dict:
+        if type(self) is LambdaLayer and self.fn is not None:
+            raise ValueError(
+                "LambdaLayer with an inline fn cannot be serialized — "
+                "subclass LambdaLayer, override call(), and register_layer "
+                "it (reference SameDiffLambdaLayer has the same contract)")
+        return super().to_json()
+
+
+# ---------------------------------------------------------------------------
+# CapsNet (Sabour et al. 2017; reference PrimaryCapsules / CapsuleLayer /
+# CapsuleStrengthLayer configs, built on the SameDiff escape hatch upstream)
+# ---------------------------------------------------------------------------
+
+def _squash(s, axis=-1, eps=1e-8):
+    """v = (|s|^2 / (1+|s|^2)) * s/|s| — the capsule nonlinearity."""
+    sq = jnp.sum(s * s, axis=axis, keepdims=True)
+    return (sq / (1.0 + sq)) * s / jnp.sqrt(sq + eps)
+
+
+@dataclasses.dataclass(kw_only=True)
+class PrimaryCapsules(Layer):
+    """Conv → capsule reshape → squash (reference `PrimaryCapsules`):
+    a conv2d with `capsules * capsule_dim` filters whose output becomes
+    [B, N_caps, capsule_dim] capsule vectors."""
+
+    capsules: int = 8
+    capsule_dim: int = 8
+    kernel_size: int = 9
+    stride: int = 2
+    REGULARIZABLE: Tuple[str, ...] = ("W",)
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        h, w, c = input_type.shape
+        k = int(self.kernel_size)
+        n_ch = self.capsules * self.capsule_dim
+        params = {"W": init_weights(rng, (k, k, c, n_ch),
+                                    self.winit("RELU"), dtype),
+                  "b": jnp.zeros((n_ch,), dtype)}
+        oh = (h - k) // int(self.stride) + 1
+        ow = (w - k) // int(self.stride) + 1
+        self._n_caps = oh * ow * self.capsules
+        return params, {}, InputType.recurrent(self.capsule_dim,
+                                               self._n_caps)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        from jax import lax
+        y = lax.conv_general_dilated(
+            x, params["W"], window_strides=(int(self.stride),) * 2,
+            padding="VALID", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        y = y + params["b"]
+        caps = y.reshape(y.shape[0], -1, self.capsule_dim)
+        return _squash(caps), state
+
+
+@dataclasses.dataclass(kw_only=True)
+class CapsuleLayer(Layer):
+    """Dynamic-routing capsule layer (reference `CapsuleLayer`): input
+    [B, N_in, D_in] capsules are linearly mapped to per-output predictions
+    and combined over `routings` agreement iterations."""
+
+    capsules: int = 10
+    capsule_dim: int = 16
+    routings: int = 3
+    REGULARIZABLE: Tuple[str, ...] = ("W",)
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        n_in, d_in = input_type.shape
+        params = {"W": init_weights(
+            rng, (n_in, d_in, self.capsules * self.capsule_dim),
+            self.winit("XAVIER"), dtype)}
+        return params, {}, InputType.recurrent(self.capsule_dim,
+                                               self.capsules)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        B, N_in, _ = x.shape
+        # predictions u_hat[b, i, j, d]: per-input-capsule votes
+        u_hat = jnp.einsum("bni,nio->bno", x, params["W"]).reshape(
+            B, N_in, self.capsules, self.capsule_dim)
+        logits = jnp.zeros((B, N_in, self.capsules), u_hat.dtype)
+        v = None
+        for r in range(int(self.routings)):
+            c = jax.nn.softmax(logits, axis=-1)          # couple over j
+            s = jnp.einsum("bnj,bnjd->bjd", c, u_hat)
+            v = _squash(s)
+            if r + 1 < self.routings:
+                # agreement; stop-grad on the routing signal as in the
+                # reference implementation (routing is not backpropped)
+                logits = logits + jax.lax.stop_gradient(
+                    jnp.einsum("bnjd,bjd->bnj", u_hat, v))
+        return v, state
+
+
+@dataclasses.dataclass(kw_only=True)
+class CapsuleStrengthLayer(Layer):
+    """Capsule length head (reference `CapsuleStrengthLayer`):
+    [B, N, D] → [B, N] vector norms = class probabilities."""
+
+    REGULARIZABLE: Tuple[str, ...] = ()
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        n, _ = input_type.shape
+        return {}, {}, InputType.feed_forward(n)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return jnp.sqrt(jnp.sum(x * x, axis=-1) + 1e-8), state

@@ -1,3 +1,6 @@
+"""ResNet-50 single-chip ablation probe: train-vs-forward step time, XLA
+cost analysis, batch scaling.  Companion of prof_capture.py; results in
+bench_artifacts/PERF_ANALYSIS.md."""
 import time, numpy as np, jax, jax.numpy as jnp
 from deeplearning4j_tpu.train.updaters import Nesterovs
 from deeplearning4j_tpu.zoo import ResNet50
@@ -22,16 +25,6 @@ def setup(batch, image=224, classes=1000):
 net, x, y = setup(64)
 dt = timeit(lambda: net.fit(x,y), lambda: float(net.score()))
 print(f"train b64: {dt*1e3:.2f} ms/step, {64/dt:.0f} samples/s")
-
-# cost analysis of the compiled train step
-try:
-    step = net._train_step
-    if step is not None:
-        ca = step.lower(net.params_, net.state_, net.opt_state_,
-                        {"input": x}, [y], None, jax.random.PRNGKey(0),
-                        0, 0).compile().cost_analysis() if False else None
-except Exception as e:
-    print("cost_analysis path 1 failed:", e)
 
 # 2) fwd-only b64
 fwd = jax.jit(lambda p,s,xx: net._forward(p,s,{"input":xx},train=False,rng=None)[0]["output"])

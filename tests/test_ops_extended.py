@@ -376,3 +376,17 @@ def test_cyclic_shift_identity_at_zero():
     np.testing.assert_array_equal(op("cyclic_shift_left")(a, 0), a)
     np.testing.assert_array_equal(op("cyclic_shift_left")(a, 32), a)
     np.testing.assert_array_equal(op("cyclic_shift_left")(a, 1), [10, 18])
+
+
+def test_ctc_loss_empty_targets():
+    """S == 0 (all-blank targets): loss is -sum of blank log-probs over the
+    input length (code-review r2)."""
+    B, T, C = 2, 5, 4
+    lp = jax.nn.log_softmax(
+        jnp.asarray(rng.standard_normal((B, T, C)).astype(np.float32)), -1)
+    out = np.asarray(OP_TABLE["ctc_loss"](
+        lp, jnp.zeros((B, 0), jnp.int32), jnp.asarray([5, 3]),
+        jnp.asarray([0, 0])))
+    ref0 = -np.asarray(lp)[0, :5, 0].sum()
+    ref1 = -np.asarray(lp)[1, :3, 0].sum()
+    np.testing.assert_allclose(out, [ref0, ref1], rtol=1e-5)
