@@ -601,6 +601,9 @@ register_op("resize_nearest", lambda a, size:
 register_op("resize_bilinear", lambda a, size:
             jax.image.resize(a, (a.shape[0],) + tuple(size)
                              + (a.shape[-1],), "bilinear"))
+register_op("image_resize", lambda a, size, method="bilinear":
+            jax.image.resize(a, (a.shape[0],) + tuple(size)
+                             + (a.shape[-1],), method))
 register_op("space_to_depth", lambda a, block_size=2:
             _space_to_depth(a, block_size))
 register_op("depth_to_space", lambda a, block_size=2:
@@ -664,28 +667,45 @@ def _depthwise_conv2d(x, w, stride=(1, 1), padding="SAME",
 # ---------------------------------------------------------------------------
 
 # ---- random (reference generic/random/**; rng is an explicit jax PRNG key,
-# the functional replacement for libnd4j's RandomGenerator state) ----
+# the functional replacement for libnd4j's RandomGenerator state).  A None
+# key falls back to a fixed seed — SameDiff feeds the per-iteration key only
+# during fit(), so inference-time output() still samples deterministically.
+def _key(rng):
+    return jax.random.PRNGKey(0) if rng is None else rng
+
+
+# key-folding helpers for graph engines feeding ONE per-step key to many
+# stochastic nodes: each node folds its own static tag so independent
+# random sites draw independent streams.
+register_op("rng_fold", lambda rng, tag=0: jax.random.fold_in(_key(rng),
+                                                              tag))
+# None-preserving variant for dropout-style ops where a missing key means
+# "inference — identity", which must survive the fold
+register_op("rng_fold_opt", lambda rng, tag=0:
+            None if rng is None else jax.random.fold_in(rng, tag))
+
+
 register_op("random_uniform", lambda rng, shape, minval=0.0, maxval=1.0,
             dtype="float32": jax.random.uniform(
-                rng, tuple(shape), jnp.dtype(dtype), minval, maxval))
+                _key(rng), tuple(shape), jnp.dtype(dtype), minval, maxval))
 register_op("random_normal", lambda rng, shape, mean=0.0, stddev=1.0,
             dtype="float32": mean + stddev * jax.random.normal(
-                rng, tuple(shape), jnp.dtype(dtype)))
+                _key(rng), tuple(shape), jnp.dtype(dtype)))
 register_op("random_bernoulli", lambda rng, shape, p=0.5:
-            jax.random.bernoulli(rng, p, tuple(shape)))
+            jax.random.bernoulli(_key(rng), p, tuple(shape)))
 register_op("random_exponential", lambda rng, shape, lam=1.0,
             dtype="float32": jax.random.exponential(
-                rng, tuple(shape), jnp.dtype(dtype)) / lam)
+                _key(rng), tuple(shape), jnp.dtype(dtype)) / lam)
 register_op("random_gamma", lambda rng, shape, alpha=1.0, beta=1.0,
             dtype="float32": jax.random.gamma(
-                rng, alpha, tuple(shape), jnp.dtype(dtype)) / beta)
+                _key(rng), alpha, tuple(shape), jnp.dtype(dtype)) / beta)
 register_op("random_poisson", lambda rng, shape, lam=1.0:
-            jax.random.poisson(rng, lam, tuple(shape)))
+            jax.random.poisson(_key(rng), lam, tuple(shape)))
 register_op("random_shuffle", lambda rng, a, axis=0:
-            jax.random.permutation(rng, a, axis=axis))
+            jax.random.permutation(_key(rng), a, axis=axis))
 register_op("multinomial", lambda rng, logits, num_samples:
             jnp.swapaxes(jax.random.categorical(
-                rng, logits, axis=-1,
+                _key(rng), logits, axis=-1,
                 shape=(num_samples,) + logits.shape[:-1]), 0, -1))
 register_op("dropout_inverted", lambda x, rng, p=0.5:
             jnp.where(jax.random.bernoulli(rng, 1.0 - p, x.shape),

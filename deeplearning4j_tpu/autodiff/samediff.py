@@ -152,8 +152,11 @@ class SDNN(_Namespace):
         args = (x, gain) if bias is None else (x, gain, bias)
         return self._sd.op("layer_norm", *args, eps=eps, name=name)
     def dropout(self, x, p=0.5, name=None):
-        """Active only during fit() (rng is fed by the train step)."""
-        return self._sd.op("dropout", x, self._sd._rng_var(), p=p, name=name)
+        """Active only during fit() (rng is fed by the train step); each
+        dropout site folds its own tag so masks are independent."""
+        site = self._sd.op("rng_fold_opt", self._sd._rng_var(),
+                           tag=self._sd._next_rng_tag())
+        return self._sd.op("dropout", x, site, p=p, name=name)
     def batch_norm(self, x, mean, var, gamma=None, beta=None, eps=1e-5,
                    name=None):
         args = [x, mean, var] + ([gamma] if gamma is not None else []) \
@@ -187,6 +190,93 @@ class SDRNN(_Namespace):
         SURVEY.md §7 hard part (d)); IFOG gate order, [B,T,F] in,
         [B,T,H] out."""
         return self._sd.op("lstm_layer", x, w, rw, b, name=name)
+
+
+class _TableNamespace(_Namespace):
+    """Generic OP_TABLE delegation scoped by a name list (the codegen'd
+    namespace classes collapse to a whitelist over the registry)."""
+
+    OPS: tuple = ()
+
+    def __getattr__(self, op):
+        if op.startswith("_") or (self.OPS and op not in self.OPS):
+            raise AttributeError(
+                f"{type(self).__name__} has no op '{op}'")
+        if op not in OP_TABLE:
+            raise AttributeError(
+                f"No op '{op}' registered (reference: unmapped op error in "
+                "ImportGraph — add via autodiff.ops.register_op)")
+
+        def call(*args, name=None, **attrs):
+            return self._sd.op(op, *args, name=name, **attrs)
+        return call
+
+
+class SDBitwise(_TableNamespace):
+    """Reference `SDBitwise` namespace."""
+    OPS = ("bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+           "shift_left", "shift_right", "cyclic_shift_left",
+           "bits_hamming_distance", "toggle_bits")
+
+
+class SDImage(_TableNamespace):
+    """Reference `SDImage` namespace."""
+    OPS = ("rgb_to_hsv", "hsv_to_rgb", "rgb_to_yiq", "yiq_to_rgb",
+           "rgb_to_yuv", "yuv_to_rgb", "rgb_to_grs", "adjust_hue",
+           "adjust_saturation", "adjust_contrast", "crop_and_resize",
+           "extract_image_patches", "non_max_suppression",
+           "resize_bilinear", "resize_nearest", "image_resize")
+
+
+class SDLinalg(_TableNamespace):
+    """Reference `SDLinalg` namespace."""
+    OPS = ("cholesky", "solve", "triangular_solve", "matrix_inverse",
+           "matrix_determinant", "log_matrix_determinant", "qr", "svd",
+           "eig_sym", "lstsq", "lu", "pinv", "expm", "matrix_band_part",
+           "matrix_diag", "matrix_diag_part", "matrix_set_diag", "mmul",
+           "matmul", "tri", "tril", "triu", "cross", "diag", "diag_part",
+           "trace", "einsum")
+
+
+class SDRandom(_Namespace):
+    """Reference `SDRandom` namespace; the PRNG key is the train step's
+    per-iteration rng feed (same mechanism as dropout), so samples change
+    every fit() step and are deterministic per (seed, iteration).  Each
+    random node folds a unique tag into the shared per-step key so
+    independent sample sites draw independent streams."""
+
+    _OPS = ("random_uniform", "random_normal", "random_bernoulli",
+            "random_exponential", "random_gamma", "random_poisson",
+            "random_shuffle", "multinomial")
+
+    def _site_key(self):
+        return self._sd.op("rng_fold", self._sd._rng_var(),
+                           tag=self._sd._next_rng_tag())
+
+    def __getattr__(self, op):
+        if op.startswith("_") or op not in self._OPS:
+            raise AttributeError(f"SDRandom has no op '{op}'")
+
+        def call(*args, name=None, **attrs):
+            return self._sd.op(op, self._site_key(), *args, name=name,
+                               **attrs)
+        return call
+
+    # reference-style aliases (shape/params ride as attrs: the executor
+    # calls OP_TABLE[op](*inputs, **attrs))
+    def uniform(self, low, high, shape, name=None):
+        return self._sd.op("random_uniform", self._site_key(),
+                           shape=tuple(shape), minval=low, maxval=high,
+                           name=name)
+
+    def normal(self, mean, stddev, shape, name=None):
+        return self._sd.op("random_normal", self._site_key(),
+                           shape=tuple(shape), mean=mean, stddev=stddev,
+                           name=name)
+
+    def bernoulli(self, p, shape, name=None):
+        return self._sd.op("random_bernoulli", self._site_key(),
+                           shape=tuple(shape), p=p, name=name)
 
 
 class SDLoss(_Namespace):
@@ -415,6 +505,10 @@ class SameDiff:
         self.cnn = SDCNN(self)
         self.rnn = SDRNN(self)
         self.loss = SDLoss(self)
+        self.bitwise = SDBitwise(self)
+        self.image = SDImage(self)
+        self.linalg = SDLinalg(self)
+        self.random = SDRandom(self)
 
     @staticmethod
     def create() -> "SameDiff":
@@ -526,6 +620,13 @@ class SameDiff:
         if RNG_FEED not in self._nodes:
             self._add(Node(RNG_FEED, "placeholder", dtype="uint32"))
         return SDVariable(self, RNG_FEED)
+
+    def _next_rng_tag(self) -> int:
+        """Unique static tag per stochastic node; folded into the shared
+        per-step key so sample sites draw independent streams."""
+        tag = getattr(self, "_rng_tag", 0)
+        self._rng_tag = tag + 1
+        return tag
 
     # ---- control flow (reference Switch/Merge/Enter/Exit → lax) ----
     def _split_outputs(self, v: SDVariable, n_out: int):

@@ -24,9 +24,10 @@ import numpy as np
 
 from deeplearning4j_tpu.nn import (
     ActivationLayer, BatchNormalizationLayer, ComputationGraph,
-    ConvolutionLayer, DenseLayer, DepthwiseConvolution2DLayer, DropoutLayer,
-    ElementWiseVertex, EmbeddingSequenceLayer, GlobalPoolingLayer,
-    GraphBuilder, InputType, LastTimeStep, Layer, LSTM, MergeVertex,
+    Convolution1DLayer, ConvolutionLayer, Deconvolution2DLayer, DenseLayer,
+    DepthwiseConvolution2DLayer, DropoutLayer, ElementWiseVertex,
+    EmbeddingSequenceLayer, GlobalPoolingLayer, GraphBuilder, InputType,
+    LastTimeStep, Layer, LayerNormalizationLayer, LSTM, MergeVertex,
     MultiLayerNetwork, NeuralNetConfiguration, OutputLayer,
     SeparableConvolution2DLayer, SimpleRnn, SubsamplingLayer,
     Upsampling2DLayer, ZeroPaddingLayer)
@@ -159,22 +160,155 @@ def _skip(cfg, is_output):
     return None     # structural no-op (Flatten: Dense auto-flattens)
 
 
+def _conv1d(cfg, is_output):
+    if cfg.get("padding") == "causal":
+        raise UnsupportedKerasConfigurationException(
+            "Conv1D padding='causal' not supported — left-pad the input "
+            "explicitly and use padding='valid'")
+    return Convolution1DLayer(
+        n_out=cfg["filters"], kernel_size=int(_pair(cfg["kernel_size"])[0]),
+        stride=int(_pair(cfg.get("strides", 1))[0]),
+        convolution_mode=_conv_mode(cfg.get("padding", "valid")),
+        dilation=int(_pair(cfg.get("dilation_rate", 1))[0]),
+        activation=_act(cfg.get("activation")),
+        has_bias=cfg.get("use_bias", True))
+
+
+def _conv2d_transpose(cfg, is_output):
+    if _pair(cfg.get("dilation_rate", 1)) != (1, 1):
+        raise UnsupportedKerasConfigurationException(
+            "Conv2DTranspose dilation_rate != 1 not supported")
+    return Deconvolution2DLayer(
+        n_out=cfg["filters"], kernel_size=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)),
+        convolution_mode=_conv_mode(cfg.get("padding", "valid")),
+        activation=_act(cfg.get("activation")),
+        has_bias=cfg.get("use_bias", True))
+
+
+def _conv3d(cfg, is_output):
+    from deeplearning4j_tpu.nn import Convolution3DLayer
+    ks = cfg["kernel_size"]
+    ks = (ks,) * 3 if isinstance(ks, int) else tuple(ks)
+    st = cfg.get("strides", 1)
+    st = (st,) * 3 if isinstance(st, int) else tuple(st)
+    dl = cfg.get("dilation_rate", 1)
+    dl = (dl,) * 3 if isinstance(dl, int) else tuple(dl)
+    return Convolution3DLayer(
+        n_out=cfg["filters"], kernel_size=ks, stride=st, dilation=dl,
+        convolution_mode=_conv_mode(cfg.get("padding", "valid")),
+        activation=_act(cfg.get("activation")),
+        has_bias=cfg.get("use_bias", True))
+
+
+def _pool1d(kind):
+    def conv(cfg, is_output):
+        from deeplearning4j_tpu.nn import Subsampling1DLayer
+        ps = cfg.get("pool_size", 2)
+        ps = ps[0] if isinstance(ps, (list, tuple)) else ps
+        st = cfg.get("strides") or ps
+        st = st[0] if isinstance(st, (list, tuple)) else st
+        return Subsampling1DLayer(
+            pooling_type=kind, kernel_size=int(ps), stride=int(st),
+            convolution_mode=_conv_mode(cfg.get("padding", "valid")))
+    return conv
+
+
+def _pool3d(kind):
+    def conv(cfg, is_output):
+        from deeplearning4j_tpu.nn import Subsampling3DLayer
+        ps = cfg.get("pool_size", 2)
+        ps = (ps,) * 3 if isinstance(ps, int) else tuple(ps)
+        st = cfg.get("strides") or ps
+        st = (st,) * 3 if isinstance(st, int) else tuple(st)
+        return Subsampling3DLayer(
+            pooling_type=kind, kernel_size=ps, stride=st,
+            convolution_mode=_conv_mode(cfg.get("padding", "valid")))
+    return conv
+
+
+def _cropping2d(cfg, is_output):
+    from deeplearning4j_tpu.nn import Cropping2DLayer
+    c = cfg.get("cropping", 0)
+    if isinstance(c, int):
+        crops = (c, c, c, c)
+    elif isinstance(c[0], (list, tuple)):
+        crops = (c[0][0], c[0][1], c[1][0], c[1][1])
+    else:
+        crops = (c[0], c[0], c[1], c[1])
+    return Cropping2DLayer(cropping=crops)
+
+
+def _leaky_relu(cfg, is_output):
+    import jax
+    # keras default alpha 0.3 (Keras 3 names it negative_slope)
+    alpha = cfg.get("alpha", cfg.get("negative_slope", 0.3))
+    return ActivationLayer(
+        activation=lambda x: jax.nn.leaky_relu(x, alpha))
+
+
+def _elu_layer(cfg, is_output):
+    import jax
+    alpha = cfg.get("alpha", 1.0)
+    return ActivationLayer(activation=lambda x: jax.nn.elu(x, alpha))
+
+
+def _prelu(cfg, is_output):
+    from deeplearning4j_tpu.nn import PReLULayer
+    shared = cfg.get("shared_axes")
+    return PReLULayer(shared_axes=None if not shared else tuple(shared))
+
+
+def _layer_norm_keras(cfg, is_output):
+    axis = cfg.get("axis", -1)
+    if isinstance(axis, (list, tuple)) and len(axis) == 1:
+        axis = axis[0]
+    # only the last axis is equivalent to our feature-axis LayerNorm; a
+    # positive axis index can't be validated without the input rank, so
+    # reject anything but -1 rather than silently normalizing differently
+    if axis != -1:
+        raise UnsupportedKerasConfigurationException(
+            f"LayerNormalization over axis {axis} unsupported (axis=-1 "
+            "only)")
+    return LayerNormalizationLayer(eps=cfg.get("epsilon", 1e-3))
+
+
 LAYER_MAP: Dict[str, Callable] = {
     "Dense": _dense,
+    "Conv1D": _conv1d,
     "Conv2D": _conv2d,
+    "Conv2DTranspose": _conv2d_transpose,
+    "Conv3D": _conv3d,
     "SeparableConv2D": _sepconv2d,
     "DepthwiseConv2D": _depthconv2d,
+    "MaxPooling1D": _pool1d("MAX"),
+    "AveragePooling1D": _pool1d("AVG"),
     "MaxPooling2D": _pool("MAX"),
     "AveragePooling2D": _pool("AVG"),
+    "MaxPooling3D": _pool3d("MAX"),
+    "AveragePooling3D": _pool3d("AVG"),
+    "GlobalAveragePooling1D": _global_pool("AVG"),
+    "GlobalMaxPooling1D": _global_pool("MAX"),
     "GlobalAveragePooling2D": _global_pool("AVG"),
     "GlobalMaxPooling2D": _global_pool("MAX"),
     "BatchNormalization": _bn,
+    "LayerNormalization": _layer_norm_keras,
     "Dropout": _dropout,
+    # spatial dropouts approximate as elementwise dropout: identical at
+    # inference; training drops elements rather than whole channels
+    "SpatialDropout1D": _dropout,
+    "SpatialDropout2D": _dropout,
+    "GaussianNoise": _skip,         # inference no-op
+    "GaussianDropout": _skip,       # inference no-op
     "Activation": _activation,
+    "LeakyReLU": _leaky_relu,
+    "ELU": _elu_layer,
+    "PReLU": _prelu,
     "Embedding": _embedding,
     "LSTM": _lstm,
     "SimpleRNN": _simplernn,
     "ZeroPadding2D": _zeropad,
+    "Cropping2D": _cropping2d,
     "UpSampling2D": _upsample,
     "Flatten": _skip,
     "InputLayer": _skip,
@@ -239,6 +373,16 @@ def _set_weights(net, name: str, layer: Layer, w: Dict[str, np.ndarray]):
         params["W"] = w["depthwise_kernel"]
         if "bias" in w:
             params["b"] = w["bias"]
+    elif isinstance(inner, Deconvolution2DLayer):
+        # keras Conv2DTranspose kernels are (kh, kw, out, in) — ours HWIO
+        params["W"] = np.swapaxes(w["kernel"], 2, 3)
+        if "bias" in w:
+            params["b"] = w["bias"]
+    elif isinstance(inner, LayerNormalizationLayer):
+        params["gamma"] = w["gamma"]
+        params["beta"] = w["beta"]
+    elif "alpha" in params and "alpha" in w:               # PReLU
+        params["alpha"] = np.asarray(w["alpha"])
     elif "kernel" in w or "embeddings" in w:
         params["W"] = w.get("kernel", w.get("embeddings"))
         if "bias" in w:
@@ -273,6 +417,9 @@ def _input_type(layers_cfg: List[dict]) -> InputType:
         raise UnsupportedKerasConfigurationException(
             "No input shape found (batch_input_shape/batch_shape)")
     shape = [s for s in shape]
+    if len(shape) == 4:
+        return InputType.convolutional3d(shape[0], shape[1], shape[2],
+                                         shape[3])
     if len(shape) == 3:
         return InputType.convolutional(shape[0], shape[1], shape[2])
     if len(shape) == 2:

@@ -361,3 +361,60 @@ def test_tf_depthwise_conv_import():
     out = gd.node[-1].name
     got = np.asarray(sd.output({"x": x}, out)[out])
     np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_keras_extended_layer_converters(tmp_path):
+    """Round-2 converter breadth: Conv2DTranspose, Cropping2D, LeakyReLU,
+    PReLU, LayerNormalization, pooling variants — import -> predict matches
+    TF."""
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((10, 10, 3)),
+        tf.keras.layers.Conv2D(6, 3, padding="same"),
+        tf.keras.layers.LeakyReLU(),
+        tf.keras.layers.Conv2DTranspose(4, 2, strides=2, padding="same"),
+        tf.keras.layers.PReLU(shared_axes=[1, 2]),
+        tf.keras.layers.Cropping2D(((2, 2), (2, 2))),
+        tf.keras.layers.AveragePooling2D(2),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(8),
+        tf.keras.layers.LayerNormalization(),
+        tf.keras.layers.ELU(),
+        tf.keras.layers.Dense(3, activation="softmax")])
+    # non-trivial weights everywhere
+    rs = np.random.RandomState(0)
+    for v in km.weights:
+        v.assign(rs.randn(*v.shape).astype(np.float32) * 0.3)
+    p = _save(km, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    x = rs.rand(4, 10, 10, 3).astype(np.float32)
+    expected = km.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_keras_1d_and_3d_converters(tmp_path):
+    km1 = tf.keras.Sequential([
+        tf.keras.layers.Input((16, 4)),
+        tf.keras.layers.Conv1D(8, 3, padding="same", activation="relu"),
+        tf.keras.layers.MaxPooling1D(2),
+        tf.keras.layers.GlobalAveragePooling1D(),
+        tf.keras.layers.Dense(3, activation="softmax")])
+    p1 = _save(km1, tmp_path, "m1d.h5")
+    net1 = KerasModelImport.import_keras_sequential_model_and_weights(p1)
+    x1 = np.random.RandomState(0).rand(3, 16, 4).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net1.output(x1)),
+                               km1.predict(x1, verbose=0),
+                               rtol=1e-4, atol=1e-5)
+
+    km3 = tf.keras.Sequential([
+        tf.keras.layers.Input((6, 6, 6, 2)),
+        tf.keras.layers.Conv3D(4, 2, padding="valid", activation="relu"),
+        tf.keras.layers.MaxPooling3D(2),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(2, activation="softmax")])
+    p3 = _save(km3, tmp_path, "m3d.h5")
+    net3 = KerasModelImport.import_keras_sequential_model_and_weights(p3)
+    x3 = np.random.RandomState(1).rand(2, 6, 6, 6, 2).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net3.output(x3)),
+                               km3.predict(x3, verbose=0),
+                               rtol=1e-4, atol=1e-5)

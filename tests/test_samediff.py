@@ -420,3 +420,43 @@ def test_samediff_evaluate_iterator():
     ev = sd.evaluate(It(), "out")
     assert ev.accuracy() > 0.85
     assert ev.confusion.sum() == 60
+
+
+def test_extended_namespaces():
+    """SDBitwise/SDImage/SDLinalg/SDRandom (reference codegen'd namespace
+    classes over the declarable registry)."""
+    sd = SameDiff.create()
+    a = sd.constant("a", np.array([0b1100, 0b1010], np.int32))
+    b = sd.constant("b", np.array([0b1010, 0b0110], np.int32))
+    x = sd.var("x", np.random.RandomState(0).rand(1, 4, 4, 3)
+               .astype(np.float32))
+    spd = np.array([[4.0, 1.0], [1.0, 3.0]], np.float32)
+    s = sd.var("s", spd)
+
+    v_and = sd.bitwise.bitwise_and(a, b)
+    v_img = sd.image.rgb_to_hsv(x)
+    v_chol = sd.linalg.cholesky(s)
+    v_rand = sd.random.uniform(2.0, 5.0, (8,))
+    outs = sd.output({}, v_and, v_img, v_chol, v_rand)
+    np.testing.assert_array_equal(outs[v_and.name], [0b1000, 0b0010])
+    assert outs[v_img.name].shape == (1, 4, 4, 3)
+    L = np.asarray(outs[v_chol.name])
+    np.testing.assert_allclose(L @ L.T, spd, rtol=1e-4)
+    r = np.asarray(outs[v_rand.name])
+    assert r.shape == (8,) and (r >= 2.0).all() and (r < 5.0).all()
+
+    # namespaces are scoped: math ops don't leak into bitwise
+    import pytest as _pytest
+    with _pytest.raises(AttributeError):
+        sd.bitwise.cholesky
+
+
+def test_random_sites_draw_independent_streams():
+    """Two random nodes sharing the per-step key must not produce identical
+    samples (code-review r2: per-site key folding)."""
+    sd = SameDiff.create()
+    a = sd.random.normal(0.0, 1.0, (8,))
+    b = sd.random.normal(0.0, 1.0, (8,))
+    outs = sd.output({}, a, b)
+    va, vb = np.asarray(outs[a.name]), np.asarray(outs[b.name])
+    assert not np.allclose(va, vb)
