@@ -1256,6 +1256,160 @@ def _kl_divergence(labels, preds, eps=1e-12):
     return jnp.sum(p * (jnp.log(p) - jnp.log(q)), axis=-1).mean()
 
 
+# ---- signal / FFT (reference generic/fft/** + helpers) ----
+register_op("fft", lambda a, axis=-1: jnp.fft.fft(a, axis=axis))
+register_op("ifft", lambda a, axis=-1: jnp.fft.ifft(a, axis=axis))
+register_op("rfft", lambda a, axis=-1: jnp.fft.rfft(a, axis=axis))
+register_op("irfft", lambda a, n=None, axis=-1:
+            jnp.fft.irfft(a, n=n, axis=axis))
+register_op("fft2", lambda a: jnp.fft.fft2(a))
+register_op("ifft2", lambda a: jnp.fft.ifft2(a))
+
+
+# ---- image transforms (reference generic/images/** continued) ----
+register_op("image_flip_left_right", lambda a: jnp.flip(a, axis=-2))
+register_op("image_flip_up_down", lambda a: jnp.flip(a, axis=-3))
+register_op("image_rot90", lambda a, k=1:
+            jnp.rot90(a, k, axes=(-3, -2)))
+
+
+@register_op("per_image_standardization")
+def _per_image_standardization(x):
+    axes = tuple(range(1, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    n = 1
+    for d in x.shape[1:]:
+        n *= d
+    std = jnp.maximum(jnp.std(x, axis=axes, keepdims=True),
+                      1.0 / jnp.sqrt(float(n)))
+    return (x - mean) / std
+
+
+@register_op("image_central_crop")
+def _image_central_crop(x, fraction):
+    h, w = x.shape[-3], x.shape[-2]
+    ch = int(h * fraction)
+    cw = int(w * fraction)
+    t = (h - ch) // 2
+    l = (w - cw) // 2
+    return x[..., t:t + ch, l:l + cw, :]
+
+
+@register_op("random_crop")
+def _random_crop(rng, x, size):
+    """Crop `size` (per-axis) at a random offset (reference
+    generic/random/random_crop.cpp)."""
+    k = _key(rng)
+    starts = []
+    for i, (d, s) in enumerate(zip(x.shape, size)):
+        sub = jax.random.fold_in(k, i)
+        starts.append(jax.random.randint(sub, (), 0, d - s + 1))
+    return lax.dynamic_slice(x, starts, tuple(size))
+
+
+# ---- bit manipulation (reference transforms/bitcast + compat) ----
+register_op("bitcast", lambda a, dtype:
+            lax.bitcast_convert_type(a, jnp.dtype(dtype)))
+register_op("population_count", lambda a: lax.population_count(a))
+
+
+# ---- set / search ops (static-size contracts under jit, like `unique`) ----
+@register_op("unique_with_counts")
+def _unique_with_counts(a, size=None):
+    if size is None:
+        raise ValueError("unique_with_counts needs static `size` under jit")
+    vals, counts = jnp.unique(a, size=size, return_counts=True)
+    return vals, counts
+
+
+@register_op("setdiff1d")
+def _setdiff1d(a, b, size=None):
+    """Elements of `a` not in `b` (TF ListDiff), padded to `size` with the
+    first kept element (size should be the true difference count)."""
+    if size is None:
+        raise ValueError("setdiff1d needs static `size` under jit")
+    keep = ~jnp.isin(a, b)
+    first_kept = jnp.argmax(keep)        # index of the first True
+    idx = jnp.nonzero(keep, size=size, fill_value=first_kept)[0]
+    return a[idx]
+
+
+@register_op("nonzero")
+def _nonzero(a, size=None):
+    if size is None:
+        raise ValueError("nonzero needs static `size` under jit")
+    return jnp.stack(jnp.nonzero(a, size=size), axis=-1)
+
+
+register_op("isin", lambda a, b: jnp.isin(a, b))
+register_op("equals_with_eps", lambda a, b, eps=1e-5:
+            jnp.all(jnp.abs(a - b) <= eps))
+register_op("isclose", lambda a, b, rtol=1e-5, atol=1e-8:
+            jnp.isclose(a, b, rtol, atol))
+register_op("is_finite", jnp.isfinite)
+register_op("is_finite_all", lambda a: jnp.all(jnp.isfinite(a)))
+
+
+# ---- scatter/segment completions ----
+register_op("scatter_nd_min", lambda a, idx, updates:
+            a.at[tuple(jnp.moveaxis(idx, -1, 0))].min(updates))
+register_op("scatter_nd_max", lambda a, idx, updates:
+            a.at[tuple(jnp.moveaxis(idx, -1, 0))].max(updates))
+register_op("segment_prod", lambda data, ids, num_segments:
+            jax.ops.segment_prod(data, ids, num_segments,
+                                 indices_are_sorted=True))
+
+
+# ---- shape / layout completions ----
+register_op("unstack", lambda a, axis=0: tuple(
+    jnp.squeeze(s, axis=axis)
+    for s in jnp.split(a, a.shape[axis], axis=axis)))
+register_op("size_of", lambda a: jnp.asarray(a.size, jnp.int32))
+register_op("rank_of", lambda a: jnp.asarray(a.ndim, jnp.int32))
+register_op("eye_like", lambda a: jnp.eye(a.shape[-2], a.shape[-1],
+                                          dtype=a.dtype))
+register_op("fill_like", lambda a, value: jnp.full_like(a, value))
+register_op("swap_axes", lambda a, axis1, axis2:
+            jnp.swapaxes(a, axis1, axis2))
+register_op("moveaxis", lambda a, source, destination:
+            jnp.moveaxis(a, source, destination))
+register_op("atleast_2d", jnp.atleast_2d)
+register_op("ravel", jnp.ravel)
+
+
+@register_op("pad_mode")
+def _pad_mode(a, paddings, mode="constant", value=0.0):
+    """Generalized pad (constant/reflect/symmetric/edge — the reference's
+    pad op mode attr)."""
+    pads = tuple(tuple(p) for p in paddings)
+    if mode == "constant":
+        return jnp.pad(a, pads, constant_values=value)
+    return jnp.pad(a, pads, mode=mode)
+
+
+@register_op("cumsum_ext")
+def _cumsum_ext(a, axis=0, exclusive=False, reverse=False):
+    """TF-style cumsum with exclusive/reverse attrs (the reference cumsum
+    declarable op's full contract)."""
+    if reverse:
+        a = jnp.flip(a, axis=axis)
+    out = jnp.cumsum(a, axis=axis)
+    if exclusive:
+        out = out - a
+    if reverse:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+# ---- linalg completions ----
+register_op("slogdet", lambda a: jnp.linalg.slogdet(a))
+register_op("matrix_rank", lambda a: jnp.linalg.matrix_rank(a))
+register_op("batched_matmul", lambda a, b: jnp.matmul(a, b))
+register_op("truncate_div", lambda a, b:
+            jnp.trunc(a / b).astype(jnp.promote_types(a.dtype, b.dtype)))
+register_op("remainder", jnp.remainder)
+
+
 @register_op("ctc_loss")
 def _ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0):
     """CTC negative log-likelihood via the standard log-space alpha

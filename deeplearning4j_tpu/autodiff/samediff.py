@@ -623,8 +623,15 @@ class SameDiff:
 
     def _next_rng_tag(self) -> int:
         """Unique static tag per stochastic node; folded into the shared
-        per-step key so sample sites draw independent streams."""
-        tag = getattr(self, "_rng_tag", 0)
+        per-step key so sample sites draw independent streams.  Seeded from
+        the tags already present in the graph so nodes added after a
+        save()/load() round-trip never reuse an existing tag."""
+        tag = getattr(self, "_rng_tag", None)
+        if tag is None:
+            tag = 1 + max(
+                (int(n.attrs.get("tag", -1)) for n in self._nodes.values()
+                 if n.kind == "op" and n.op in ("rng_fold", "rng_fold_opt")),
+                default=-1)
         self._rng_tag = tag + 1
         return tag
 

@@ -240,17 +240,17 @@ def _cropping2d(cfg, is_output):
 
 
 def _leaky_relu(cfg, is_output):
-    import jax
-    # keras default alpha 0.3 (Keras 3 names it negative_slope)
+    # keras default alpha 0.3 (Keras 3 names it negative_slope); named
+    # activation + args keeps the imported config JSON-serializable
     alpha = cfg.get("alpha", cfg.get("negative_slope", 0.3))
-    return ActivationLayer(
-        activation=lambda x: jax.nn.leaky_relu(x, alpha))
+    return ActivationLayer(activation="leakyrelu",
+                           activation_args={"alpha": float(alpha)})
 
 
 def _elu_layer(cfg, is_output):
-    import jax
-    alpha = cfg.get("alpha", 1.0)
-    return ActivationLayer(activation=lambda x: jax.nn.elu(x, alpha))
+    return ActivationLayer(activation="elu",
+                           activation_args={"alpha":
+                                            float(cfg.get("alpha", 1.0))})
 
 
 def _prelu(cfg, is_output):
@@ -379,8 +379,12 @@ def _set_weights(net, name: str, layer: Layer, w: Dict[str, np.ndarray]):
         if "bias" in w:
             params["b"] = w["bias"]
     elif isinstance(inner, LayerNormalizationLayer):
-        params["gamma"] = w["gamma"]
-        params["beta"] = w["beta"]
+        # keras scale=False / center=False drop gamma / beta from the
+        # weights; the initialized ones/zeros are exactly those semantics
+        if "gamma" in w:
+            params["gamma"] = w["gamma"]
+        if "beta" in w:
+            params["beta"] = w["beta"]
     elif "alpha" in params and "alpha" in w:               # PReLU
         params["alpha"] = np.asarray(w["alpha"])
     elif "kernel" in w or "embeddings" in w:

@@ -16,7 +16,7 @@ batchnorm plays in the reference's platform helpers,
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional, Sequence, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -119,15 +119,23 @@ class LossLayer(Layer):
 
 @dataclasses.dataclass(kw_only=True)
 class ActivationLayer(Layer):
-    """Standalone activation (reference `ActivationLayer`)."""
+    """Standalone activation (reference `ActivationLayer`).
 
+    `activation_args` parameterizes named activations (e.g. leakyrelu's
+    alpha) while keeping the config JSON-serializable — the IActivation-
+    with-hyperparameters case that a bare name can't carry."""
+
+    activation_args: Optional[Dict[str, Any]] = None
     REGULARIZABLE: Tuple[str, ...] = ()
 
     def initialize(self, rng, input_type, dtype=jnp.float32):
         return {}, {}, input_type
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
-        return self.act_fn()(x), state
+        fn = self.act_fn()
+        if self.activation_args:
+            return fn(x, **self.activation_args), state
+        return fn(x), state
 
 
 @dataclasses.dataclass(kw_only=True)

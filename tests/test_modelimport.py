@@ -418,3 +418,29 @@ def test_keras_1d_and_3d_converters(tmp_path):
     np.testing.assert_allclose(np.asarray(net3.output(x3)),
                                km3.predict(x3, verbose=0),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_keras_layernorm_flags_and_param_activations(tmp_path):
+    """scale=False LayerNormalization imports (gamma stays 1); LeakyReLU
+    alpha survives config JSON round-trip (code-review r2)."""
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((6,)),
+        tf.keras.layers.Dense(5),
+        tf.keras.layers.LayerNormalization(scale=False),
+        tf.keras.layers.LeakyReLU(),
+        tf.keras.layers.Dense(2, activation="softmax")])
+    p = _save(km, tmp_path, "ln_flags.h5")
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               km.predict(x, verbose=0),
+                               rtol=1e-4, atol=1e-5)
+    # imported config (incl. parameterized LeakyReLU) round-trips via JSON
+    from deeplearning4j_tpu.nn import (MultiLayerConfiguration,
+                                       MultiLayerNetwork)
+    conf2 = MultiLayerConfiguration.from_json(net.conf.to_json())
+    net2 = MultiLayerNetwork(conf2).init()
+    net2.set_params(net.params())
+    np.testing.assert_allclose(np.asarray(net2.output(x)),
+                               km.predict(x, verbose=0),
+                               rtol=1e-4, atol=1e-5)

@@ -460,3 +460,16 @@ def test_random_sites_draw_independent_streams():
     outs = sd.output({}, a, b)
     va, vb = np.asarray(outs[a.name]), np.asarray(outs[b.name])
     assert not np.allclose(va, vb)
+
+
+def test_rng_tags_survive_save_load():
+    """Stochastic nodes added after load() must not reuse existing tags
+    (code-review r2)."""
+    sd = SameDiff.create()
+    a = sd.random.normal(0.0, 1.0, (8,))
+    sd.save("/tmp/_rng_tags.zip")
+    sd2 = SameDiff.load("/tmp/_rng_tags.zip")
+    b = sd2.random.normal(0.0, 1.0, (8,))
+    outs = sd2.output({}, a.name, b.name)
+    assert not np.allclose(np.asarray(outs[a.name]),
+                           np.asarray(outs[b.name]))
