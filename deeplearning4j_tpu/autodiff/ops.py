@@ -1287,12 +1287,12 @@ def _per_image_standardization(x):
 
 @register_op("image_central_crop")
 def _image_central_crop(x, fraction):
+    """TF central_crop semantics: the OFFSET is floored first and the
+    remainder pixel is kept (h=5, fraction=0.5 → offset 1, size 3)."""
     h, w = x.shape[-3], x.shape[-2]
-    ch = int(h * fraction)
-    cw = int(w * fraction)
-    t = (h - ch) // 2
-    l = (w - cw) // 2
-    return x[..., t:t + ch, l:l + cw, :]
+    t = int((h - h * fraction) / 2)
+    l = int((w - w * fraction) / 2)
+    return x[..., t:h - t, l:w - l, :]
 
 
 @register_op("random_crop")
@@ -1356,8 +1356,7 @@ register_op("scatter_nd_min", lambda a, idx, updates:
 register_op("scatter_nd_max", lambda a, idx, updates:
             a.at[tuple(jnp.moveaxis(idx, -1, 0))].max(updates))
 register_op("segment_prod", lambda data, ids, num_segments:
-            jax.ops.segment_prod(data, ids, num_segments,
-                                 indices_are_sorted=True))
+            jax.ops.segment_prod(data, ids, num_segments))
 
 
 # ---- shape / layout completions ----

@@ -6,8 +6,11 @@ TPU-native inversion: the reference trains with custom multi-threaded Java
 workers doing per-pair hierarchical-softmax/negative-sampling updates; here
 pair generation is host-side numpy and the update is ONE jitted step over a
 batch of (center, context, negatives) — an embedding-gather + dot + sigmoid
-kernel XLA fuses; negative sampling only (hierarchical softmax's per-word
-Huffman paths are interpreter-shaped, not accelerator-shaped).
+kernel XLA fuses.  Hierarchical softmax is supported in the same shape:
+the Huffman paths are precomputed host-side into padded [V, L] code/point
+matrices, so the per-pair "walk the tree" of the reference becomes one
+masked gather + sigmoid reduction per batch — accelerator-shaped after
+all.
 """
 from __future__ import annotations
 
@@ -43,7 +46,7 @@ class Word2Vec(WordVectorsMixin):
     def __init__(self, layer_size=100, window_size=5, min_word_frequency=5,
                  negative_sample=5, learning_rate=0.025, epochs=1,
                  batch_size=1024, seed=42, elements_algo="skipgram",
-                 subsample=0.0):
+                 subsample=0.0, use_hierarchic_softmax=False):
         # subsample=0 is the reference default (`sampling(0)`); enable
         # (e.g. 1e-3) only for large corpora — it decimates toy ones.
         self.layer_size = layer_size
@@ -56,6 +59,9 @@ class Word2Vec(WordVectorsMixin):
         self.seed = seed
         self.elements_algo = elements_algo  # "skipgram" | "cbow"
         self.subsample = subsample
+        # reference `useHierarchicSoftmax(true)`: Huffman-tree output layer
+        # instead of negative sampling
+        self.use_hs = use_hierarchic_softmax
         self.vocab: Dict[str, int] = {}
         self.inv_vocab: Dict[int, str] = {}
         self.counts: Optional[np.ndarray] = None
@@ -84,6 +90,71 @@ class Word2Vec(WordVectorsMixin):
         table)."""
         p = self.counts ** 0.75
         return p / p.sum()
+
+    # ---- Huffman coding (reference models/word2vec/Huffman.java) ----
+    def _build_huffman(self):
+        """Binary Huffman tree over word counts → per-word (codes, points)
+        padded to the max path length: CODES/POINTS/PMASK are [V, L], so
+        the hierarchical-softmax walk is a batched masked gather."""
+        import heapq
+        V = len(self.vocab)
+        heap = [(float(self.counts[i]), i) for i in range(V)]
+        heapq.heapify(heap)
+        parent = {}
+        side = {}
+        nxt = V                      # inner nodes numbered V .. 2V-2
+        while len(heap) > 1:
+            c1, n1 = heapq.heappop(heap)
+            c2, n2 = heapq.heappop(heap)
+            parent[n1], side[n1] = nxt, 0
+            parent[n2], side[n2] = nxt, 1
+            heapq.heappush(heap, (c1 + c2, nxt))
+            nxt += 1
+        root = heap[0][1] if heap else None
+        codes, points = [], []
+        for w in range(V):
+            c, p, node = [], [], w
+            while node != root:
+                c.append(side[node])
+                p.append(parent[node] - V)   # inner-node index 0..V-2
+                node = parent[node]
+            codes.append(c[::-1])
+            points.append(p[::-1])
+        L = max((len(c) for c in codes), default=1) or 1
+        CODES = np.zeros((V, L), np.float32)
+        POINTS = np.zeros((V, L), np.int32)
+        PMASK = np.zeros((V, L), np.float32)
+        for w in range(V):
+            n = len(codes[w])
+            CODES[w, :n] = codes[w]
+            POINTS[w, :n] = points[w]
+            PMASK[w, :n] = 1.0
+        return CODES, POINTS, PMASK
+
+    def _make_hs_step(self, CODES, POINTS, PMASK):
+        """Skip-gram + hierarchical softmax: for each path node j of the
+        context word, maximize log σ((1-2·code_j)·v_center·u_{point_j})."""
+        lr = self.learning_rate
+        C = jnp.asarray(CODES)
+        P = jnp.asarray(POINTS)
+        M = jnp.asarray(PMASK)
+
+        def step(syn0, syn1, center, context):
+            def loss_fn(params):
+                s0, s1 = params
+                v = s0[center]                     # [B, D]
+                pts = P[context]                   # [B, L]
+                sgn = 1.0 - 2.0 * C[context]       # [B, L]
+                msk = M[context]
+                u = s1[pts]                        # [B, L, D]
+                dots = jnp.einsum("bd,bld->bl", v, u)
+                return -jnp.sum(jax.nn.log_sigmoid(sgn * dots) * msk)
+
+            loss, grads = jax.value_and_grad(loss_fn)((syn0, syn1))
+            g0, g1 = grads
+            return syn0 - lr * g0, syn1 - lr * g1, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
 
     # ---- pair generation (host-side ETL) ----
     def _sent_ids(self, corpus, rng):
@@ -193,9 +264,19 @@ class Word2Vec(WordVectorsMixin):
         rng = np.random.RandomState(self.seed)
         V, D = len(self.vocab), self.layer_size
         syn0 = jnp.asarray((rng.rand(V, D) - 0.5) / D, jnp.float32)
-        syn1 = jnp.zeros((V, D), jnp.float32)
         cbow = self.elements_algo == "cbow"
-        step = self._make_cbow_step() if cbow else self._make_step()
+        if self.use_hs:
+            if cbow:
+                raise ValueError(
+                    "hierarchical softmax is implemented for skip-gram "
+                    "(reference default pairing); use negative sampling "
+                    "with CBOW")
+            CODES, POINTS, PMASK = self._build_huffman()
+            syn1 = jnp.zeros((max(V - 1, 1), D), jnp.float32)
+            step = self._make_hs_step(CODES, POINTS, PMASK)
+        else:
+            syn1 = jnp.zeros((V, D), jnp.float32)
+            step = self._make_cbow_step() if cbow else self._make_step()
         neg_p = self._neg_table()
         bs = self.batch_size
         for _ in range(self.epochs):
@@ -216,6 +297,10 @@ class Word2Vec(WordVectorsMixin):
             loss = None
             for i in range(0, len(order), bs):
                 sel = order[i:i + bs]
+                if self.use_hs:
+                    syn0, syn1, loss = step(syn0, syn1, centers[sel],
+                                            contexts[sel])
+                    continue
                 negs = rng.choice(len(neg_p), size=(bs, self.negative),
                                   p=neg_p).astype(np.int32)
                 if cbow:

@@ -153,9 +153,11 @@ class PrimaryCapsules(Layer):
 
 @dataclasses.dataclass(kw_only=True)
 class CapsuleLayer(Layer):
-    """Dynamic-routing capsule layer (reference `CapsuleLayer`): input
-    [B, N_in, D_in] capsules are linearly mapped to per-output predictions
-    and combined over `routings` agreement iterations."""
+    """Dynamic-routing capsule layer (reference `CapsuleLayer`, which
+    builds the routing loop in SameDiff ops and therefore backprops
+    through it — matched here): input [B, N_in, D_in] capsules are
+    linearly mapped to per-output predictions and combined over
+    `routings` agreement iterations, differentiated end-to-end."""
 
     capsules: int = 10
     capsule_dim: int = 16
@@ -182,10 +184,10 @@ class CapsuleLayer(Layer):
             s = jnp.einsum("bnj,bnjd->bjd", c, u_hat)
             v = _squash(s)
             if r + 1 < self.routings:
-                # agreement; stop-grad on the routing signal as in the
-                # reference implementation (routing is not backpropped)
-                logits = logits + jax.lax.stop_gradient(
-                    jnp.einsum("bnjd,bjd->bnj", u_hat, v))
+                # agreement update; fully differentiated (the routing is a
+                # fixed-iteration unrolled loop, finite-difference-checked
+                # in tests/test_gradientcheck.py)
+                logits = logits + jnp.einsum("bnjd,bjd->bnj", u_hat, v)
         return v, state
 
 

@@ -181,3 +181,52 @@ def test_separable_conv_gradients():
     ], InputType.convolutional(5, 5, 2))
     assert check_gradients(score_fn_for(net, x, y), net.params_,
                            max_params_per_leaf=20)
+
+
+def test_capsnet_gradients():
+    """CapsNet stack gradient check: dynamic routing is a fixed-iteration
+    unrolled loop differentiated end-to-end."""
+    from deeplearning4j_tpu.nn import (CapsuleLayer, CapsuleStrengthLayer,
+                                       LossLayer, PrimaryCapsules)
+    rng = np.random.default_rng(5)
+    x = rng.random((4, 8, 8, 1))
+    y = np.eye(2)[rng.integers(0, 2, 4)]
+    net = build_net([
+        PrimaryCapsules(capsules=2, capsule_dim=4, kernel_size=5, stride=2),
+        CapsuleLayer(capsules=2, capsule_dim=4, routings=2),
+        CapsuleStrengthLayer(),
+        LossLayer(loss="mcxent", activation="softmax"),
+    ], InputType.convolutional(8, 8, 1))
+    assert check_gradients(score_fn_for(net, x, y), net.params_,
+                           max_params_per_leaf=12, verbose=True)
+
+
+def test_samediff_custom_layer_gradients():
+    import dataclasses
+
+    from deeplearning4j_tpu.nn import SameDiffLayer, register_layer
+
+    @register_layer
+    @dataclasses.dataclass(kw_only=True)
+    class _Bilinear(SameDiffLayer):
+        n_out: int = 0
+
+        def define_parameters(self, input_type):
+            f = input_type.shape[-1]
+            return {"W": (f, self.n_out), "U": (f, self.n_out)}
+
+        def define_layer(self, params, x, mask=None):
+            return jnp.tanh(x @ params["W"]) * (x @ params["U"])
+
+        def get_output_type(self, input_type):
+            return InputType.feed_forward(self.n_out)
+
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(6, 3))
+    y = np.eye(2)[rng.integers(0, 2, 6)]
+    net = build_net([
+        _Bilinear(n_out=5),
+        OutputLayer(n_out=2, loss="mcxent", activation="softmax"),
+    ], InputType.feed_forward(3))
+    assert check_gradients(score_fn_for(net, x, y), net.params_,
+                           max_params_per_leaf=None, verbose=True)

@@ -213,3 +213,32 @@ def test_paragraph_vectors_dbow_and_roundtrip(tmp_path):
     np.testing.assert_array_equal(pv.doc_vectors, pv2.doc_vectors)
     v = pv2.infer_vector("the fresh apple")
     assert v.shape == (12,)
+
+
+def test_word2vec_hierarchical_softmax_learns():
+    """HS mode (reference useHierarchicSoftmax): Huffman paths as padded
+    [V, L] matrices, one masked-gather step — same co-occurrence structure
+    emerges as with negative sampling."""
+    w2v = (Word2Vec.builder()
+           .min_word_frequency(2).layer_size(16).window_size(3)
+           .use_hierarchic_softmax(True).epochs(3).learning_rate(0.02)
+           .batch_size(256).seed(1).build())
+    w2v.fit(_corpus())
+    assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "two")
+    near = w2v.words_nearest("one", 2)
+    assert set(near) <= {"two", "three"}
+
+
+def test_huffman_codes_are_prefix_free_and_short_for_frequent():
+    w2v = (Word2Vec.builder().min_word_frequency(1).layer_size(4)
+           .use_hierarchic_softmax(True).epochs(1).build())
+    w2v.fit(["a a a a a a b b c", "a a b c c b a a a"])
+    CODES, POINTS, PMASK = w2v._build_huffman()
+    V = len(w2v.vocab)
+    lens = PMASK.sum(1).astype(int)
+    # the most frequent word gets the shortest code
+    assert lens[w2v.vocab["a"]] == lens.min()
+    # codes are unique full paths (prefix-free by tree construction)
+    paths = {tuple(CODES[i, :lens[i]]) for i in range(V)}
+    assert len(paths) == V
+    assert POINTS.max() <= V - 2

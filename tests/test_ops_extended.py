@@ -467,3 +467,15 @@ def test_setdiff1d_padding_never_leaks_excluded_values():
                                      jnp.asarray([1]), size=3))
     assert 1 not in out.tolist()        # pad repeats a kept element instead
     assert set(out.tolist()) == {2, 3}
+
+
+def test_central_crop_keeps_remainder_pixel():
+    img = jnp.asarray(rng.random((1, 5, 5, 1)).astype(np.float32))
+    out = op("image_central_crop")(img, 0.5)
+    assert out.shape == (1, 3, 3, 1)       # TF keeps the remainder pixel
+
+
+def test_segment_prod_unsorted_ids():
+    data = jnp.asarray([2.0, 3.0, 5.0])
+    out = np.asarray(op("segment_prod")(data, jnp.asarray([1, 0, 1]), 2))
+    np.testing.assert_allclose(out, [3.0, 10.0])
