@@ -66,7 +66,10 @@ class Word2Vec(WordVectorsMixin):
         self.inv_vocab: Dict[int, str] = {}
         self.counts: Optional[np.ndarray] = None
         self.syn0: Optional[np.ndarray] = None   # input vectors [V, D]
-        self.syn1: Optional[np.ndarray] = None   # output vectors [V, D]
+        # output vectors: [V, D] under negative sampling; [V-1, D] Huffman
+        # inner-node vectors under hierarchical softmax (word lookups always
+        # use syn0)
+        self.syn1: Optional[np.ndarray] = None
         self._tok = DefaultTokenizerFactory(CommonPreprocessor())
 
     # ---- builder ----
@@ -326,7 +329,8 @@ class Word2Vec(WordVectorsMixin):
             config=json.dumps({
                 "layer_size": self.layer_size,
                 "window_size": self.window_size,
-                "negative": self.negative}))
+                "negative": self.negative,
+                "use_hierarchic_softmax": self.use_hs}))
 
     @staticmethod
     def load(path: str) -> "Word2Vec":
@@ -334,7 +338,9 @@ class Word2Vec(WordVectorsMixin):
             cfg = json.loads(str(z["config"]))
             w = Word2Vec(layer_size=cfg["layer_size"],
                          window_size=cfg["window_size"],
-                         negative_sample=cfg["negative"])
+                         negative_sample=cfg["negative"],
+                         use_hierarchic_softmax=cfg.get(
+                             "use_hierarchic_softmax", False))
             w.vocab = json.loads(str(z["vocab"]))
             w.inv_vocab = {i: k for k, i in w.vocab.items()}
             w.syn0, w.syn1 = z["syn0"], z["syn1"]

@@ -271,6 +271,7 @@ class ComputationGraphConfiguration:
     weight_decay: float = 0.0
     dtype: str = "float32"
     compute_dtype: Optional[str] = None   # bf16 compute path (see multilayer)
+    remat: bool = False                   # per-vertex jax.checkpoint
     gradient_normalization: Optional[str] = None
     gradient_normalization_threshold: float = 1.0
 
@@ -315,6 +316,7 @@ class ComputationGraphConfiguration:
             "l1": self.l1, "l2": self.l2, "weight_decay": self.weight_decay,
             "dtype": self.dtype,
             "compute_dtype": self.compute_dtype,
+            "remat": self.remat,
             "gradient_normalization": self.gradient_normalization,
             "gradient_normalization_threshold": self.gradient_normalization_threshold,
         }, indent=2)
@@ -341,6 +343,7 @@ class ComputationGraphConfiguration:
             l1=d["l1"], l2=d["l2"], weight_decay=d.get("weight_decay", 0.0),
             dtype=d.get("dtype", "float32"),
             compute_dtype=d.get("compute_dtype"),
+            remat=d.get("remat", False),
             gradient_normalization=d.get("gradient_normalization"),
             gradient_normalization_threshold=d.get(
                 "gradient_normalization_threshold", 1.0),
@@ -366,6 +369,7 @@ class GraphBuilder:
         self._weight_decay = 0.0
         self._dtype = "float32"
         self._compute_dtype = None
+        self._remat = False
         self._grad_norm = None
         self._grad_norm_threshold = 1.0
 
@@ -379,6 +383,11 @@ class GraphBuilder:
     def weight_decay(self, v): self._weight_decay = float(v); return self
     def dtype(self, dt): self._dtype = dt; return self
     def compute_dtype(self, dt): self._compute_dtype = dt; return self
+
+    def gradient_checkpointing(self, on: bool = True):
+        """Rematerialize each vertex in the backward pass (jax.checkpoint);
+        HBM for FLOPs on deep graphs."""
+        self._remat = bool(on); return self
 
     def gradient_normalization(self, mode, threshold=1.0):
         self._grad_norm = mode; self._grad_norm_threshold = threshold; return self
@@ -426,6 +435,7 @@ class GraphBuilder:
             activation=self._activation, l1=self._l1, l2=self._l2,
             weight_decay=self._weight_decay, dtype=self._dtype,
             compute_dtype=self._compute_dtype,
+            remat=self._remat,
             gradient_normalization=self._grad_norm,
             gradient_normalization_threshold=self._grad_norm_threshold)
 
@@ -523,8 +533,15 @@ class ComputationGraph:
             if (want_head_inputs and name in self.conf.network_outputs
                     and layer is not None and hasattr(layer, "compute_loss")):
                 head_inputs[name] = xs[0]
-            acts[name], new_state[name] = vertex.apply(
-                params[name], state[name], xs, train=train, rng=vrng)
+            if self.conf.remat and train:
+                # train only (see MultiLayerNetwork._forward)
+                def _apply(p_, s_, xs_, r_, _v=vertex, _train=train):
+                    return _v.apply(p_, s_, xs_, train=_train, rng=r_)
+                acts[name], new_state[name] = jax.checkpoint(_apply)(
+                    params[name], state[name], xs, vrng)
+            else:
+                acts[name], new_state[name] = vertex.apply(
+                    params[name], state[name], xs, train=train, rng=vrng)
         if want_head_inputs:
             return acts, new_state, head_inputs
         return acts, new_state

@@ -78,6 +78,11 @@ class MultiLayerConfiguration:
     compute_dtype: Optional[str] = None
     gradient_normalization: Optional[str] = None
     gradient_normalization_threshold: float = 1.0
+    # gradient checkpointing (jax.checkpoint per layer): trades ~1 extra
+    # forward of FLOPs for O(sqrt)-ish activation memory — the HBM lever
+    # for deep models; a capability-exceeding TPU addition (the reference
+    # has no rematerialization story)
+    remat: bool = False
 
     def layer_name(self, i: int) -> str:
         return self.layers[i].name or f"layer_{i}"
@@ -97,6 +102,7 @@ class MultiLayerConfiguration:
             "compute_dtype": self.compute_dtype,
             "gradient_normalization": self.gradient_normalization,
             "gradient_normalization_threshold": self.gradient_normalization_threshold,
+            "remat": self.remat,
         }, indent=2)
 
     @staticmethod
@@ -114,6 +120,7 @@ class MultiLayerConfiguration:
             compute_dtype=d.get("compute_dtype"),
             gradient_normalization=d.get("gradient_normalization"),
             gradient_normalization_threshold=d.get("gradient_normalization_threshold", 1.0),
+            remat=d.get("remat", False),
         )
 
 
@@ -135,6 +142,7 @@ class NeuralNetConfiguration:
             self._grad_norm = None
             self._grad_norm_threshold = 1.0
             self._input_type: Optional[InputType] = None
+            self._remat = False
 
         def seed(self, s: int):
             self._seed = int(s); return self
@@ -166,6 +174,11 @@ class NeuralNetConfiguration:
         def gradient_normalization(self, mode: str, threshold: float = 1.0):
             self._grad_norm = mode; self._grad_norm_threshold = threshold; return self
 
+        def gradient_checkpointing(self, on: bool = True):
+            """Rematerialize each layer's activations in the backward pass
+            (jax.checkpoint) — HBM for FLOPs on deep models."""
+            self._remat = bool(on); return self
+
         def set_input_type(self, it: InputType):
             self._input_type = it; return self
 
@@ -192,6 +205,7 @@ class NeuralNetConfiguration:
                 compute_dtype=p._compute_dtype,
                 gradient_normalization=p._grad_norm,
                 gradient_normalization_threshold=p._grad_norm_threshold,
+                remat=p._remat,
             )
 
     @staticmethod
@@ -284,8 +298,17 @@ class MultiLayerNetwork:
             lrng = None
             if rng is not None and layer.STOCHASTIC:
                 rng, lrng = jax.random.split(rng)
-            x, s = layer.apply(params[name], state[name], x, train=train,
-                               rng=lrng, mask=mask)
+            if self.conf.remat and train:
+                # train only: inference is never differentiated, and
+                # jax.checkpoint's CSE barrier would just slow it down
+                def _apply(p_, s_, x_, r_, m_, _layer=layer, _train=train):
+                    return _layer.apply(p_, s_, x_, train=_train, rng=r_,
+                                        mask=m_)
+                x, s = jax.checkpoint(_apply)(params[name], state[name], x,
+                                              lrng, mask)
+            else:
+                x, s = layer.apply(params[name], state[name], x, train=train,
+                                   rng=lrng, mask=mask)
             new_state[name] = s
             if mask is not None and self._layer_types:
                 # Mask propagation (the reference's feedForwardMaskArray):

@@ -205,3 +205,35 @@ def test_cycle_detection():
          .set_outputs("out"))
     with pytest.raises(ValueError, match="cycle"):
         ComputationGraph(b.build()).init()
+
+
+def test_cg_gradient_checkpointing_matches_plain():
+    import numpy as np
+
+    from deeplearning4j_tpu.train.updaters import Sgd
+
+    def build(remat):
+        b = (GraphBuilder().seed(3).updater(Sgd(0.05))
+             .add_inputs("in")
+             .set_input_types(InputType.feed_forward(5)))
+        if remat:
+            b = b.gradient_checkpointing()
+        b.add_layer("d1", DenseLayer(n_out=8, activation="tanh"), "in")
+        b.add_layer("d2", DenseLayer(n_out=8, activation="relu"), "d1")
+        b.add_vertex("res", ElementWiseVertex(op="Add"), "d1", "d2")
+        b.add_layer("out", OutputLayer(n_out=2, loss="mcxent",
+                                       activation="softmax"), "res")
+        b.set_outputs("out")
+        return ComputationGraph(b.build()).init()
+
+    rng = np.random.RandomState(2)
+    x = rng.randn(6, 5).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 6)]
+    a, b_ = build(False), build(True)
+    for _ in range(4):
+        a.fit(x, y)
+        b_.fit(x, y)
+    np.testing.assert_allclose(np.asarray(a.params()),
+                               np.asarray(b_.params()), atol=1e-6)
+    assert ComputationGraphConfiguration.from_json(
+        b_.conf.to_json()).remat

@@ -165,3 +165,37 @@ def test_frozen_layer_does_not_update():
     np.testing.assert_allclose(np.asarray(net.params_["layer_0"]["W"]), w_before)
     assert not np.allclose(np.asarray(net.params_["layer_1"]["W"]),
                            w_before[:32, :32] if w_before.shape[0] >= 32 else 0)
+
+
+def test_gradient_checkpointing_matches_plain():
+    """remat (jax.checkpoint per layer) must be numerically identical to the
+    plain path — it only changes what the backward rematerializes."""
+    rng = np.random.RandomState(11)
+    x = rng.randn(8, 6).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 8)]
+
+    from deeplearning4j_tpu.train.updaters import Sgd
+
+    def build(remat):
+        b = (NeuralNetConfiguration.builder().seed(5)
+             .updater(Sgd(0.1)))
+        if remat:
+            b = b.gradient_checkpointing()
+        conf = (b.list([DenseLayer(n_out=16, activation="tanh"),
+                        DenseLayer(n_out=8, activation="relu"),
+                        OutputLayer(n_out=3, loss="mcxent",
+                                    activation="softmax")])
+                .set_input_type(InputType.feed_forward(6)).build())
+        return MultiLayerNetwork(conf).init()
+
+    a, b_ = build(False), build(True)
+    assert b_.conf.remat and not a.conf.remat
+    # same seed -> same init; train both 5 steps; params must bit-match
+    for _ in range(5):
+        a.fit(x, y)
+        b_.fit(x, y)
+    np.testing.assert_allclose(np.asarray(a.params()),
+                               np.asarray(b_.params()), atol=1e-6)
+    # config round-trips the flag
+    from deeplearning4j_tpu.nn import MultiLayerConfiguration
+    assert MultiLayerConfiguration.from_json(b_.conf.to_json()).remat

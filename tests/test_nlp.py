@@ -242,3 +242,14 @@ def test_huffman_codes_are_prefix_free_and_short_for_frequent():
     paths = {tuple(CODES[i, :lens[i]]) for i in range(V)}
     assert len(paths) == V
     assert POINTS.max() <= V - 2
+
+
+def test_word2vec_hs_flag_survives_save_load(tmp_path):
+    w2v = (Word2Vec.builder().min_word_frequency(1).layer_size(8)
+           .use_hierarchic_softmax(True).epochs(1).build())
+    w2v.fit(["a b c a b c", "c b a c b a"])
+    p = str(tmp_path / "hs.npz")
+    w2v.save(p)
+    w2 = Word2Vec.load(p)
+    assert w2.use_hs
+    assert w2.syn1.shape[0] == len(w2.vocab) - 1   # inner-node matrix
