@@ -11,6 +11,7 @@ Only ops touched by the baseline configs + test suite are present (SURVEY.md
 """
 from __future__ import annotations
 
+import functools
 from typing import Callable, Dict
 
 import jax
@@ -1398,6 +1399,183 @@ def _cumsum_ext(a, axis=0, exclusive=False, reverse=False):
     if reverse:
         out = jnp.flip(out, axis=axis)
     return out
+
+
+# ---- updater ops (reference generic/updaters/{sgd,rmsProp,adam,adaGrad,
+# adaMax,adaDelta,nadam,amsGrad,nesterovs}Updater.cpp — the functional
+# faces of the optimizer family; stateful use lives in train/updaters.py)
+register_op("sgd_updater", lambda g, lr=0.01: g * lr)
+
+
+@register_op("nesterovs_updater")
+def _nesterovs_updater(g, v, lr=0.1, momentum=0.9):
+    """Returns (update-to-subtract, new velocity) — same contract as
+    train/updaters.Nesterovs."""
+    v_new = momentum * v - lr * g
+    return momentum * v - (1 + momentum) * v_new, v_new
+
+
+@register_op("adam_updater")
+def _adam_updater(g, m, v, t, lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8):
+    m_new = beta1 * m + (1 - beta1) * g
+    v_new = beta2 * v + (1 - beta2) * g * g
+    t1 = t + 1
+    mhat = m_new / (1 - beta1 ** t1)
+    vhat = v_new / (1 - beta2 ** t1)
+    return lr * mhat / (jnp.sqrt(vhat) + eps), m_new, v_new
+
+
+@register_op("rms_prop_updater")
+def _rms_prop_updater(g, s, lr=1e-3, decay=0.95, eps=1e-8):
+    s_new = decay * s + (1 - decay) * g * g
+    return lr * g / jnp.sqrt(s_new + eps), s_new
+
+
+@register_op("ada_grad_updater")
+def _ada_grad_updater(g, h, lr=1e-2, eps=1e-6):
+    h_new = h + g * g
+    return lr * g / (jnp.sqrt(h_new) + eps), h_new
+
+
+@register_op("ada_delta_updater")
+def _ada_delta_updater(g, msg, msdx, rho=0.95, eps=1e-6):
+    msg_new = rho * msg + (1 - rho) * g * g
+    dx = jnp.sqrt(msdx + eps) / jnp.sqrt(msg_new + eps) * g
+    return dx, msg_new, rho * msdx + (1 - rho) * dx * dx
+
+
+@register_op("ada_max_updater")
+def _ada_max_updater(g, m, u, t, lr=2e-3, beta1=0.9, beta2=0.999,
+                     eps=1e-8):
+    m_new = beta1 * m + (1 - beta1) * g
+    u_new = jnp.maximum(beta2 * u, jnp.abs(g))
+    return (lr / (1 - beta1 ** (t + 1))) * m_new / (u_new + eps), \
+        m_new, u_new
+
+
+@register_op("nadam_updater")
+def _nadam_updater(g, m, v, t, lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8):
+    m_new = beta1 * m + (1 - beta1) * g
+    v_new = beta2 * v + (1 - beta2) * g * g
+    t1 = t + 1
+    mhat = m_new / (1 - beta1 ** t1)
+    vhat = v_new / (1 - beta2 ** t1)
+    return lr * (beta1 * mhat + (1 - beta1) * g / (1 - beta1 ** t1)) \
+        / (jnp.sqrt(vhat) + eps), m_new, v_new
+
+
+@register_op("ams_grad_updater")
+def _ams_grad_updater(g, m, v, vhat, t, lr=1e-3, beta1=0.9, beta2=0.999,
+                      eps=1e-8):
+    m_new = beta1 * m + (1 - beta1) * g
+    v_new = beta2 * v + (1 - beta2) * g * g
+    vhat_new = jnp.maximum(vhat, v_new)
+    return lr * m_new / (jnp.sqrt(vhat_new) + eps), m_new, v_new, vhat_new
+
+
+# ---- rnn: whole-sequence GRU (reference generic/nn/recurrent/gru.cpp) ----
+@register_op("gru_layer")
+def _gru_layer(x, h0, w_ih, w_hh, b_ih=None, b_hh=None):
+    """[B, T, F] → [B, T, H] via lax.scan of gru_cell."""
+    cell = OP_TABLE["gru_cell"]
+
+    def step(h, xt):
+        h_new = cell(xt, h, w_ih, w_hh, b_ih, b_hh)
+        return h_new, h_new
+
+    _, ys = lax.scan(step, h0, jnp.swapaxes(x, 0, 1))
+    return jnp.swapaxes(ys, 0, 1)
+
+
+# ---- morphology / pooling extras ----
+@register_op("dilation2d")
+def _dilation2d(x, filt, stride=(1, 1), padding="SAME"):
+    """Grayscale morphological dilation (TF Dilation2D / reference
+    generic/nn/dilation2d.cpp): max over window of (x + filter)."""
+    kh, kw, c = filt.shape
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), tuple(stride), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    B, OH, OW, _ = patches.shape
+    # patches feature axis is ordered [c, kh, kw]
+    p = patches.reshape(B, OH, OW, c, kh * kw)
+    f = filt.transpose(2, 0, 1).reshape(c, kh * kw)
+    return jnp.max(p + f[None, None, None], axis=-1)
+
+
+@register_op("max_pool_with_argmax")
+def _max_pool_with_argmax(x, kernel=(2, 2), stride=(2, 2),
+                          padding="VALID"):
+    """Returns (pooled, argmax indices) with the TF MaxPoolWithArgmax
+    contract (include_batch_in_index=False): index = (h*W + w)*C + c."""
+    B, H, W, C = x.shape
+    hw = jnp.arange(H * W).reshape(1, H, W, 1)
+    ch = jnp.arange(C).reshape(1, 1, 1, C)
+    flat_idx = jnp.broadcast_to(hw * C + ch, x.shape).astype(jnp.int32)
+    kh, kw = kernel
+
+    def both(xv, iv):
+        # max-reduce values and carry the argmax index alongside
+        init = (jnp.asarray(-jnp.inf, xv.dtype),
+                jnp.asarray(-1, iv.dtype))
+
+        def reducer(a, b):
+            av, ai = a
+            bv, bi = b
+            take_b = bv > av
+            return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
+
+        return lax.reduce_window(
+            (xv, iv), init, reducer,
+            (1, kh, kw, 1), (1,) + tuple(stride) + (1,), padding)
+
+    vals, idxs = both(x, flat_idx)
+    return vals, idxs
+
+
+@register_op("col2im")
+def _col2im(cols, h, w, kh, kw, sh=1, sw=1):
+    """Inverse of im2col (VALID padding): scatter-add patches back to
+    [B, H, W, C] (reference generic/nn/col2im.cpp)."""
+    B, OH, OW, _, _, C = cols.shape
+    out = jnp.zeros((B, h, w, C), cols.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            out = out.at[:, i:i + OH * sh:sh, j:j + OW * sw:sw, :].add(
+                cols[:, :, :, i, j, :])
+    return out
+
+
+# ---- merge ops (reference generic/broadcastable/merge_{max,add,avg}) ----
+register_op("mergemax", lambda *xs: functools.reduce(jnp.maximum, xs))
+register_op("mergeadd", lambda *xs: sum(xs))
+register_op("mergeavg", lambda *xs: sum(xs) / len(xs))
+
+
+# ---- misc completions ----
+register_op("bias_add", lambda x, b: x + b)
+register_op("assign_add", lambda a, b: a + b)
+register_op("assign_sub", lambda a, b: a - b)
+register_op("histogram", lambda a, bins: jnp.histogram(a, bins=bins)[0])
+register_op("norm_p", lambda a, p=2, axis=None, keepdims=False:
+            jnp.sum(jnp.abs(a) ** p, axis=_axis_tuple(axis),
+                    keepdims=keepdims) ** (1.0 / p))
+# TF/libnd4j clip_by_average_norm: the divisor is norm2 / numel
+register_op("clip_by_avg_norm", lambda a, clip_norm:
+            a * jnp.minimum(1.0, clip_norm /
+                            jnp.maximum(jnp.sqrt(jnp.sum(a * a)) / a.size,
+                                        1e-12)))
+
+
+@register_op("log_poisson_loss")
+def _log_poisson_loss(labels, log_input, compute_full_loss=False):
+    loss = jnp.exp(log_input) - labels * log_input
+    if compute_full_loss:
+        # Stirling approximation for log(y!) as TF does
+        ls = labels * jnp.log(jnp.maximum(labels, 1e-8)) - labels \
+            + 0.5 * jnp.log(2 * jnp.pi * jnp.maximum(labels, 1.0))
+        loss = loss + jnp.where(labels > 1.0, ls, 0.0)
+    return jnp.mean(loss)
 
 
 # ---- linalg completions ----

@@ -350,8 +350,8 @@ def test_clip_by_global_norm():
 
 def test_op_count_vs_reference_inventory():
     """Round-2 breadth: the registry should keep growing toward the ~500
-    reference declarable ops (VERDICT round 1: 113; round 2: 370+)."""
-    assert len(OP_TABLE) >= 370, len(OP_TABLE)
+    reference declarable ops (VERDICT round 1: 113; round 2: 390+)."""
+    assert len(OP_TABLE) >= 390, len(OP_TABLE)
 
 
 def test_matrix_set_diag_rectangular():
@@ -479,3 +479,122 @@ def test_segment_prod_unsorted_ids():
     data = jnp.asarray([2.0, 3.0, 5.0])
     out = np.asarray(op("segment_prod")(data, jnp.asarray([1, 0, 1]), 2))
     np.testing.assert_allclose(out, [3.0, 10.0])
+
+
+# ---- round-2 third batch: updater ops / gru / morphology / merges ----
+
+def test_updater_ops_match_stateful_updaters():
+    """Functional updater ops vs the train/updaters classes (reference
+    generic/updaters/*.cpp are the same duality)."""
+    from deeplearning4j_tpu.train.updaters import Adam, Nesterovs, RmsProp  # noqa: F401
+    g = jnp.asarray(rng.standard_normal((4, 3)).astype(np.float32))
+    p = jnp.asarray(rng.standard_normal((4, 3)).astype(np.float32))
+
+    # adam: one step from zero state, t=0
+    upd, m, v = op("adam_updater")(g, jnp.zeros_like(g), jnp.zeros_like(g),
+                                   0, lr=1e-3)
+    cfg = Adam(1e-3)
+    st = cfg.init_state({"w": p})
+    ref_upd, _ = cfg.apply(st, {"w": g}, 0, 0, params={"w": p})
+    np.testing.assert_allclose(np.asarray(upd), np.asarray(ref_upd["w"]),
+                               rtol=1e-5, atol=1e-7)
+
+    # rmsprop
+    upd2, s2 = op("rms_prop_updater")(g, jnp.zeros_like(g), lr=1e-3)
+    cfg2 = RmsProp(1e-3)
+    st2 = cfg2.init_state({"w": p})
+    ref2, _ = cfg2.apply(st2, {"w": g}, 0, 0, params={"w": p})
+    np.testing.assert_allclose(np.asarray(upd2), np.asarray(ref2["w"]),
+                               rtol=1e-4, atol=1e-7)
+
+    # nesterovs: update must match the stateful class exactly
+    upd3, v3 = op("nesterovs_updater")(g, jnp.zeros_like(g), lr=0.1,
+                                       momentum=0.9)
+    cfg3 = Nesterovs(0.1, 0.9)
+    st3 = cfg3.init_state({"w": p})
+    ref3, _ = cfg3.apply(st3, {"w": g}, 0, 0, params={"w": p})
+    np.testing.assert_allclose(np.asarray(upd3), np.asarray(ref3["w"]),
+                               rtol=1e-5, atol=1e-7)
+
+    # shapes/finiteness across the rest
+    z = jnp.zeros_like(g)
+    for name, args in [("sgd_updater", (g,)),
+                       ("ada_grad_updater", (g, z)),
+                       ("ada_delta_updater", (g, z, z)),
+                       ("ada_max_updater", (g, z, z, 0)),
+                       ("nadam_updater", (g, z, z, 0)),
+                       ("ams_grad_updater", (g, z, z, z, 0))]:
+        out = op(name)(*args)
+        first = out[0] if isinstance(out, tuple) else out
+        assert first.shape == g.shape
+        assert np.isfinite(np.asarray(first)).all(), name
+
+
+def test_gru_layer_scan():
+    B, T, F, H = 2, 5, 3, 4
+    x = jnp.asarray(rng.standard_normal((B, T, F)).astype(np.float32))
+    h0 = jnp.zeros((B, H))
+    w_ih = jnp.asarray(rng.standard_normal((F, 3 * H)).astype(np.float32)
+                       * 0.3)
+    w_hh = jnp.asarray(rng.standard_normal((H, 3 * H)).astype(np.float32)
+                       * 0.3)
+    ys = op("gru_layer")(x, h0, w_ih, w_hh)
+    assert ys.shape == (B, T, H)
+    # last output equals manually chaining the cell
+    h = h0
+    for t in range(T):
+        h = op("gru_cell")(x[:, t], h, w_ih, w_hh)
+    np.testing.assert_allclose(np.asarray(ys[:, -1]), np.asarray(h),
+                               rtol=1e-5)
+
+
+def test_dilation2d_matches_tf():
+    tf = pytest.importorskip("tensorflow")
+    x = rng.random((1, 6, 6, 2)).astype(np.float32)
+    f = rng.random((3, 3, 2)).astype(np.float32) * 0.1
+    ours = np.asarray(op("dilation2d")(jnp.asarray(x), jnp.asarray(f)))
+    ref = tf.nn.dilation2d(x, f, strides=(1, 1, 1, 1), padding="SAME",
+                           data_format="NHWC", dilations=(1, 1, 1, 1))
+    np.testing.assert_allclose(ours, ref.numpy(), rtol=1e-5)
+
+
+def test_max_pool_with_argmax():
+    x = jnp.asarray(np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1))
+    vals, idxs = op("max_pool_with_argmax")(x)
+    np.testing.assert_allclose(np.asarray(vals)[0, :, :, 0],
+                               [[5, 7], [13, 15]])
+    np.testing.assert_array_equal(np.asarray(idxs)[0, :, :, 0],
+                                  [[5, 7], [13, 15]])
+    # multi-channel: TF contract index = (h*W + w)*C + c
+    tf = pytest.importorskip("tensorflow")
+    xc = rng.random((1, 4, 4, 3)).astype(np.float32)
+    v2, i2 = op("max_pool_with_argmax")(jnp.asarray(xc))
+    tv, ti = tf.nn.max_pool_with_argmax(xc, 2, 2, "VALID",
+                                        include_batch_in_index=False)
+    np.testing.assert_allclose(np.asarray(v2), tv.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(i2), ti.numpy())
+
+
+def test_col2im_inverts_im2col_counts():
+    x = jnp.ones((1, 4, 4, 1))
+    cols = op("im2col")(x, 2, 2, 2, 2)      # non-overlapping
+    back = np.asarray(op("col2im")(cols, 4, 4, 2, 2, 2, 2))
+    np.testing.assert_allclose(back, np.ones((1, 4, 4, 1)))
+
+
+def test_merge_and_misc_ops():
+    a, b, c = (jnp.asarray([1.0, 5.0]), jnp.asarray([4.0, 2.0]),
+               jnp.asarray([3.0, 3.0]))
+    np.testing.assert_allclose(op("mergemax")(a, b, c), [4, 5])
+    np.testing.assert_allclose(op("mergeadd")(a, b, c), [8, 10])
+    np.testing.assert_allclose(op("mergeavg")(a, b, c), [8 / 3, 10 / 3])
+    np.testing.assert_allclose(op("norm_p")(jnp.asarray([3.0, 4.0]), p=2),
+                               5.0, rtol=1e-6)
+    h = np.asarray(op("histogram")(jnp.asarray([0.1, 0.2, 0.9]), 2))
+    np.testing.assert_array_equal(h, [2, 1])
+    # clip_by_average_norm semantics: divisor is norm2/numel
+    cl = np.asarray(op("clip_by_avg_norm")(jnp.asarray([6.0, 8.0]), 1.0))
+    np.testing.assert_allclose(cl, [1.2, 1.6], rtol=1e-5)
+    lp = float(op("log_poisson_loss")(jnp.asarray([2.0]),
+                                      jnp.asarray([1.0])))
+    np.testing.assert_allclose(lp, np.exp(1.0) - 2.0, rtol=1e-5)
