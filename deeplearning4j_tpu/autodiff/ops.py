@@ -1491,10 +1491,22 @@ def _gru_layer(x, h0, w_ih, w_hh, b_ih=None, b_hh=None):
 @register_op("dilation2d")
 def _dilation2d(x, filt, stride=(1, 1), padding="SAME"):
     """Grayscale morphological dilation (TF Dilation2D / reference
-    generic/nn/dilation2d.cpp): max over window of (x + filter)."""
+    generic/nn/dilation2d.cpp): max over window of (x + filter).  SAME
+    borders pad with dtype-min (the morphological identity), matching TF —
+    zero-padding would corrupt borders of negative feature maps."""
     kh, kw, c = filt.shape
+    if padding == "SAME":
+        H, W = x.shape[1], x.shape[2]
+        sh, sw = stride
+        oh, ow = -(-H // sh), -(-W // sw)
+        ph = max((oh - 1) * sh + kh - H, 0)
+        pw = max((ow - 1) * sw + kw - W, 0)
+        neg = jnp.finfo(x.dtype).min
+        x = jnp.pad(x, ((0, 0), (ph // 2, ph - ph // 2),
+                        (pw // 2, pw - pw // 2), (0, 0)),
+                    constant_values=neg)
     patches = lax.conv_general_dilated_patches(
-        x, (kh, kw), tuple(stride), padding,
+        x, (kh, kw), tuple(stride), "VALID",
         dimension_numbers=("NHWC", "HWIO", "NHWC"))
     B, OH, OW, _ = patches.shape
     # patches feature axis is ordered [c, kh, kw]
@@ -1576,6 +1588,134 @@ def _log_poisson_loss(labels, log_input, compute_full_loss=False):
             + 0.5 * jnp.log(2 * jnp.pi * jnp.maximum(labels, 1.0))
         loss = loss + jnp.where(labels > 1.0, ls, 0.0)
     return jnp.mean(loss)
+
+
+# ---- rnn: SRU (reference generic/nn/recurrent/sru.cpp) ----
+@register_op("sru_cell")
+def _sru_cell(x, c, w, b):
+    """Simple Recurrent Unit step (Lei et al.; reference sru.cpp): w packs
+    [W, Wf, Wr] as [F, 3H]; b packs [bf, br] as [2H].  The highway skip
+    uses the RAW input, so F must equal H (the reference asserts
+    inSize == nUnits for the same reason)."""
+    H = c.shape[-1]
+    if x.shape[-1] != H:
+        raise ValueError(
+            f"sru requires input size == hidden size (got {x.shape[-1]} "
+            f"vs {H}) — the highway term is the raw input")
+    z = x @ w
+    xt, f_in, r_in = z[..., :H], z[..., H:2 * H], z[..., 2 * H:]
+    f = jax.nn.sigmoid(f_in + b[:H])
+    r = jax.nn.sigmoid(r_in + b[H:])
+    c_new = f * c + (1 - f) * xt
+    h = r * jnp.tanh(c_new) + (1 - r) * x
+    return h, c_new
+
+
+@register_op("sru_layer")
+def _sru_layer(x, c0, w, b):
+    """[B, T, F] → [B, T, H] SRU via lax.scan."""
+    def step(c, xt):
+        h, c_new = _sru_cell(xt, c, w, b)
+        return c_new, h
+
+    _, ys = lax.scan(step, c0, jnp.swapaxes(x, 0, 1))
+    return jnp.swapaxes(ys, 0, 1)
+
+
+# ---- resize variants / nd space-batch ----
+register_op("resize_bicubic", lambda a, size:
+            jax.image.resize(a, (a.shape[0],) + tuple(size)
+                             + (a.shape[-1],), "cubic"))
+register_op("resize_lanczos", lambda a, size:
+            jax.image.resize(a, (a.shape[0],) + tuple(size)
+                             + (a.shape[-1],), "lanczos3"))
+
+
+# ---- solves ----
+register_op("cholesky_solve", lambda chol, b:
+            jax.scipy.linalg.cho_solve((chol, True), b))
+register_op("lu_solve", lambda a, b:
+            jax.scipy.linalg.lu_solve(jax.scipy.linalg.lu_factor(a), b))
+
+
+# ---- losses / decode ----
+@register_op("mean_pairwise_squared_error")
+def _mean_pairwise_squared_error(labels, preds):
+    """TF mean_pairwise_squared_error (reference SDLoss
+    meanPairwiseSquaredError): for each sample, mean over pairs (i, j) of
+    ((d_i - d_j)^2) where d = preds - labels."""
+    d = (preds - labels).reshape(labels.shape[0], -1)
+    n = d.shape[-1]
+    if n <= 1:
+        return jnp.asarray(0.0, d.dtype)
+    sum_d = jnp.sum(d, axis=-1)
+    sum_d2 = jnp.sum(d * d, axis=-1)
+    # TF per-sample formula: 2*sum(d^2)/(n-1) - 2*sum(d)^2/(n*(n-1)).
+    # Batch reduction is a plain mean (TF's SUM_BY_NONZERO_WEIGHTS
+    # denominator here is a historical quirk, not replicated).
+    per = (2.0 * sum_d2 / (n - 1)
+           - 2.0 * sum_d * sum_d / (n * (n - 1)))
+    return jnp.mean(per)
+
+
+@register_op("ctc_greedy_decode")
+def _ctc_greedy_decode(log_probs, input_lengths, blank=0):
+    """Greedy (best-path) CTC decoding: argmax per frame, collapse
+    repeats, drop blanks; returns ids padded with -1 (static shapes)."""
+    B, T, C = log_probs.shape
+    best = jnp.argmax(log_probs, axis=-1)                 # [B, T]
+    t_idx = jnp.arange(T)
+    live = t_idx[None, :] < input_lengths[:, None]
+    prev = jnp.concatenate(
+        [jnp.full((B, 1), -1, best.dtype), best[:, :-1]], axis=1)
+    keep = live & (best != blank) & (best != prev)
+    # stable left-compaction: kept symbols scatter to their cumulative
+    # slot, dropped ones target an out-of-bounds index (mode="drop")
+    pos = jnp.cumsum(keep, axis=1) - 1
+
+    def row(k, p, b):
+        idx = jnp.where(k, p, T)
+        return jnp.full((T,), -1, best.dtype).at[idx].set(b, mode="drop")
+
+    return jax.vmap(row)(keep, pos, best)
+
+
+# ---- dropout variants / sparse ----
+@register_op("alpha_dropout")
+def _alpha_dropout(x, rng, p=0.05):
+    """SELU-compatible alpha dropout (reference alphaDropOut): keeps the
+    self-normalizing property; p = DROP probability."""
+    if rng is None:
+        return x
+    alpha_p = -1.7580993408473766
+    keep = jax.random.bernoulli(rng, 1.0 - p, x.shape)
+    # Klambauer et al. affine correction: restores zero mean/unit variance
+    a = ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** -0.5
+    b = -a * p * alpha_p
+    return a * jnp.where(keep, x, alpha_p) + b
+
+
+@register_op("sparse_to_dense")
+def _sparse_to_dense(indices, shape, values, default_value=0.0):
+    out = jnp.full(tuple(shape), default_value,
+                   values.dtype if hasattr(values, "dtype")
+                   else jnp.float32)
+    return out.at[tuple(jnp.moveaxis(indices, -1, 0))].set(values)
+
+
+@register_op("fused_batch_norm")
+def _fused_batch_norm(x, scale, offset, eps=1e-3):
+    """TF FusedBatchNorm training contract: normalize with the biased batch
+    variance, but return the Bessel-corrected variance as batch_var (TF
+    feeds it into running-variance updates); NHWC."""
+    axes = tuple(range(x.ndim - 1))
+    n = 1
+    for ax in axes:
+        n *= x.shape[ax]
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    y = (x - mean) / jnp.sqrt(var + eps) * scale + offset
+    return y, mean, var * (n / max(n - 1, 1))
 
 
 # ---- linalg completions ----

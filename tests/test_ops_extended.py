@@ -350,8 +350,8 @@ def test_clip_by_global_norm():
 
 def test_op_count_vs_reference_inventory():
     """Round-2 breadth: the registry should keep growing toward the ~500
-    reference declarable ops (VERDICT round 1: 113; round 2: 390+)."""
-    assert len(OP_TABLE) >= 390, len(OP_TABLE)
+    reference declarable ops (VERDICT round 1: 113; round 2: 400+)."""
+    assert len(OP_TABLE) >= 400, len(OP_TABLE)
 
 
 def test_matrix_set_diag_rectangular():
@@ -556,6 +556,12 @@ def test_dilation2d_matches_tf():
     ref = tf.nn.dilation2d(x, f, strides=(1, 1, 1, 1), padding="SAME",
                            data_format="NHWC", dilations=(1, 1, 1, 1))
     np.testing.assert_allclose(ours, ref.numpy(), rtol=1e-5)
+    # negative feature maps: SAME borders must pad with -inf, not zero
+    xn = (x - 5.0).astype(np.float32)
+    ours_n = np.asarray(op("dilation2d")(jnp.asarray(xn), jnp.asarray(f)))
+    ref_n = tf.nn.dilation2d(xn, f, strides=(1, 1, 1, 1), padding="SAME",
+                             data_format="NHWC", dilations=(1, 1, 1, 1))
+    np.testing.assert_allclose(ours_n, ref_n.numpy(), rtol=1e-5)
 
 
 def test_max_pool_with_argmax():
@@ -598,3 +604,90 @@ def test_merge_and_misc_ops():
     lp = float(op("log_poisson_loss")(jnp.asarray([2.0]),
                                       jnp.asarray([1.0])))
     np.testing.assert_allclose(lp, np.exp(1.0) - 2.0, rtol=1e-5)
+
+
+# ---- round-2 fourth batch ----
+
+def test_sru_layer_and_cell():
+    B, T, H = 2, 4, 5
+    F = H                 # SRU highway uses the raw input: inSize == nUnits
+    x = jnp.asarray(rng.standard_normal((B, T, F)).astype(np.float32))
+    c0 = jnp.zeros((B, H))
+    w = jnp.asarray(rng.standard_normal((F, 3 * H)).astype(np.float32) * 0.3)
+    b = jnp.zeros(2 * H)
+    ys = op("sru_layer")(x, c0, w, b)
+    assert ys.shape == (B, T, H)
+    h, c = op("sru_cell")(x[:, 0], c0, w, b)
+    np.testing.assert_allclose(np.asarray(ys[:, 0]), np.asarray(h),
+                               rtol=1e-5)
+    g = jax.grad(lambda w_: jnp.sum(op("sru_layer")(x, c0, w_, b) ** 2))(w)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_resize_variants_and_solves():
+    img = jnp.asarray(rng.random((1, 4, 4, 2)).astype(np.float32))
+    assert op("resize_bicubic")(img, (8, 8)).shape == (1, 8, 8, 2)
+    assert op("resize_lanczos")(img, (8, 8)).shape == (1, 8, 8, 2)
+    spd = jnp.asarray([[4.0, 1.0], [1.0, 3.0]])
+    bvec = jnp.asarray([1.0, 2.0])
+    chol = jnp.linalg.cholesky(spd)
+    np.testing.assert_allclose(np.asarray(op("cholesky_solve")(chol, bvec)),
+                               np.linalg.solve(np.asarray(spd),
+                                               np.asarray(bvec)), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(op("lu_solve")(spd, bvec)),
+                               np.linalg.solve(np.asarray(spd),
+                                               np.asarray(bvec)), rtol=1e-5)
+
+
+def test_mean_pairwise_squared_error_matches_tf():
+    """Per-sample values match TF exactly (batch=1); our batch reduction
+    is a plain mean, unlike TF's historical SUM_BY_NONZERO_WEIGHTS
+    denominator."""
+    tf = pytest.importorskip("tensorflow")
+    labels = rng.random((3, 5)).astype(np.float32)
+    preds = rng.random((3, 5)).astype(np.float32)
+    for b in range(3):
+        ours = float(op("mean_pairwise_squared_error")(
+            jnp.asarray(labels[b:b + 1]), jnp.asarray(preds[b:b + 1])))
+        ref = float(tf.compat.v1.losses.mean_pairwise_squared_error(
+            labels[b:b + 1], preds[b:b + 1]))
+        np.testing.assert_allclose(ours, ref, rtol=1e-4)
+
+
+def test_ctc_greedy_decode():
+    # frames argmax: [1, 1, blank, 2, 2, 1] -> collapse/drop -> [1, 2, 1]
+    C = 4
+    seq = [1, 1, 0, 2, 2, 1]
+    lp = jnp.asarray(np.eye(C, dtype=np.float32)[seq][None] * 10.0)
+    out = np.asarray(op("ctc_greedy_decode")(lp, jnp.asarray([6])))
+    assert out[0].tolist()[:3] == [1, 2, 1]
+    assert (out[0][3:] == -1).all()
+    # respects input_lengths
+    out2 = np.asarray(op("ctc_greedy_decode")(lp, jnp.asarray([2])))
+    assert out2[0].tolist()[:1] == [1] and (out2[0][1:] == -1).all()
+
+
+def test_alpha_dropout_preserves_moments():
+    key = jax.random.PRNGKey(0)
+    x = jnp.asarray(rng.standard_normal(20000).astype(np.float32))
+    y = np.asarray(op("alpha_dropout")(x, key, p=0.1))
+    assert abs(y.mean() - np.asarray(x).mean()) < 0.05
+    assert abs(y.std() - np.asarray(x).std()) < 0.1
+    np.testing.assert_array_equal(np.asarray(op("alpha_dropout")(x, None)),
+                                  np.asarray(x))
+
+
+def test_sparse_to_dense_and_fused_bn():
+    idx = jnp.asarray([[0, 1], [2, 0]])
+    dense = np.asarray(op("sparse_to_dense")(idx, (3, 2),
+                                             jnp.asarray([5.0, 7.0])))
+    assert dense[0, 1] == 5.0 and dense[2, 0] == 7.0
+    x = jnp.asarray(rng.random((2, 4, 4, 3)).astype(np.float32))
+    y, m, v = op("fused_batch_norm")(x, jnp.ones(3), jnp.zeros(3))
+    assert y.shape == x.shape and m.shape == (3,) and v.shape == (3,)
+    np.testing.assert_allclose(np.asarray(y).mean(axis=(0, 1, 2)), 0.0,
+                               atol=1e-4)
+    # batch_var output is Bessel-corrected (TF contract), n = 2*4*4 = 32
+    np.testing.assert_allclose(
+        np.asarray(v),
+        np.asarray(x).reshape(-1, 3).var(0, ddof=1), rtol=1e-5)
