@@ -6,8 +6,11 @@ Replaces the reference's ~500 libnd4j declarable ops
 jax/lax lowerings: each entry is a pure function over jnp arrays; XLA fuses
 and differentiates them, so there are no hand-written `doDiff` rules.
 
-Only ops touched by the baseline configs + test suite are present (SURVEY.md
-§7 'hard parts (a)'); the registry is open — `register_op` adds more.
+The registry covers 400+ of the reference's declarable inventory —
+elementwise/reduction/linalg/segment/scatter/image/FFT/random/bitwise/
+distance/set/updater/morphology/loss families (SURVEY.md §7 'hard parts
+(a)' started minimal; rounds widen it) — and is open: `register_op` adds
+more.
 """
 from __future__ import annotations
 
@@ -1501,7 +1504,9 @@ def _dilation2d(x, filt, stride=(1, 1), padding="SAME"):
         oh, ow = -(-H // sh), -(-W // sw)
         ph = max((oh - 1) * sh + kh - H, 0)
         pw = max((ow - 1) * sw + kw - W, 0)
-        neg = jnp.finfo(x.dtype).min
+        neg = (jnp.finfo(x.dtype).min
+               if jnp.issubdtype(x.dtype, jnp.floating)
+               else jnp.iinfo(x.dtype).min)
         x = jnp.pad(x, ((0, 0), (ph // 2, ph - ph // 2),
                         (pw // 2, pw - pw // 2), (0, 0)),
                     constant_values=neg)
@@ -1697,9 +1702,13 @@ def _alpha_dropout(x, rng, p=0.05):
 
 @register_op("sparse_to_dense")
 def _sparse_to_dense(indices, shape, values, default_value=0.0):
+    """TF SparseToDense: indices are [N, ndims], or a plain [N] vector of
+    positions when the output is 1-D."""
     out = jnp.full(tuple(shape), default_value,
                    values.dtype if hasattr(values, "dtype")
                    else jnp.float32)
+    if indices.ndim == 1:
+        return out.at[indices].set(values)
     return out.at[tuple(jnp.moveaxis(indices, -1, 0))].set(values)
 
 

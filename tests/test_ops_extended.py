@@ -691,3 +691,18 @@ def test_sparse_to_dense_and_fused_bn():
     np.testing.assert_allclose(
         np.asarray(v),
         np.asarray(x).reshape(-1, 3).var(0, ddof=1), rtol=1e-5)
+
+
+def test_dilation2d_integer_dtypes():
+    x = jnp.asarray(rng.integers(0, 255, (1, 5, 5, 1)), jnp.int32)
+    f = jnp.zeros((3, 3, 1), jnp.int32)
+    out = np.asarray(op("dilation2d")(x, f))
+    assert out.shape == (1, 5, 5, 1)
+    # center output = window max of the input
+    assert out[0, 2, 2, 0] == np.asarray(x)[0, 1:4, 1:4, 0].max()
+
+
+def test_sparse_to_dense_1d():
+    out = np.asarray(op("sparse_to_dense")(
+        jnp.asarray([0, 2]), (4,), jnp.asarray([5.0, 7.0])))
+    np.testing.assert_allclose(out, [5, 0, 7, 0])
