@@ -224,10 +224,59 @@ def bench_lstm_charlm(batch=64, steps=10, t=64, vocab=77):
     return batch * t * steps / dt
 
 
+def _wait_for_backend(max_wait_s=1800.0, retry_every_s=120.0):
+    """Bounded probe-retry for the TPU backend.
+
+    On this host the axon tunnel can be down for hours; `jax.devices()`
+    then blocks forever inside `make_c_api_client` (it does not raise), so
+    the backend must be probed in a subprocess with a hard timeout.  Re-
+    probes every `retry_every_s` for up to `max_wait_s` so the bench can
+    catch a tunnel-up window during the driver's run.  Returns the device
+    count (>=1) on success; on final failure prints a structured JSON
+    error line to stdout and returns 0.
+    """
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from __graft_entry__ import _probe_backend_device_count
+    t0 = time.monotonic()
+    probes = 0
+    while True:
+        probes += 1
+        n = _probe_backend_device_count()
+        if n >= 1:
+            return n
+        elapsed = time.monotonic() - t0
+        if n < 0:  # probe died fast — non-transient, retrying is pointless
+            print(json.dumps({
+                "metric": "resnet50_train_samples_per_sec_per_chip",
+                "value": None,
+                "unit": "samples/sec/chip",
+                "error": "backend probe failed hard (broken jax install "
+                         "or platform plugin?) — not retrying",
+            }))
+            return 0
+        if elapsed + retry_every_s > max_wait_s:
+            print(json.dumps({
+                "metric": "resnet50_train_samples_per_sec_per_chip",
+                "value": None,
+                "unit": "samples/sec/chip",
+                "error": (f"TPU backend unreachable: {probes} probes over "
+                          f"{elapsed / 60:.1f} min (axon tunnel down); "
+                          "no measurement possible"),
+            }))
+            return 0
+        print(f"[bench] backend unreachable (probe {probes}); retrying in "
+              f"{retry_every_s:.0f}s ({(max_wait_s - elapsed) / 60:.0f} min "
+              "left in budget)", file=sys.stderr, flush=True)
+        time.sleep(retry_every_s)
+
+
 def main():
     quick = "--quick" in sys.argv
+    n_chips = _wait_for_backend()
+    if n_chips == 0:
+        sys.exit(1)
     import jax
-    n_chips = max(len(jax.devices()), 1)
     print(f"devices: {jax.devices()}", file=sys.stderr)
 
     if quick:
