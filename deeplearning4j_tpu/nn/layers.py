@@ -582,19 +582,30 @@ class Upsampling2DLayer(Layer):
 
 @dataclasses.dataclass(kw_only=True)
 class ZeroPaddingLayer(Layer):
-    """Spatial zero padding (reference `ZeroPaddingLayer`)."""
+    """Spatial zero padding (reference `ZeroPaddingLayer`).  `padding`
+    accepts an int, a symmetric (ph, pw) pair, or per-side
+    ((top, bottom), (left, right)) — the Keras ZeroPadding2D forms."""
 
     padding: Any = (1, 1)
     REGULARIZABLE: Tuple[str, ...] = ()
 
+    def _sides(self):
+        ph, pw = _pair(self.padding) if not (
+            isinstance(self.padding, (tuple, list))
+            and len(self.padding) == 2
+            and isinstance(self.padding[0], (tuple, list))) else self.padding
+        top, bot = _pair(ph)
+        left, right = _pair(pw)
+        return (int(top), int(bot)), (int(left), int(right))
+
     def initialize(self, rng, input_type, dtype=jnp.float32):
         h, w, c = input_type.shape
-        ph, pw = _pair(self.padding)
-        return {}, {}, InputType.convolutional(h + 2 * ph, w + 2 * pw, c)
+        (t, b), (le, r) = self._sides()
+        return {}, {}, InputType.convolutional(h + t + b, w + le + r, c)
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
-        ph, pw = _pair(self.padding)
-        return jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0))), state
+        (t, b), (le, r) = self._sides()
+        return jnp.pad(x, ((0, 0), (t, b), (le, r), (0, 0))), state
 
 
 # ---------------------------------------------------------------------------
@@ -697,7 +708,7 @@ class LayerNormalizationLayer(Layer):
         return {"gamma": jnp.ones((c,), dtype), "beta": jnp.zeros((c,), dtype)}, {}, input_type
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
-        mean = jnp.mean(x, axis=-1, keepdims=True)
-        var = jnp.var(x, axis=-1, keepdims=True)
-        y = (x - mean) / jnp.sqrt(var + self.eps)
-        return y * params["gamma"] + params["beta"], state
+        # measured dispatch: Pallas fused LayerNorm on TPU, jnp otherwise
+        from deeplearning4j_tpu.ops.norm_kernels import fused_layer_norm
+        return fused_layer_norm(x, params["gamma"], params["beta"],
+                                self.eps), state

@@ -140,13 +140,20 @@ blockwise_attention.defvjp(_bw_fwd, _bw_bwd)
 # Pallas TPU kernel
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc,
-                  *, block_q: int, block_k: int, nkv: int, causal: bool,
-                  scale: float):
+def _flash_kernel(q_ref, k_ref, v_ref, *rest,
+                  block_q: int, block_k: int, nkv: int, causal: bool,
+                  scale: float, has_mask: bool):
     """3D grid (batch*head, q-block, kv-block): Pallas pipelines the KV
     block fetches (double-buffered HBM→VMEM) while online-softmax state
     lives in VMEM scratch across the kv dimension.  Emits per-row
-    logsumexp for the backward kernels."""
+    logsumexp for the backward kernels.  With ``has_mask`` an additive
+    f32 bias block [1, 1, bk] (0 keep / NEG_INF drop over KV positions)
+    precedes the outputs."""
+    if has_mask:
+        bias_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc = rest
+    else:
+        o_ref, lse_ref, acc_sc, m_sc, l_sc = rest
+        bias_ref = None
     qi = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -165,6 +172,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc,
         kj = k_ref[0]                                      # [bk, D]
         vj = v_ref[0]
         s = jnp.dot(q, kj.T, preferred_element_type=jnp.float32) * scale
+        if has_mask:
+            s = s + bias_ref[0]                            # [1,bk] → rows
         if causal:
             rows = (qi * block_q
                     + jax.lax.broadcasted_iota(jnp.int32,
@@ -189,12 +198,20 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc,
         lse_ref[0] = m_sc[...] + jnp.log(l)                # [bq, 1]
 
 
+def _mask_bias3(mask, B, S):
+    """[B, S] 1/0 keep-mask → additive f32 bias [B, 1, S] for the kernels."""
+    return jnp.where(mask.reshape(B, S) > 0, 0.0, NEG_INF).astype(
+        jnp.float32).reshape(B, 1, S)
+
+
 def flash_attention_tpu(q, k, v, causal=False, scale=None,
                         block_q=256, block_k=256, interpret=False,
-                        return_lse=False):
+                        return_lse=False, mask=None):
     """Pallas flash-attention forward.  [B, H, T, D]; T divisible by the
     block sizes (dispatcher checks).  With ``return_lse`` also returns the
-    row logsumexp [B*H, T] (f32) for the backward kernels."""
+    row logsumexp [B*H, T] (f32) for the backward kernels.  ``mask``:
+    optional [B, S] 1/0 keep-mask over KV positions (padding/segment
+    mask), shared across heads."""
     B, H, T, D = q.shape
     S = k.shape[2]
     if scale is None:
@@ -205,16 +222,26 @@ def flash_attention_tpu(q, k, v, causal=False, scale=None,
     qf = q.reshape(B * H, T, D)
     kf = k.reshape(B * H, S, D)
     vf = v.reshape(B * H, S, D)
+    has_mask = mask is not None
     kernel = functools.partial(_flash_kernel, block_q=bq, block_k=bk,
-                               nkv=nkv, causal=causal, scale=scale)
+                               nkv=nkv, causal=causal, scale=scale,
+                               has_mask=has_mask)
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+    ]
+    inputs = [qf, kf, vf]
+    if has_mask:
+        # bias [B, 1, S]: per-batch, shared across the H heads folded into
+        # grid dim 0 — the index map divides the head out
+        in_specs.append(pl.BlockSpec((1, 1, bk),
+                                     lambda b, i, j, H=H: (b // H, 0, j)))
+        inputs.append(_mask_bias3(mask, B, S))
     out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, T // bq, nkv),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             # lse rides a trailing singleton lane dim — (1, bq, 1) blocks
@@ -231,17 +258,23 @@ def flash_attention_tpu(q, k, v, causal=False, scale=None,
             pltpu.VMEM((bq, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*inputs)
     out = out.reshape(B, H, T, D)
     return (out, lse.reshape(B * H, T)) if return_lse else out
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, dq_sc, *, block_q: int, block_k: int,
-                         nkv: int, causal: bool, scale: float):
+                         *rest, block_q: int, block_k: int,
+                         nkv: int, causal: bool, scale: float,
+                         has_mask: bool):
     """dQ over grid (batch*head, q-block, kv-block): recompute P from the
     saved logsumexp (no [T,T] materialization), accumulate dS·K in
     scratch."""
+    if has_mask:
+        bias_ref, dq_ref, dq_sc = rest
+    else:
+        dq_ref, dq_sc = rest
+        bias_ref = None
     qi = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -260,6 +293,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         kj = k_ref[0]                                      # [bk, D]
         vj = v_ref[0]
         s = jnp.dot(q, kj.T, preferred_element_type=jnp.float32) * scale
+        if has_mask:
+            s = s + bias_ref[0]
         if causal:
             rows = (qi * block_q
                     + jax.lax.broadcasted_iota(jnp.int32,
@@ -280,10 +315,16 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, dk_sc, dv_sc, *, block_q: int,
-                          block_k: int, nq: int, causal: bool, scale: float):
+                          *rest, block_q: int,
+                          block_k: int, nq: int, causal: bool, scale: float,
+                          has_mask: bool):
     """dK/dV over grid (batch*head, kv-block, q-block): recompute P,
     accumulate P^T·dO and dS^T·Q in scratch."""
+    if has_mask:
+        bias_ref, dk_ref, dv_ref, dk_sc, dv_sc = rest
+    else:
+        dk_ref, dv_ref, dk_sc, dv_sc = rest
+        bias_ref = None
     ji = pl.program_id(1)
     i = pl.program_id(2)
 
@@ -304,6 +345,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse_i = lse_ref[0]                                 # [bq, 1]
         delta_i = delta_ref[0]
         s = jnp.dot(qi, kj.T, preferred_element_type=jnp.float32) * scale
+        if has_mask:
+            s = s + bias_ref[0]
         if causal:
             rows = (i * block_q
                     + jax.lax.broadcasted_iota(jnp.int32,
@@ -327,7 +370,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def flash_attention_bwd_tpu(q, k, v, out, lse, g, causal=False, scale=None,
-                            block_q=256, block_k=256, interpret=False):
+                            block_q=256, block_k=256, interpret=False,
+                            mask=None):
     """Pallas flash-attention backward (FlashAttention-2 style): delta
     precomputed on-device, then separate dQ and dK/dV kernels so both
     matmul passes stay on the MXU without [T,T] materialization."""
@@ -348,10 +392,18 @@ def flash_attention_bwd_tpu(q, k, v, out, lse, g, causal=False, scale=None,
     delta3 = delta.reshape(B * H, T, 1)
     nkv = S // bk
     nq = T // bq
+    has_mask = mask is not None
+    extra_in, extra_specs_ij, extra_specs_ji = [], [], []
+    if has_mask:
+        extra_in = [_mask_bias3(mask, B, S)]
+        extra_specs_ij = [pl.BlockSpec((1, 1, bk),
+                                       lambda b, i, j, H=H: (b // H, 0, j))]
+        extra_specs_ji = [pl.BlockSpec((1, 1, bk),
+                                       lambda b, j, i, H=H: (b // H, 0, j))]
 
     dq_kernel = functools.partial(_flash_bwd_dq_kernel, block_q=bq,
                                   block_k=bk, nkv=nkv, causal=causal,
-                                  scale=scale)
+                                  scale=scale, has_mask=has_mask)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(B * H, nq, nkv),
@@ -362,16 +414,16 @@ def flash_attention_bwd_tpu(q, k, v, out, lse, g, causal=False, scale=None,
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
-        ],
+        ] + extra_specs_ij,
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, gf, lse3, delta3)
+    )(qf, kf, vf, gf, lse3, delta3, *extra_in)
 
     dkv_kernel = functools.partial(_flash_bwd_dkv_kernel, block_q=bq,
                                    block_k=bk, nq=nq, causal=causal,
-                                   scale=scale)
+                                   scale=scale, has_mask=has_mask)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(B * H, nkv, nq),
@@ -382,7 +434,7 @@ def flash_attention_bwd_tpu(q, k, v, out, lse, g, causal=False, scale=None,
             pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
-        ],
+        ] + extra_specs_ji,
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
@@ -396,26 +448,29 @@ def flash_attention_bwd_tpu(q, k, v, out, lse, g, causal=False, scale=None,
             pltpu.VMEM((bk, D), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf, gf, lse3, delta3)
+    )(qf, kf, vf, gf, lse3, delta3, *extra_in)
     return (dq.reshape(B, H, T, D), dk.reshape(B, H, S, D),
             dv.reshape(B, H, S, D))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention_diff(q, k, v, causal, scale, block_q=256, block_k=256):
-    return flash_attention_tpu(q, k, v, causal, scale, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_attention_diff(q, k, v, mask, causal, scale, block_q=256,
+                          block_k=256):
+    return flash_attention_tpu(q, k, v, causal, scale, block_q, block_k,
+                               mask=mask)
 
 
-def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
+def _fa_fwd(q, k, v, mask, causal, scale, block_q, block_k):
     out, lse = flash_attention_tpu(q, k, v, causal, scale, block_q, block_k,
-                                   return_lse=True)
-    return out, (q, k, v, out, lse)
+                                   return_lse=True, mask=mask)
+    return out, (q, k, v, mask, out, lse)
 
 
 def _fa_bwd(causal, scale, block_q, block_k, res, g):
-    q, k, v, out, lse = res
-    return flash_attention_bwd_tpu(q, k, v, out, lse, g, causal, scale,
-                                   block_q, block_k)
+    q, k, v, mask, out, lse = res
+    dq, dk, dv = flash_attention_bwd_tpu(q, k, v, out, lse, g, causal, scale,
+                                         block_q, block_k, mask=mask)
+    return dq, dk, dv, None
 
 
 _flash_attention_diff.defvjp(_fa_fwd, _fa_bwd)
@@ -438,23 +493,27 @@ _XLA_SCORE_BYTES_MAX = 2 << 30   # beyond ~2GB of scores, never take XLA path
 def fused_attention(q, k, v, mask=None, causal=False, scale=None):
     """Dispatcher (the platform-helper pattern — cuDNN-attention role):
 
-    - TPU, unmasked, tiling shapes, long seq → Pallas flash kernels
-      (fwd + true FlashAttention-2-style bwd, O(T) memory).
+    - TPU, tiling shapes, long seq → Pallas flash kernels (fwd + true
+      FlashAttention-2-style bwd, O(T) memory), with [B, S] padding/
+      segment masks supported in-kernel (additive bias per KV tile).
     - short seq / small scores → XLA-fused naive path (measured fastest
       on v5e below ~2k).
-    - masked or non-tiling → blockwise scan (O(T) memory), or XLA path
-      when scores are small.
+    - non-tiling → blockwise scan (O(T) memory), or XLA path when
+      scores are small.
 
     Differentiable everywhere."""
     on_tpu = jax.default_backend() == "tpu"
     B, H, T, D = q.shape
     S = k.shape[2]
     score_bytes = B * H * T * S * q.dtype.itemsize
-    if on_tpu and mask is None and D % 64 == 0 and max(T, S) >= _FLASH_MIN_SEQ:
+    mask_ok = mask is None or (mask.ndim == 2 and mask.shape == (B, S))
+    if (on_tpu and mask_ok and D % 64 == 0
+            and max(T, S) >= _FLASH_MIN_SEQ):
         bq = _pick_block(T, 512)
         bk = _pick_block(S, 1024)
         if bq and bk:
-            return _flash_attention_diff(q, k, v, causal, scale, bq, bk)
+            return _flash_attention_diff(q, k, v, mask, causal, scale,
+                                         bq, bk)
     if score_bytes <= _XLA_SCORE_BYTES_MAX:
         return mha_reference(q, k, v, mask, causal, scale)
     return blockwise_attention(q, k, v, mask, causal, scale)

@@ -22,10 +22,13 @@ from jax.experimental import pallas as pl
 
 
 def layer_norm_reference(x, gain, bias=None, eps: float = 1e-5):
-    """The canonical jnp layer norm (single impl: autodiff.ops)."""
-    from deeplearning4j_tpu.autodiff.ops import OP_TABLE
-    args = (x, gain) if bias is None else (x, gain, bias)
-    return OP_TABLE["layer_norm"](*args, eps=eps)
+    """The canonical jnp layer norm over the last axis (the plain impl the
+    registry op and the Pallas kernel both validate against — standalone so
+    the op can dispatch here without a circular import)."""
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + eps) * gain
+    return y if bias is None else y + bias
 
 
 # -- forward kernel ---------------------------------------------------------
