@@ -19,12 +19,16 @@ from typing import Callable, Dict
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 OP_TABLE: Dict[str, Callable] = {}
 
 
 def register_op(name: str, fn: Callable = None):
+    if name in OP_TABLE:
+        raise ValueError(f"op {name!r} already registered — duplicate "
+                         "registrations inflate the op-inventory count")
     if fn is None:
         def deco(f):
             OP_TABLE[name] = f
@@ -88,9 +92,6 @@ register_op("gte", lambda a, b: (a >= b))
 register_op("lt", lambda a, b: (a < b))
 register_op("lte", lambda a, b: (a <= b))
 register_op("where", jnp.where)
-register_op("logical_and", jnp.logical_and)
-register_op("logical_or", jnp.logical_or)
-register_op("logical_not", jnp.logical_not)
 register_op("isnan", jnp.isnan)
 register_op("isinf", jnp.isinf)
 
@@ -175,6 +176,11 @@ def _linear(x, w, b=None):
 
 @register_op("layer_norm")
 def _layer_norm(x, gain, bias=None, eps=1e-5, axis=-1):
+    if axis in (-1, x.ndim - 1):
+        # measured dispatch: Pallas fused kernel on TPU for big tiling
+        # shapes, plain jnp otherwise (norm_kernels._LN_MIN_ROWS policy)
+        from deeplearning4j_tpu.ops.norm_kernels import fused_layer_norm
+        return fused_layer_norm(x, gain, bias, eps)
     mean = jnp.mean(x, axis=axis, keepdims=True)
     var = jnp.var(x, axis=axis, keepdims=True)
     y = (x - mean) / jnp.sqrt(var + eps) * gain
@@ -912,18 +918,20 @@ def _hsv_to_rgb(x):
     return jnp.stack([r, g, b], axis=-1)
 
 
-_YIQ = jnp.asarray([[0.299, 0.587, 0.114],
-                    [0.5959, -0.2746, -0.3213],
-                    [0.2115, -0.5227, 0.3112]], jnp.float32)
-register_op("rgb_to_yiq", lambda x: x @ _YIQ.T.astype(x.dtype))
+# Kept as numpy (not jnp): a module-level jnp constant would initialize
+# the JAX backend at import time, before callers can select a platform.
+_YIQ = np.asarray([[0.299, 0.587, 0.114],
+                   [0.5959, -0.2746, -0.3213],
+                   [0.2115, -0.5227, 0.3112]], np.float32)
+register_op("rgb_to_yiq", lambda x: x @ jnp.asarray(_YIQ.T, x.dtype))
 register_op("yiq_to_rgb", lambda x:
-            x @ jnp.linalg.inv(_YIQ).T.astype(x.dtype))
-_YUV = jnp.asarray([[0.299, 0.587, 0.114],
-                    [-0.14714119, -0.28886916, 0.43601035],
-                    [0.61497538, -0.51496512, -0.10001026]], jnp.float32)
-register_op("rgb_to_yuv", lambda x: x @ _YUV.T.astype(x.dtype))
+            x @ jnp.asarray(np.linalg.inv(_YIQ).T, x.dtype))
+_YUV = np.asarray([[0.299, 0.587, 0.114],
+                   [-0.14714119, -0.28886916, 0.43601035],
+                   [0.61497538, -0.51496512, -0.10001026]], np.float32)
+register_op("rgb_to_yuv", lambda x: x @ jnp.asarray(_YUV.T, x.dtype))
 register_op("yuv_to_rgb", lambda x:
-            x @ jnp.linalg.inv(_YUV).T.astype(x.dtype))
+            x @ jnp.asarray(np.linalg.inv(_YUV).T, x.dtype))
 
 
 @register_op("adjust_hue")
@@ -957,10 +965,18 @@ def _crop_and_resize(image, boxes, box_indices, crop_size,
         y1, x1, y2, x2 = box[0], box[1], box[2], box[3]
         img = image[bi]
         h, w = image.shape[1], image.shape[2]
-        ys = y1 * (h - 1) + jnp.arange(ch) / max(ch - 1, 1) \
-            * (y2 - y1) * (h - 1)
-        xs = x1 * (w - 1) + jnp.arange(cw) / max(cw - 1, 1) \
-            * (x2 - x1) * (w - 1)
+        # size-1 crops sample the box CENTER (TF CropAndResize contract),
+        # not the top-left corner
+        if ch == 1:
+            ys = (y1 + y2) / 2 * (h - 1) + jnp.zeros(1)
+        else:
+            ys = y1 * (h - 1) + jnp.arange(ch) / (ch - 1) \
+                * (y2 - y1) * (h - 1)
+        if cw == 1:
+            xs = (x1 + x2) / 2 * (w - 1) + jnp.zeros(1)
+        else:
+            xs = x1 * (w - 1) + jnp.arange(cw) / (cw - 1) \
+                * (x2 - x1) * (w - 1)
         if method == "nearest":
             yi = jnp.clip(jnp.round(ys).astype(jnp.int32), 0, h - 1)
             xi = jnp.clip(jnp.round(xs).astype(jnp.int32), 0, w - 1)
@@ -1201,7 +1217,12 @@ register_op("norm_fro", lambda a: jnp.linalg.norm(a))
 # ---- compare / classification helpers (reference compat/** + parity) ----
 @register_op("is_max")
 def _is_max(a, axis=-1):
-    return (a == jnp.max(a, axis=axis, keepdims=True)).astype(a.dtype)
+    # exactly ONE element marked per slice (reference IsMax contract);
+    # argmax breaks value ties toward the lower index
+    idx = jnp.argmax(a, axis=axis)
+    n = a.shape[axis]
+    onehot = jax.nn.one_hot(idx, n, dtype=a.dtype)
+    return jnp.moveaxis(onehot, -1, axis)
 
 
 @register_op("in_top_k")
@@ -1533,13 +1554,19 @@ def _max_pool_with_argmax(x, kernel=(2, 2), stride=(2, 2),
 
     def both(xv, iv):
         # max-reduce values and carry the argmax index alongside
-        init = (jnp.asarray(-jnp.inf, xv.dtype),
-                jnp.asarray(-1, iv.dtype))
+        if jnp.issubdtype(xv.dtype, jnp.integer):
+            lowest = jnp.iinfo(xv.dtype).min
+        else:
+            lowest = -jnp.inf
+        # index sentinel = int max so value ties resolve to the real
+        # (smaller) index, matching TF's lowest-index contract
+        init = (jnp.asarray(lowest, xv.dtype),
+                jnp.asarray(jnp.iinfo(iv.dtype).max, iv.dtype))
 
         def reducer(a, b):
             av, ai = a
             bv, bi = b
-            take_b = bv > av
+            take_b = (bv > av) | ((bv == av) & (bi < ai))
             return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
 
         return lax.reduce_window(
@@ -1795,3 +1822,534 @@ def _ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0):
                       alpha, jnp.maximum(last - 1, 0)[:, None],
                       axis=1)[:, 0], NEG))
     return -final
+
+
+# ---- gradient compression (reference libnd4j .../compression/
+# threshold_encoding.cpp — the encode_threshold/decode_threshold declarable
+# ops behind SharedTrainingMaster's compressed-DP path).  In-graph jnp
+# forms with STATIC capacity (jit-compatible); the host-side C++ codec
+# (native_ops.ThresholdCodec) adds residual carry-over for the transport
+# path and is bit-compatible on the wire format: int32 sign-in-index
+# codes ±(idx+1), 0 = padding. ----
+
+@register_op("encode_threshold")
+def _encode_threshold(grad, threshold=1e-3, max_elements=None):
+    """Flattened sparse threshold encoding: the first `max_elements`
+    entries (in index order) with |g| >= threshold become ±(idx+1)."""
+    v = grad.reshape(-1)
+    n = v.shape[0]
+    if max_elements is None:
+        max_elements = n
+    keep = jnp.abs(v) >= threshold
+    # stable order-preserving compaction: non-kept slots sort to the end
+    order_key = jnp.where(keep, jnp.arange(n), n)
+    first = jnp.sort(order_key)[:max_elements]
+    valid = first < n
+    idx = jnp.where(valid, first, 0)
+    code = jnp.sign(v[idx]).astype(jnp.int32) * (idx.astype(jnp.int32) + 1)
+    return jnp.where(valid, code, 0)
+
+
+@register_op("decode_threshold")
+def _decode_threshold(encoded, size, threshold=1e-3):
+    """Inverse: scatter-add ±threshold at |code|-1; 0 codes are padding."""
+    e = encoded.astype(jnp.int32)
+    idx = jnp.clip(jnp.abs(e) - 1, 0, size - 1)
+    val = jnp.sign(e).astype(jnp.float32) * threshold
+    return jnp.zeros((size,), jnp.float32).at[idx].add(val)
+
+
+# ---- round-3 declarable-op tail (reference libnd4j
+# include/ops/declarable/generic/** families not yet covered: parity/
+# transforms/nn/compat/image/quantization exotica) ----
+
+register_op("stop_gradient", lax.stop_gradient)
+register_op("invert_permutation", lambda p: jnp.argsort(p))
+register_op("divide_no_nan", lambda a, b:
+            jnp.where(b == 0, 0.0, a / jnp.where(b == 0, 1.0, b)))
+register_op("lbeta", lambda x:
+            jnp.sum(jax.scipy.special.gammaln(x), axis=-1)
+            - jax.scipy.special.gammaln(jnp.sum(x, axis=-1)))
+register_op("bucketize", lambda x, boundaries:
+            jnp.searchsorted(jnp.asarray(boundaries), x, side="right")
+            .astype(jnp.int32))
+register_op("truncated_normal", lambda rng, shape, mean=0.0, stddev=1.0,
+            dtype="float32": mean + stddev * jax.random.truncated_normal(
+                _key(rng), -2.0, 2.0, tuple(shape), jnp.dtype(dtype)))
+register_op("random_randint", lambda rng, shape, minval, maxval:
+            jax.random.randint(_key(rng), tuple(shape), minval, maxval))
+@register_op("cyclic_shift_right")
+def _cyclic_shift_right(x, n):
+    # rotate on the UNSIGNED view: arithmetic right-shift on signed
+    # dtypes sign-extends and corrupts the rotation; n is taken mod the
+    # bit width so n=0 never emits an undefined full-width shift
+    bits = x.dtype.itemsize * 8
+    n = n % bits
+    u = x.view(jnp.dtype(f"uint{bits}")) if jnp.issubdtype(
+        x.dtype, jnp.signedinteger) else x
+    r = jnp.bitwise_or(jnp.right_shift(u, n),
+                       jnp.left_shift(u, (bits - n) % bits))
+    return r.view(x.dtype) if r.dtype != x.dtype else r
+register_op("xw_plus_b", lambda x, w, b: x @ w + b)
+register_op("relu_layer", lambda x, w, b: jax.nn.relu(x @ w + b))
+register_op("reverse", lambda x, axes:
+            jnp.flip(x, axis=tuple(axes) if isinstance(axes, (list, tuple))
+                     else int(axes)))
+register_op("mergemaxindex", lambda *xs:
+            jnp.argmax(jnp.stack(xs), axis=0).astype(jnp.int32))
+
+
+@register_op("dynamic_partition")
+def _dynamic_partition(data, partitions, num_partitions):
+    """TF DynamicPartition (ragged outputs — host-side op, not jittable;
+    the reference's op is likewise host-orchestrated)."""
+    import numpy as onp
+    data = onp.asarray(data)
+    partitions = onp.asarray(partitions)
+    return tuple(jnp.asarray(data[partitions == i])
+                 for i in range(num_partitions))
+
+
+@register_op("sufficient_statistics")
+def _sufficient_statistics(x, axes, shift=None):
+    """TF nn.sufficient_statistics: (count, mean_ss, var_ss, shift)."""
+    axes = _axis_tuple(axes)
+    count = 1
+    for a in axes:
+        count *= x.shape[a]
+    xs = x if shift is None else x - shift
+    m_ss = jnp.sum(xs, axis=axes)
+    v_ss = jnp.sum(xs * xs, axis=axes)
+    return jnp.asarray(count, x.dtype), m_ss, v_ss, shift
+
+
+@register_op("compare_and_bitpack")
+def _compare_and_bitpack(x, threshold):
+    """TF CompareAndBitpack: pack groups of 8 (x > threshold) bits into
+    uint8, MSB first."""
+    bits = (x > threshold).astype(jnp.uint8)
+    b8 = bits.reshape(bits.shape[:-1] + (bits.shape[-1] // 8, 8))
+    weights = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], jnp.uint8)
+    return jnp.sum(b8 * weights, axis=-1).astype(jnp.uint8)
+
+
+@register_op("fake_quant_with_min_max_args")
+def _fake_quant_args(x, min=-6.0, max=6.0, num_bits=8, narrow_range=False):
+    """Quantize-dequantize through an affine int grid (reference
+    fake_quant_with_min_max_vars.cpp; TF nudged-range semantics)."""
+    qmin = 1.0 if narrow_range else 0.0
+    qmax = float(2 ** num_bits - 1)
+    scale = (max - min) / (qmax - qmin)
+    zero = qmin - min / scale
+    nudged_zero = jnp.clip(jnp.round(zero), qmin, qmax)
+    nudged_min = (qmin - nudged_zero) * scale
+    nudged_max = (qmax - nudged_zero) * scale
+    clamped = jnp.clip(x, nudged_min, nudged_max)
+    return (jnp.round((clamped - nudged_min) / scale) * scale
+            + nudged_min).astype(x.dtype)
+
+
+register_op("fake_quant_with_min_max_vars", lambda x, min, max, num_bits=8,
+            narrow_range=False: _fake_quant_args(
+                x, jnp.asarray(min), jnp.asarray(max), num_bits,
+                narrow_range))
+
+
+@register_op("pnorm_pool2d")
+def _pnorm_pool2d(x, kernel=(2, 2), stride=(2, 2), p=2, padding="VALID"):
+    """P-norm pooling (reference pnormpool2d / SubsamplingLayer PNORM)."""
+    kh, kw = kernel
+    s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add,
+                          (1, kh, kw, 1), (1,) + tuple(stride) + (1,),
+                          padding)
+    return s ** (1.0 / p)
+
+
+@register_op("upsampling3d")
+def _upsampling3d(x, size=2):
+    sd_, sh, sw = (size, size, size) if isinstance(size, int) else size
+    x = jnp.repeat(x, sd_, axis=1)
+    x = jnp.repeat(x, sh, axis=2)
+    return jnp.repeat(x, sw, axis=3)
+
+
+@register_op("resize_area")
+def _resize_area(a, size):
+    """TF area resize: exact box-average for integer downscale (the common
+    case); bilinear fallback otherwise."""
+    H, W = a.shape[-3], a.shape[-2]
+    h2, w2 = size
+    if H % h2 == 0 and W % w2 == 0:
+        fh, fw = H // h2, W // w2
+        s = a.shape
+        r = a.reshape(s[:-3] + (h2, fh, w2, fw, s[-1]))
+        return r.mean(axis=(-4, -2)).astype(a.dtype)
+    return jax.image.resize(a, a.shape[:-3] + (h2, w2, a.shape[-1]),
+                            "linear").astype(a.dtype)
+
+
+@register_op("non_max_suppression_overlaps")
+def _nms_overlaps(overlaps, scores, max_output_size,
+                  overlap_threshold=0.5, score_threshold=-jnp.inf):
+    """Greedy NMS on a precomputed [N,N] overlap matrix (reference
+    non_max_suppression_overlaps.cpp); fixed-size -1-padded output."""
+    n = overlaps.shape[0]
+    live = scores > score_threshold
+
+    def body(state, _):
+        live_, sc = state
+        best = jnp.argmax(jnp.where(live_, sc, -jnp.inf))
+        ok = live_[best]
+        live_ = live_ & (overlaps[best] <= overlap_threshold)
+        live_ = live_.at[best].set(False)
+        return (live_, sc), jnp.where(ok, best, -1)
+
+    (_, _), picked = lax.scan(body, (live, scores), None,
+                              length=max_output_size)
+    return picked
+
+
+@register_op("draw_bounding_boxes")
+def _draw_bounding_boxes(images, boxes, colors=None):
+    """[B,H,W,C] images + [B,N,4] normalized (y1,x1,y2,x2) boxes -> 1px
+    box outlines (reference generic/images/draw_bounding_boxes.cpp)."""
+    B, H, W, C = images.shape
+    N = boxes.shape[1]
+    if colors is None:
+        colors = jnp.ones((1, C), images.dtype)
+    colors = jnp.asarray(colors, images.dtype)
+    rows = jnp.arange(H)[:, None]
+    cols = jnp.arange(W)[None, :]
+
+    def one_image(img, bxs):
+        def one_box(img, i):
+            y1, x1, y2, x2 = (bxs[i, 0] * (H - 1), bxs[i, 1] * (W - 1),
+                              bxs[i, 2] * (H - 1), bxs[i, 3] * (W - 1))
+            inside = ((rows >= jnp.floor(y1)) & (rows <= jnp.ceil(y2))
+                      & (cols >= jnp.floor(x1)) & (cols <= jnp.ceil(x2)))
+            edge_r = ((jnp.abs(rows - jnp.round(y1)) < 1)
+                      | (jnp.abs(rows - jnp.round(y2)) < 1))
+            edge_c = ((jnp.abs(cols - jnp.round(x1)) < 1)
+                      | (jnp.abs(cols - jnp.round(x2)) < 1))
+            mask = inside & (edge_r | edge_c)
+            col = colors[i % colors.shape[0]]
+            return jnp.where(mask[..., None], col, img), None
+
+        img, _ = lax.scan(one_box, img, jnp.arange(N))
+        return img
+
+    return jax.vmap(one_image)(images, boxes)
+
+
+@register_op("conv1d")
+def _conv1d(x, w, stride=1, padding="SAME", dilation=1):
+    """[B,T,Ci] x [K,Ci,Co] temporal conv via conv_general_dilated."""
+    return lax.conv_general_dilated(
+        x, w, (stride,), padding, rhs_dilation=(dilation,),
+        dimension_numbers=("NWC", "WIO", "NWC"))
+
+
+@register_op("max_pooling1d")
+def _max_pooling1d(x, kernel=2, stride=2, padding="VALID"):
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        lowest = jnp.iinfo(x.dtype).min
+    else:
+        lowest = -jnp.inf
+    return lax.reduce_window(x, lowest, lax.max, (1, kernel, 1),
+                             (1, stride, 1), padding)
+
+
+@register_op("avg_pooling1d")
+def _avg_pooling1d(x, kernel=2, stride=2, padding="VALID"):
+    s = lax.reduce_window(x, 0.0, lax.add, (1, kernel, 1), (1, stride, 1),
+                          padding)
+    n = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, (1, kernel, 1),
+                          (1, stride, 1), padding)
+    return s / n
+
+
+register_op("pointwise_conv2d", lambda x, w:
+            jnp.einsum("bhwi,io->bhwo", x, w.reshape(w.shape[-2:])))
+
+
+@register_op("separable_conv2d")
+def _separable_conv2d(x, w_depth, w_point, stride=(1, 1), padding="SAME"):
+    """Depthwise [Kh,Kw,Ci,M] then pointwise [1,1,Ci*M,Co] (reference
+    sconv2d.cpp)."""
+    ci = x.shape[-1]
+    d = lax.conv_general_dilated(
+        x, w_depth.reshape(w_depth.shape[0], w_depth.shape[1], 1, -1),
+        tuple(stride), padding, feature_group_count=ci,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return jnp.einsum("bhwi,io->bhwo", d,
+                      w_point.reshape(-1, w_point.shape[-1]))
+
+
+@register_op("deconv3d")
+def _deconv3d(x, w, stride=(1, 1, 1), padding="SAME"):
+    """[B,D,H,W,Ci] x [Kd,Kh,Kw,Ci,Co] transpose conv (reference
+    deconv3d.cpp)."""
+    return lax.conv_transpose(x, w, tuple(stride), padding,
+                              dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+
+
+@register_op("lstm_layer")
+def _lstm_layer(x, w_ih, w_hh, b=None, h0=None, c0=None):
+    """Full-sequence LSTM via lax.scan of lstm_cell (reference lstmLayer
+    declarable op; cuDNN-LSTM role).  x: [B,T,F] -> [B,T,H]."""
+    Bsz, T, _ = x.shape
+    H = w_hh.shape[0]
+    h = jnp.zeros((Bsz, H), x.dtype) if h0 is None else h0
+    c = jnp.zeros((Bsz, H), x.dtype) if c0 is None else c0
+    cell = OP_TABLE["lstm_cell"]
+
+    def step(carry, xt):
+        h_, c_ = carry
+        h_new, c_new = cell(xt, h_, c_, w_ih, w_hh, b)
+        return (h_new, c_new), h_new
+
+    (h, c), ys = lax.scan(step, (h, c), jnp.swapaxes(x, 0, 1))
+    return jnp.swapaxes(ys, 0, 1), h, c
+
+
+@register_op("space_to_batch_nd")
+def _space_to_batch_nd(x, block_shape, paddings):
+    """General ND form (reference space_to_batch_nd.cpp)."""
+    nb = len(block_shape)
+    pads = [(0, 0)] + [tuple(p) for p in paddings] \
+        + [(0, 0)] * (x.ndim - 1 - nb)
+    x = jnp.pad(x, pads)
+    B = x.shape[0]
+    spatial = x.shape[1:1 + nb]
+    rest = x.shape[1 + nb:]
+    shape = [B]
+    for s, b in zip(spatial, block_shape):
+        shape += [s // b, b]
+    x = x.reshape(shape + list(rest))
+    block_axes = [2 + 2 * i for i in range(nb)]
+    grid_axes = [1 + 2 * i for i in range(nb)]
+    rest_axes = list(range(1 + 2 * nb, x.ndim))
+    x = x.transpose(block_axes + [0] + grid_axes + rest_axes)
+    prod_b = 1
+    for b in block_shape:
+        prod_b *= b
+    return x.reshape([prod_b * B] + [s // b for s, b in
+                                     zip(spatial, block_shape)]
+                     + list(rest))
+
+
+@register_op("batch_to_space_nd")
+def _batch_to_space_nd(x, block_shape, crops):
+    nb = len(block_shape)
+    prod_b = 1
+    for b in block_shape:
+        prod_b *= b
+    B = x.shape[0] // prod_b
+    spatial = x.shape[1:1 + nb]
+    rest = x.shape[1 + nb:]
+    x = x.reshape(list(block_shape) + [B] + list(spatial) + list(rest))
+    perm = [nb]
+    for i in range(nb):
+        perm += [nb + 1 + i, i]
+    perm += list(range(1 + 2 * nb, x.ndim))
+    x = x.transpose(perm)
+    x = x.reshape([B] + [s * b for s, b in zip(spatial, block_shape)]
+                  + list(rest))
+    slices = [slice(None)]
+    for (c0, c1), s, b in zip([tuple(c) for c in crops], spatial,
+                              block_shape):
+        slices.append(slice(c0, s * b - c1))
+    return x[tuple(slices)]
+
+
+@register_op("ctc_beam_decode")
+def _ctc_beam_decode(log_probs, input_lengths, beam_width=8, blank=0):
+    """CTC prefix beam search (reference ctc_beam.cpp) — host-side numpy
+    decode (ragged, data-dependent; not a jit op, same as the reference's
+    CPU-only helper).  log_probs: [B,T,C]; returns list of label lists."""
+    import numpy as onp
+    lp = onp.asarray(log_probs)
+    lens = onp.asarray(input_lengths).astype(onp.int64)
+    results = []
+    NEG = -1e30
+
+    def lse(a, b):
+        m = max(a, b)
+        if m <= NEG:
+            return NEG
+        return m + onp.log(onp.exp(a - m) + onp.exp(b - m))
+
+    for b in range(lp.shape[0]):
+        # beams: prefix tuple -> (p_blank, p_nonblank)
+        beams = {(): (0.0, NEG)}
+        for t in range(int(lens[b])):
+            new = {}
+
+            def add(prefix, pb, pnb):
+                opb, opnb = new.get(prefix, (NEG, NEG))
+                new[prefix] = (lse(opb, pb), lse(opnb, pnb))
+
+            for prefix, (pb, pnb) in beams.items():
+                for c in range(lp.shape[2]):
+                    p = float(lp[b, t, c])
+                    if c == blank:
+                        add(prefix, lse(pb, pnb) + p, NEG)
+                    elif prefix and prefix[-1] == c:
+                        add(prefix, NEG, pnb + p)          # repeat merges
+                        add(prefix + (c,), NEG, pb + p)    # after blank
+                    else:
+                        add(prefix + (c,), NEG, lse(pb, pnb) + p)
+            beams = dict(sorted(new.items(),
+                                key=lambda kv: -lse(*kv[1]))[:beam_width])
+        best = max(beams.items(), key=lambda kv: lse(*kv[1]))[0]
+        results.append(list(best))
+    return results
+
+
+# ---- round-3 tail, part 2: parity/compat/tsne exotica (reference
+# generic/parity_ops/**, generic/compat/**, helpers/cpu/BarnesHutTsne) ----
+
+register_op("erfinv", lambda x: lax.erf_inv(x))
+register_op("polyval", lambda coeffs, x: jnp.polyval(jnp.asarray(coeffs), x))
+register_op("is_non_decreasing", lambda x:
+            jnp.all(jnp.diff(x.reshape(-1)) >= 0))
+register_op("is_strictly_increasing", lambda x:
+            jnp.all(jnp.diff(x.reshape(-1)) > 0))
+register_op("is_numeric_tensor", lambda x:
+            jnp.issubdtype(x.dtype, jnp.number))
+register_op("unravel_index", lambda indices, shape:
+            jnp.stack(jnp.unravel_index(indices, tuple(shape)), axis=0))
+
+
+@register_op("eig")
+def _eig(a):
+    """General (non-symmetric) eigendecomposition — CPU-only in XLA, the
+    same host-bound role the reference's lapack path has."""
+    import numpy as onp
+    w, v = onp.linalg.eig(onp.asarray(a))
+    return jnp.asarray(w), jnp.asarray(v)
+
+
+@register_op("hashcode")
+def _hashcode(x):
+    """Deterministic int64 tensor hash (reference parity op `hashcode` —
+    value-dependent checksum; exact constant differs, contract is
+    determinism over content)."""
+    b = jnp.asarray(x).reshape(-1)
+    if jnp.issubdtype(b.dtype, jnp.floating):
+        b = b.astype(jnp.float32).view(jnp.int32)
+    b = b.astype(jnp.int64)
+    n = b.shape[0]
+    mult = jnp.asarray(31, jnp.int64) ** (jnp.arange(n, dtype=jnp.int64)
+                                          % 16)
+    return jnp.sum(b * mult)
+
+
+@register_op("choose")
+def _choose(x, comparable, mode=0):
+    """Filter elements by scalar comparison (reference compat `choose`:
+    mode 0 '<', 1 '<=', 2 '>', 3 '>=', 4 '=='); ragged result — host-side
+    numpy op.  Returns (filtered values, count)."""
+    import numpy as onp
+    xv = onp.asarray(x).reshape(-1)
+    c = float(comparable)
+    sel = {0: xv < c, 1: xv <= c, 2: xv > c, 3: xv >= c,
+           4: xv == c}[int(mode)]
+    kept = xv[sel]
+    return jnp.asarray(kept), jnp.asarray(kept.size, jnp.int32)
+
+
+@register_op("broadcast_dynamic_shape")
+def _broadcast_dynamic_shape(s1, s2):
+    import numpy as onp
+    return jnp.asarray(
+        onp.broadcast_shapes(tuple(onp.asarray(s1).astype(int)),
+                             tuple(onp.asarray(s2).astype(int))),
+        jnp.int32)
+
+
+@register_op("broadcast_gradient_args")
+def _broadcast_gradient_args(s1, s2):
+    """Reduction axes each operand's gradient needs after broadcasting
+    (TF BroadcastGradientArgs / reference compat op) — host-side."""
+    import numpy as onp
+    a = list(onp.asarray(s1).astype(int))
+    b = list(onp.asarray(s2).astype(int))
+    n = max(len(a), len(b))
+    a = [1] * (n - len(a)) + a
+    b = [1] * (n - len(b)) + b
+    ra = [i for i in range(n) if a[i] == 1 and b[i] != 1]
+    rb = [i for i in range(n) if b[i] == 1 and a[i] != 1]
+    return (jnp.asarray(ra, jnp.int32), jnp.asarray(rb, jnp.int32))
+
+
+register_op("knn_mindistance", lambda lowest, highest, point:
+            jnp.sqrt(jnp.sum(jnp.maximum(
+                jnp.maximum(lowest - point, 0.0),
+                jnp.maximum(point - highest, 0.0)) ** 2, axis=-1)))
+register_op("cell_contains", lambda corner, width, point:
+            jnp.all((point >= corner - width / 2)
+                    & (point <= corner + width / 2), axis=-1))
+
+
+@register_op("barnes_gains")
+def _barnes_gains(gains, grad, step):
+    """t-SNE gain update (reference BarnesHutTsne helpers): gain + 0.2
+    where grad and step disagree in sign, gain * 0.8 where they agree,
+    floored at 0.01."""
+    agree = jnp.sign(grad) == jnp.sign(step)
+    return jnp.maximum(jnp.where(agree, gains * 0.8, gains + 0.2), 0.01)
+
+
+@register_op("barnes_symmetrize")
+def _barnes_symmetrize(row_p, col_p, val_p, n):
+    """Symmetrize a CSR sparse affinity matrix: (P + P^T) / 2 (reference
+    barnes_symmetrized op) — host-side, returns CSR triple."""
+    import numpy as onp
+    from scipy.sparse import csr_matrix
+    rp = onp.asarray(row_p).astype(onp.int64)
+    cp = onp.asarray(col_p).astype(onp.int64)
+    vp = onp.asarray(val_p).astype(onp.float64)
+    m = csr_matrix((vp, cp, rp), shape=(int(n), int(n)))
+    s = ((m + m.T) * 0.5).tocsr()
+    return (jnp.asarray(s.indptr.astype(onp.int32)),
+            jnp.asarray(s.indices.astype(onp.int32)),
+            jnp.asarray(s.data.astype(onp.float32)))
+
+
+@register_op("barnes_edge_forces")
+def _barnes_edge_forces(row_p, col_p, val_p, y):
+    """t-SNE attractive edge forces: F_i = sum_j P_ij (1+||yi-yj||^2)^-1
+    (yi-yj) over the sparse neighbor lists (reference barnes_edge_forces)
+    — host-side numpy."""
+    import numpy as onp
+    rp = onp.asarray(row_p).astype(onp.int64)
+    cp = onp.asarray(col_p).astype(onp.int64)
+    vp = onp.asarray(val_p).astype(onp.float64)
+    yv = onp.asarray(y).astype(onp.float64)
+    out = onp.zeros_like(yv)
+    for i in range(yv.shape[0]):
+        js = cp[rp[i]:rp[i + 1]]
+        ws = vp[rp[i]:rp[i + 1]]
+        if js.size == 0:
+            continue
+        d = yv[i] - yv[js]
+        q = 1.0 / (1.0 + onp.sum(d * d, axis=1))
+        out[i] = onp.sum((ws * q)[:, None] * d, axis=0)
+    return jnp.asarray(out.astype(onp.float32))
+
+
+@register_op("multi_head_dot_product_attention")
+def _mhdpa(q, k, v, wq, wk, wv, wo, mask=None, scaled=True):
+    """Reference `multi_head_dot_product_attention` declarable op
+    (generic/nn/multi_head_dot_product_attention.cpp): project [B,T,F]
+    inputs per head, run fused attention, re-project.  Head count comes
+    from wq's leading dim: wq [H, dk, F]."""
+    from deeplearning4j_tpu.ops.attention_kernels import fused_attention
+    H = wq.shape[0]
+    def proj(x, w):                          # [B,T,F] x [H,dh,F]
+        return jnp.einsum("btf,hdf->bhtd", x, w)
+    qh, kh, vh = proj(q, wq), proj(k, wk), proj(v, wv)
+    scale = None if scaled else 1.0
+    ctx = fused_attention(qh, kh, vh, mask=mask, scale=scale)  # [B,H,T,dv]
+    return jnp.einsum("bhtd,ohd->bto", ctx, wo)

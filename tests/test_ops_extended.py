@@ -706,3 +706,270 @@ def test_sparse_to_dense_1d():
     out = np.asarray(op("sparse_to_dense")(
         jnp.asarray([0, 2]), (4,), jnp.asarray([5.0, 7.0])))
     np.testing.assert_allclose(out, [5, 0, 7, 0])
+
+
+def test_encode_decode_threshold_roundtrip_vs_native_codec():
+    """Graph-op forms are wire-compatible with the host C++ codec
+    (reference threshold_encoding.cpp round-trip)."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.autodiff.ops import OP_TABLE
+    from deeplearning4j_tpu.native_ops import ThresholdCodec
+    rng = np.random.RandomState(0)
+    g = (rng.randn(256).astype(np.float32) * 2e-3)
+    thr = 1e-3
+    enc = np.asarray(OP_TABLE["encode_threshold"](jnp.asarray(g), thr))
+    # codec with zero residual produces the same code stream (order and
+    # sign-in-index format), modulo trailing zero padding
+    codec = ThresholdCodec(g.size, threshold=thr)
+    ref = codec.encode(g)
+    nz = enc[enc != 0]
+    np.testing.assert_array_equal(nz, ref)
+    # decode: graph op == codec decode
+    dec = np.asarray(OP_TABLE["decode_threshold"](jnp.asarray(enc), g.size,
+                                                  thr))
+    ref_dec = ThresholdCodec(g.size, threshold=thr).decode(ref)
+    np.testing.assert_allclose(dec, ref_dec, atol=0)
+    # every decoded entry is ±thr at positions where |g| >= thr
+    np.testing.assert_array_equal(dec != 0, np.abs(g) >= thr)
+    # jit-compatible with static capacity
+    import jax
+    f = jax.jit(lambda x: OP_TABLE["encode_threshold"](x, thr, 64))
+    enc64 = np.asarray(f(jnp.asarray(g)))
+    assert enc64.shape == (64,)
+    np.testing.assert_array_equal(enc64[enc64 != 0], ref[:np.sum(enc64 != 0)])
+
+
+# ---- round-3 op tail ----
+
+def test_round3_elementwise_and_misc_ops():
+    assert np.allclose(op("divide_no_nan")(jnp.asarray([1.0, 2.0]),
+                                           jnp.asarray([0.0, 4.0])),
+                       [0.0, 0.5])
+    p = jnp.asarray([2, 0, 1])
+    np.testing.assert_array_equal(op("invert_permutation")(p), [1, 2, 0])
+    x = jnp.asarray([0.5, 1.5, 2.5, 10.0])
+    np.testing.assert_array_equal(
+        op("bucketize")(x, [1.0, 2.0, 3.0]), [0, 1, 2, 3])
+    # lbeta vs scipy identity: B(a,b) = G(a)G(b)/G(a+b)
+    from scipy.special import betaln
+    ab = np.asarray([[2.0, 3.0], [0.5, 0.5]])
+    np.testing.assert_allclose(op("lbeta")(jnp.asarray(ab)),
+                               betaln(ab[:, 0], ab[:, 1]), rtol=1e-5)
+    g = jax.grad(lambda a: jnp.sum(op("stop_gradient")(a) * a))(
+        jnp.asarray([3.0]))
+    np.testing.assert_allclose(g, [3.0])   # only the non-stopped factor
+    np.testing.assert_array_equal(
+        op("mergemaxindex")(jnp.asarray([1.0, 5.0]),
+                            jnp.asarray([2.0, 1.0])), [1, 0])
+    np.testing.assert_array_equal(
+        op("reverse")(jnp.arange(6).reshape(2, 3), [0, 1]),
+        np.arange(6).reshape(2, 3)[::-1, ::-1])
+
+
+def test_round3_quantization_ops():
+    x = jnp.asarray([-10.0, -1.0, 0.0, 0.3, 5.9, 10.0])
+    q = np.asarray(op("fake_quant_with_min_max_args")(x, min=-6.0, max=6.0))
+    # output lies on the quantization grid within the nudged range
+    scale = 12.0 / 255.0
+    np.testing.assert_allclose((q - q.min()) / scale,
+                               np.round((q - q.min()) / scale), atol=1e-4)
+    assert q.min() >= -6.1 and q.max() <= 6.1
+    q2 = np.asarray(op("fake_quant_with_min_max_vars")(
+        x, jnp.asarray(-6.0), jnp.asarray(6.0)))
+    np.testing.assert_allclose(q, q2)
+    bits = op("compare_and_bitpack")(
+        jnp.asarray([1.0, -1.0, 1.0, -1.0, -1.0, -1.0, -1.0, 1.0]), 0.0)
+    np.testing.assert_array_equal(bits, [0b10100001])
+
+
+def test_round3_pooling_conv_ops():
+    x = jnp.asarray(rng.standard_normal((2, 8, 8, 3)).astype(np.float32))
+    pn = op("pnorm_pool2d")(x, (2, 2), (2, 2), p=2)
+    want = np.sqrt((np.asarray(x).reshape(2, 4, 2, 4, 2, 3) ** 2)
+                   .sum(axis=(2, 4)))
+    np.testing.assert_allclose(np.asarray(pn), want, rtol=1e-5)
+    xt = jnp.asarray(rng.standard_normal((2, 10, 4)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((3, 4, 6)).astype(np.float32))
+    y = op("conv1d")(xt, w, padding="VALID")
+    assert y.shape == (2, 8, 6)
+    # oracle: manual sliding dot
+    want0 = sum(np.asarray(xt)[0, i:i + 3].reshape(-1)
+                @ np.asarray(w).reshape(-1, 6) for i in [0])[None]
+    np.testing.assert_allclose(np.asarray(y)[0, 0], want0[0], rtol=1e-4)
+    mp = op("max_pooling1d")(xt, 2, 2)
+    np.testing.assert_allclose(
+        np.asarray(mp), np.asarray(xt).reshape(2, 5, 2, 4).max(2),
+        rtol=1e-6)
+    ap = op("avg_pooling1d")(xt, 2, 2)
+    np.testing.assert_allclose(
+        np.asarray(ap), np.asarray(xt).reshape(2, 5, 2, 4).mean(2),
+        rtol=1e-6)
+    # separable == depthwise then 1x1 (oracle via conv2d on each channel)
+    xi = jnp.asarray(rng.standard_normal((1, 6, 6, 2)).astype(np.float32))
+    wd = jnp.asarray(rng.standard_normal((3, 3, 2, 1)).astype(np.float32))
+    wp = jnp.asarray(rng.standard_normal((1, 1, 2, 4)).astype(np.float32))
+    ys = op("separable_conv2d")(xi, wd, wp, padding="VALID")
+    assert ys.shape == (1, 4, 4, 4)
+    yd = op("depthwise_conv2d")(xi, jnp.reshape(wd, (3, 3, 1, 2)),
+                               padding="VALID")
+    np.testing.assert_allclose(
+        np.asarray(ys),
+        np.einsum("bhwi,io->bhwo", np.asarray(yd),
+                  np.asarray(wp).reshape(2, 4)), rtol=1e-4)
+
+
+def test_round3_space_batch_nd_roundtrip():
+    x = jnp.asarray(rng.standard_normal((2, 6, 4, 3)).astype(np.float32))
+    y = op("space_to_batch_nd")(x, [2, 2], [[1, 1], [0, 0]])
+    assert y.shape == (8, 4, 2, 3)
+    back = op("batch_to_space_nd")(y, [2, 2], [[1, 1], [0, 0]])
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x), atol=0)
+
+
+def test_round3_image_ops():
+    a = jnp.asarray(rng.standard_normal((1, 8, 8, 2)).astype(np.float32))
+    area = op("resize_area")(a, (4, 4))
+    np.testing.assert_allclose(
+        np.asarray(area),
+        np.asarray(a).reshape(1, 4, 2, 4, 2, 2).mean(axis=(2, 4)),
+        rtol=1e-6)
+    img = jnp.zeros((1, 8, 8, 3), jnp.float32)
+    boxes = jnp.asarray([[[0.25, 0.25, 0.75, 0.75]]])
+    drawn = np.asarray(op("draw_bounding_boxes")(img, boxes))
+    assert drawn.sum() > 0 and drawn[0, 0, 0].sum() == 0  # corner untouched
+    ov = jnp.asarray([[1.0, 0.9, 0.0], [0.9, 1.0, 0.0], [0.0, 0.0, 1.0]])
+    sc = jnp.asarray([0.9, 0.8, 0.7])
+    picked = np.asarray(op("non_max_suppression_overlaps")(ov, sc, 3, 0.5))
+    np.testing.assert_array_equal(picked, [0, 2, -1])
+
+
+def test_round3_rnn_layer_ops():
+    B, T, F, H = 2, 5, 3, 4
+    x = jnp.asarray(rng.standard_normal((B, T, F)).astype(np.float32))
+    w_ih = jnp.asarray(rng.standard_normal((F, 4 * H)).astype(np.float32)
+                       * 0.3)
+    w_hh = jnp.asarray(rng.standard_normal((H, 4 * H)).astype(np.float32)
+                       * 0.3)
+    ys, h, c = op("lstm_layer")(x, w_ih, w_hh)
+    assert ys.shape == (B, T, H)
+    # oracle: manual cell loop
+    hh = np.zeros((B, H), np.float32)
+    cc = np.zeros((B, H), np.float32)
+    for t in range(T):
+        hh, cc = (np.asarray(v) for v in
+                  OP_TABLE["lstm_cell"](x[:, t], jnp.asarray(hh),
+                                        jnp.asarray(cc), w_ih, w_hh))
+    np.testing.assert_allclose(np.asarray(ys[:, -1]), hh, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(h), hh, rtol=1e-5)
+
+
+def test_round3_ctc_beam_decode():
+    # easy case: beam agrees with greedy collapse
+    B, T, C = 1, 6, 4
+    logits = np.full((B, T, C), -5.0, np.float32)
+    path = [1, 1, 0, 2, 2, 3]
+    for t, c in enumerate(path):
+        logits[0, t, c] = 0.0
+    lp = jnp.asarray(logits - np.log(np.exp(logits).sum(-1, keepdims=True)))
+    out = op("ctc_beam_decode")(lp, jnp.asarray([T]), beam_width=4)
+    assert out == [[1, 2, 3]]
+
+
+def test_round3_random_and_partition_ops():
+    import jax.random as jr
+    key = jr.PRNGKey(0)
+    tn = np.asarray(op("truncated_normal")(key, (2000,), 0.0, 1.0))
+    assert np.abs(tn).max() <= 2.0 + 1e-6
+    ri = np.asarray(op("random_randint")(key, (1000,), 3, 7))
+    assert ri.min() >= 3 and ri.max() <= 6
+    parts = op("dynamic_partition")(
+        jnp.asarray([10., 20., 30., 40.]), jnp.asarray([1, 0, 1, 0]), 2)
+    np.testing.assert_allclose(np.asarray(parts[0]), [20., 40.])
+    np.testing.assert_allclose(np.asarray(parts[1]), [10., 30.])
+    cnt, mss, vss, _ = op("sufficient_statistics")(
+        jnp.asarray(np.arange(12, dtype=np.float32).reshape(3, 4)), (0,))
+    assert float(cnt) == 3
+    np.testing.assert_allclose(np.asarray(mss),
+                               np.arange(12.).reshape(3, 4).sum(0))
+    x8 = jnp.asarray([0b10110001], jnp.uint8)  # placeholder usage check
+    _ = x8
+    np.testing.assert_array_equal(
+        np.asarray(op("cyclic_shift_right")(jnp.asarray([2], jnp.uint8),
+                                            1)), [1])
+
+
+def test_round3b_parity_ops():
+    from scipy.special import erfinv as sp_erfinv
+    x = jnp.asarray([0.1, -0.5, 0.9])
+    np.testing.assert_allclose(np.asarray(op("erfinv")(x)),
+                               sp_erfinv(np.asarray(x)), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(op("polyval")([2.0, 0.0, 1.0], jnp.asarray([3.0]))),
+        [19.0])
+    assert bool(op("is_non_decreasing")(jnp.asarray([1., 1., 2.])))
+    assert not bool(op("is_strictly_increasing")(jnp.asarray([1., 1.])))
+    assert op("is_numeric_tensor")(jnp.asarray([1.0]))
+    ui = np.asarray(op("unravel_index")(jnp.asarray([5, 7]), (2, 4)))
+    np.testing.assert_array_equal(ui, [[1, 1], [1, 3]])
+    h1 = int(op("hashcode")(jnp.asarray([1.0, 2.0])))
+    h2 = int(op("hashcode")(jnp.asarray([1.0, 2.0])))
+    h3 = int(op("hashcode")(jnp.asarray([1.0, 2.1])))
+    assert h1 == h2 and h1 != h3
+    vals, cnt = op("choose")(jnp.asarray([1., 5., 3., 0.]), 2.5, mode=2)
+    np.testing.assert_allclose(np.asarray(vals), [5., 3.])
+    assert int(cnt) == 2
+    np.testing.assert_array_equal(
+        np.asarray(op("broadcast_dynamic_shape")(jnp.asarray([2, 1]),
+                                                 jnp.asarray([3]))), [2, 3])
+    ra, rb = op("broadcast_gradient_args")(jnp.asarray([2, 1]),
+                                           jnp.asarray([2, 3]))
+    np.testing.assert_array_equal(np.asarray(ra), [1])
+    np.testing.assert_array_equal(np.asarray(rb), [])
+
+
+def test_round3b_tsne_and_knn_ops():
+    g = op("barnes_gains")(jnp.asarray([1.0, 1.0, 0.012]),
+                           jnp.asarray([1.0, -1.0, 1.0]),
+                           jnp.asarray([1.0, 1.0, 1.0]))
+    np.testing.assert_allclose(np.asarray(g), [0.8, 1.2, 0.01], rtol=1e-6)
+    # symmetrize a tiny CSR matrix: P[0,1]=1 -> (P+P^T)/2 has 0.5 both ways
+    rp, cp, vp = op("barnes_symmetrize")(jnp.asarray([0, 1, 1]),
+                                         jnp.asarray([1]),
+                                         jnp.asarray([1.0]), 2)
+    from scipy.sparse import csr_matrix
+    m = csr_matrix((np.asarray(vp), np.asarray(cp), np.asarray(rp)),
+                   shape=(2, 2)).toarray()
+    np.testing.assert_allclose(m, [[0, 0.5], [0.5, 0]])
+    y = jnp.asarray([[0.0, 0.0], [1.0, 0.0]])
+    f = np.asarray(op("barnes_edge_forces")(jnp.asarray([0, 1, 2]),
+                                            jnp.asarray([1, 0]),
+                                            jnp.asarray([1.0, 1.0]), y))
+    # symmetric points: equal/opposite attraction, q = 1/(1+1) = 0.5
+    np.testing.assert_allclose(f, [[-0.5, 0.0], [0.5, 0.0]], rtol=1e-6)
+    d = op("knn_mindistance")(jnp.asarray([0.0, 0.0]),
+                              jnp.asarray([1.0, 1.0]),
+                              jnp.asarray([2.0, 0.5]))
+    np.testing.assert_allclose(float(d), 1.0)
+    assert bool(op("cell_contains")(jnp.asarray([0.0, 0.0]),
+                                    jnp.asarray([2.0, 2.0]),
+                                    jnp.asarray([0.5, -0.5])))
+
+
+def test_round3b_multi_head_attention_op():
+    B, T, F, H, dh = 2, 4, 8, 2, 4
+    r = np.random.RandomState(0)
+    q = jnp.asarray(r.randn(B, T, F).astype(np.float32) * 0.3)
+    wq = jnp.asarray(r.randn(H, dh, F).astype(np.float32) * 0.3)
+    wk = jnp.asarray(r.randn(H, dh, F).astype(np.float32) * 0.3)
+    wv = jnp.asarray(r.randn(H, dh, F).astype(np.float32) * 0.3)
+    wo = jnp.asarray(r.randn(F, H, dh).astype(np.float32) * 0.3)
+    out = op("multi_head_dot_product_attention")(q, q, q, wq, wk, wv, wo)
+    assert out.shape == (B, T, F)
+    # oracle: naive per-head attention
+    from deeplearning4j_tpu.ops.attention_kernels import mha_reference
+    qh = np.einsum("btf,hdf->bhtd", q, wq)
+    kh = np.einsum("btf,hdf->bhtd", q, wk)
+    vh = np.einsum("btf,hdf->bhtd", q, wv)
+    ctx = mha_reference(jnp.asarray(qh), jnp.asarray(kh), jnp.asarray(vh))
+    want = np.einsum("bhtd,ohd->bto", np.asarray(ctx), wo)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4, atol=1e-5)
