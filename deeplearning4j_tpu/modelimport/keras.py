@@ -23,14 +23,16 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from deeplearning4j_tpu.nn import (
-    ActivationLayer, BatchNormalizationLayer, ComputationGraph,
-    Convolution1DLayer, ConvolutionLayer, Deconvolution2DLayer, DenseLayer,
-    DepthwiseConvolution2DLayer, DropoutLayer, ElementWiseVertex,
-    EmbeddingSequenceLayer, GlobalPoolingLayer, GraphBuilder, InputType,
-    LastTimeStep, Layer, LayerNormalizationLayer, LSTM, MergeVertex,
-    MultiLayerNetwork, NeuralNetConfiguration, OutputLayer,
-    SeparableConvolution2DLayer, SimpleRnn, SubsamplingLayer,
-    Upsampling2DLayer, ZeroPaddingLayer)
+    ActivationLayer, BatchNormalizationLayer, Bidirectional,
+    ComputationGraph, Convolution1DLayer, ConvolutionLayer,
+    Deconvolution2DLayer, DenseLayer, DepthwiseConvolution2DLayer,
+    DropoutLayer, ElementWiseVertex, EmbeddingSequenceLayer,
+    GlobalPoolingLayer, GraphBuilder, InputType, LastTimeStep, Layer,
+    LayerNormalizationLayer, LSTM, MergeVertex, MultiLayerNetwork,
+    NeuralNetConfiguration, OutputLayer, PermuteLayer, RepeatVectorLayer,
+    FlattenLayer, ReshapeLayer, SeparableConvolution2DLayer, SimpleRnn,
+    SubsamplingLayer,
+    TimeDistributed, Upsampling2DLayer, ZeroPaddingLayer)
 
 
 class UnsupportedKerasConfigurationException(Exception):
@@ -119,6 +121,24 @@ def _dropout(cfg, is_output):
     return DropoutLayer(dropout=1.0 - cfg["rate"])
 
 
+def _spatial_dropout(cfg, is_output):
+    import warnings
+    warnings.warn(
+        "SpatialDropout imported as elementwise Dropout: inference is "
+        "identical, but fine-tuning drops elements rather than whole "
+        "channels (different regularization than Keras)", stacklevel=2)
+    return _dropout(cfg, is_output)
+
+
+def _gaussian_reg_skip(cfg, is_output):
+    import warnings
+    warnings.warn(
+        "GaussianNoise/GaussianDropout imported as a structural no-op: "
+        "inference is identical, but fine-tuning trains without the "
+        "Gaussian regularization Keras applied", stacklevel=2)
+    return None
+
+
 def _activation(cfg, is_output):
     return ActivationLayer(activation=_act(cfg["activation"]))
 
@@ -146,6 +166,57 @@ def _simplernn(cfg, is_output):
     if not cfg.get("return_sequences", False):
         return LastTimeStep(underlying=layer)
     return layer
+
+
+def _bidirectional(cfg, is_output):
+    """Keras `Bidirectional` wrapper (reference `KerasBidirectional`):
+    inner recurrent layer run both ways; merge_mode concat/sum/mul/ave;
+    return_sequences=False maps to our `return_last` semantics."""
+    inner_lc = cfg["layer"]
+    inner_cls = inner_lc["class_name"]
+    if inner_cls not in ("LSTM", "SimpleRNN"):
+        raise UnsupportedKerasConfigurationException(
+            f"Bidirectional over unsupported inner layer '{inner_cls}'")
+    inner_cfg = dict(inner_lc["config"])
+    ret_seq = inner_cfg.get("return_sequences", False)
+    inner_cfg["return_sequences"] = True      # we take last step ourselves
+    inner = LAYER_MAP[inner_cls](inner_cfg, False)
+    mode = {"concat": "CONCAT", "sum": "ADD", "mul": "MUL",
+            "ave": "AVERAGE"}.get(cfg.get("merge_mode", "concat"))
+    if mode is None:
+        raise UnsupportedKerasConfigurationException(
+            f"Bidirectional merge_mode {cfg.get('merge_mode')!r}")
+    return Bidirectional(fwd=inner, mode=mode, return_last=not ret_seq)
+
+
+def _time_distributed(cfg, is_output):
+    """Keras `TimeDistributed` (reference `KerasTimeDistributed`): inner
+    feed-forward layer applied per timestep."""
+    inner_lc = cfg["layer"]
+    inner_cls = inner_lc["class_name"]
+    if inner_cls not in LAYER_MAP:
+        raise UnsupportedKerasConfigurationException(
+            f"TimeDistributed over unsupported inner layer '{inner_cls}'")
+    inner = LAYER_MAP[inner_cls](inner_lc["config"], False)
+    return TimeDistributed(underlying=inner)
+
+
+def _reshape(cfg, is_output):
+    return ReshapeLayer(target_shape=tuple(cfg["target_shape"]))
+
+
+def _permute(cfg, is_output):
+    return PermuteLayer(dims=tuple(cfg["dims"]))
+
+
+def _repeat_vector(cfg, is_output):
+    return RepeatVectorLayer(n=cfg["n"])
+
+
+def _flatten(cfg, is_output):
+    # a real layer (not a skip): after recurrent/TimeDistributed outputs
+    # the downstream Dense must see feed-forward [B, T*F], not [B, T, F]
+    return FlattenLayer()
 
 
 def _zeropad(cfg, is_output):
@@ -296,10 +367,11 @@ LAYER_MAP: Dict[str, Callable] = {
     "Dropout": _dropout,
     # spatial dropouts approximate as elementwise dropout: identical at
     # inference; training drops elements rather than whole channels
-    "SpatialDropout1D": _dropout,
-    "SpatialDropout2D": _dropout,
-    "GaussianNoise": _skip,         # inference no-op
-    "GaussianDropout": _skip,       # inference no-op
+    # (a warning is emitted at import time — see converters)
+    "SpatialDropout1D": _spatial_dropout,
+    "SpatialDropout2D": _spatial_dropout,
+    "GaussianNoise": _gaussian_reg_skip,    # inference no-op, warns
+    "GaussianDropout": _gaussian_reg_skip,  # inference no-op, warns
     "Activation": _activation,
     "LeakyReLU": _leaky_relu,
     "ELU": _elu_layer,
@@ -310,8 +382,13 @@ LAYER_MAP: Dict[str, Callable] = {
     "ZeroPadding2D": _zeropad,
     "Cropping2D": _cropping2d,
     "UpSampling2D": _upsample,
-    "Flatten": _skip,
+    "Flatten": _flatten,
     "InputLayer": _skip,
+    "Bidirectional": _bidirectional,
+    "TimeDistributed": _time_distributed,
+    "Reshape": _reshape,
+    "Permute": _permute,
+    "RepeatVector": _repeat_vector,
 }
 
 
@@ -325,8 +402,9 @@ def register_keras_layer(class_name: str, converter: Callable):
 # ---------------------------------------------------------------------------
 
 def _layer_weights(h5, layer_name: str) -> Dict[str, np.ndarray]:
-    """Collect datasets under model_weights/<layer> keyed by trailing path
-    component (handles both Keras-2 `kernel:0` and Keras-3 nested paths)."""
+    """Collect datasets under model_weights/<layer> keyed by FULL relative
+    path (handles both Keras-2 `kernel:0` and Keras-3 nested paths; the
+    path prefix disambiguates Bidirectional forward/backward sublayers)."""
     import h5py
     out = {}
     if layer_name not in h5["model_weights"]:
@@ -334,11 +412,15 @@ def _layer_weights(h5, layer_name: str) -> Dict[str, np.ndarray]:
 
     def visit(name, obj):
         if isinstance(obj, h5py.Dataset):
-            key = name.split("/")[-1].split(":")[0]
-            out[key] = np.asarray(obj)
+            out[name.split(":")[0]] = np.asarray(obj)
 
     h5["model_weights"][layer_name].visititems(visit)
     return out
+
+
+def _flat_w(pw: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Path-keyed weights -> trailing-component keys (kernel, bias, ...)."""
+    return {p.split("/")[-1]: v for p, v in pw.items()}
 
 
 def _reorder_lstm_gates(k: np.ndarray, H: int) -> np.ndarray:
@@ -347,11 +429,55 @@ def _reorder_lstm_gates(k: np.ndarray, H: int) -> np.ndarray:
     return np.concatenate([i, f, o, c], axis=-1)
 
 
-def _set_weights(net, name: str, layer: Layer, w: Dict[str, np.ndarray]):
+def _copy_rnn_weights(dst, il, w):
+    """Copy one direction's Keras RNN weights into our param dict."""
+    if isinstance(il, LSTM):
+        H = il.n_out
+        dst["W"] = _reorder_lstm_gates(w["kernel"], H)
+        dst["RW"] = _reorder_lstm_gates(w["recurrent_kernel"], H)
+        dst["b"] = _reorder_lstm_gates(w["bias"], H)
+    else:                                                  # SimpleRnn
+        dst["W"] = w["kernel"]
+        dst["RW"] = w["recurrent_kernel"]
+        dst["b"] = w["bias"]
+
+
+def _set_weights(net, name: str, layer: Layer, pw: Dict[str, np.ndarray]):
     params = net.params_[name]
     state = net.state_[name]
-    inner = layer.underlying if isinstance(layer, LastTimeStep) else layer
-    if isinstance(inner, LSTM):
+    w = _flat_w(pw)
+    inner = layer.underlying if isinstance(layer, (LastTimeStep,
+                                                   TimeDistributed)) \
+        else layer
+    if isinstance(inner, Bidirectional):
+        il = inner.fwd.underlying if isinstance(inner.fwd, LastTimeStep) \
+            else inner.fwd
+        # Keras names the direction groups 'forward_<inner>' /
+        # 'backward_<inner>' as ONE path component (possibly below a
+        # model-name prefix).  Split on the FIRST component starting with
+        # a direction marker — a plain substring test would mis-split
+        # when the inner layer's own name contains 'forward' (e.g.
+        # Bidirectional(LSTM(name='forward_lstm')) gives groups
+        # forward_forward_lstm / backward_forward_lstm).
+        def direction_of(path):
+            for comp in path.split("/"):
+                if comp.startswith("forward"):
+                    return "fwd"
+                if comp.startswith("backward"):
+                    return "bwd"
+            return None
+
+        fw = _flat_w({p: v for p, v in pw.items()
+                      if direction_of(p) == "fwd"})
+        bw = _flat_w({p: v for p, v in pw.items()
+                      if direction_of(p) == "bwd"})
+        if not fw or not bw:
+            raise UnsupportedKerasConfigurationException(
+                f"{name}: Bidirectional weights missing forward/backward "
+                f"groups (paths: {sorted(pw)})")
+        _copy_rnn_weights(params["fwd"], il, fw)
+        _copy_rnn_weights(params["bwd"], il, bw)
+    elif isinstance(inner, LSTM):
         H = inner.n_out
         # LastTimeStep forwards its underlying layer's params un-nested
         params["W"] = _reorder_lstm_gates(w["kernel"], H)
@@ -391,18 +517,25 @@ def _set_weights(net, name: str, layer: Layer, w: Dict[str, np.ndarray]):
         params["W"] = w.get("kernel", w.get("embeddings"))
         if "bias" in w:
             params["b"] = w["bias"]
-    # convert all to device arrays with expected shapes
+    # convert all to device arrays with expected shapes (recursing into
+    # nested param dicts — Bidirectional fwd/bwd)
     import jax.numpy as jnp
-    for k2 in list(params):
-        tmpl = params[k2]
-        arr = jnp.asarray(np.asarray(params[k2]))
-        if arr.shape != tmpl.shape:
-            raise UnsupportedKerasConfigurationException(
-                f"{name}/{k2}: weight shape {arr.shape} != expected "
-                f"{tmpl.shape}")
-        params[k2] = arr
-    for k2 in list(state):
-        state[k2] = jnp.asarray(np.asarray(state[k2]))
+
+    def to_device(d, prefix):
+        for k2 in list(d):
+            tmpl = d[k2]
+            if isinstance(tmpl, dict):
+                to_device(tmpl, f"{prefix}/{k2}")
+                continue
+            arr = jnp.asarray(np.asarray(tmpl))
+            if arr.shape != tmpl.shape:
+                raise UnsupportedKerasConfigurationException(
+                    f"{prefix}/{k2}: weight shape {arr.shape} != expected "
+                    f"{tmpl.shape}")
+            d[k2] = arr
+
+    to_device(params, name)
+    to_device(state, name)
 
 
 # ---------------------------------------------------------------------------

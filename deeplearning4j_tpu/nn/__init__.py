@@ -23,6 +23,9 @@ from deeplearning4j_tpu.nn.layers_extra import (  # noqa: F401
 from deeplearning4j_tpu.nn.custom import (  # noqa: F401
     CapsuleLayer, CapsuleStrengthLayer, LambdaLayer, PrimaryCapsules,
     SameDiffLayer)
+from deeplearning4j_tpu.nn.shape_ops import (  # noqa: F401
+    FlattenLayer, PermuteLayer, RepeatVectorLayer, ReshapeLayer,
+    TimeDistributed)
 from deeplearning4j_tpu.nn.multilayer import (  # noqa: F401
     MultiLayerConfiguration, MultiLayerNetwork, NeuralNetConfiguration)
 from deeplearning4j_tpu.nn.graph import (  # noqa: F401
@@ -48,6 +51,8 @@ _LAYER_CLASSES = [
     LocallyConnected1DLayer, LocallyConnected2DLayer, PReLULayer,
     Subsampling1DLayer, Subsampling3DLayer,
     CapsuleLayer, CapsuleStrengthLayer, LambdaLayer, PrimaryCapsules,
+    FlattenLayer, PermuteLayer, RepeatVectorLayer, ReshapeLayer,
+    TimeDistributed,
 ]
 
 # Name -> class registry for config JSON round-trip (the reference's Jackson

@@ -200,10 +200,17 @@ class GravesLSTM(LSTM):
 class Bidirectional(Layer):
     """Runs an inner recurrent layer forward + backward over time and merges
     (reference `nn/conf/layers/recurrent/Bidirectional.java`; modes ADD,
-    MUL, AVERAGE, CONCAT)."""
+    MUL, AVERAGE, CONCAT).
+
+    ``return_last=True`` gives Keras `Bidirectional(return_sequences=False)`
+    semantics: merge(fwd output at the LAST step, bwd output after
+    consuming the WHOLE sequence — i.e. at original position 0), as a
+    feed-forward activation.  (A plain `LastTimeStep` wrapper would wrongly
+    take the bwd output at t=T-1, where it has seen one element.)"""
 
     fwd: Optional[Layer] = None
     mode: str = "CONCAT"
+    return_last: bool = False
     REGULARIZABLE: Tuple[str, ...] = ()
     STOCHASTIC: bool = True
 
@@ -221,8 +228,12 @@ class Bidirectional(Layer):
             self._bwd.weight_init = self.weight_init
         pf, sf, of = self.fwd.initialize(k1, input_type, dtype)
         pb, sb, _ = self._bwd.initialize(k2, input_type, dtype)
-        out = of if self.mode != "CONCAT" else InputType.recurrent(
-            2 * of.shape[-1], of.shape[0])
+        n_out = (2 * of.shape[-1] if self.mode == "CONCAT"
+                 else of.shape[-1])
+        if self.return_last:
+            out = InputType.feed_forward(n_out)
+        else:
+            out = InputType.recurrent(n_out, of.shape[0])
         return {"fwd": pf, "bwd": pb}, {"fwd": sf, "bwd": sb}, out
 
     def regularizable_mask(self, params):
@@ -242,6 +253,25 @@ class Bidirectional(Layer):
         yb, sb = self._bwd.apply(params["bwd"], state["bwd"], xr, train=train,
                                  rng=r2, mask=mr)
         yb = jnp.flip(yb, axis=1)
+        if self.return_last:
+            # fwd: last (valid) step; bwd: full-consumption output, which
+            # after flipping back sits at original position 0
+            if mask is None:
+                yf = yf[:, -1]
+                yb = yb[:, 0]
+            else:
+                m = jnp.asarray(mask)
+                T = m.shape[1]
+                idx = (T - 1 - jnp.argmax(jnp.flip(m, axis=1), axis=1)
+                       .astype(jnp.int32))
+                yf = jnp.take_along_axis(yf, idx[:, None, None],
+                                         axis=1)[:, 0]
+                yb = yb[:, 0]
+                # an all-padding row has no valid step: emit zeros, not
+                # the garbage at the argmax fallback index
+                valid = jnp.any(m > 0, axis=1)[:, None]
+                yf = jnp.where(valid, yf, 0.0)
+                yb = jnp.where(valid, yb, 0.0)
         if self.mode == "CONCAT":
             y = jnp.concatenate([yf, yb], axis=-1)
         elif self.mode == "ADD":

@@ -1,0 +1,155 @@
+"""Shape-manipulation layers + the TimeDistributed wrapper.
+
+Reference analogs: the Keras-import preprocessors
+(`deeplearning4j-modelimport/.../keras/layers/core/KerasReshape.java`,
+`KerasPermute.java`, `KerasRepeatVector.java`) and the wrapper layer
+`keras/layers/wrappers/KerasTimeDistributed.java` — the reference realises
+these as InputPreProcessors attached to neighbouring layers; here they are
+first-class (param-free) layers, which keeps the MLN/CG topology explicit
+and JSON-round-trippable.
+
+All are pure reshapes/transposes — XLA folds them into neighbouring
+fusions, so they cost nothing on TPU.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.core import InputType, Layer
+
+
+def input_type_from_shape(shape: Sequence[int]) -> InputType:
+    """Batch-less shape tuple -> InputType (the Keras-import convention:
+    rank 1 = feed-forward, 2 = recurrent [T, F], 3 = NHWC, 4 = NDHWC)."""
+    shape = tuple(int(s) for s in shape)
+    if len(shape) == 1:
+        return InputType.feed_forward(shape[0])
+    if len(shape) == 2:
+        return InputType.recurrent(shape[1], shape[0])
+    if len(shape) == 3:
+        return InputType.convolutional(*shape)
+    if len(shape) == 4:
+        return InputType.convolutional3d(*shape)
+    raise ValueError(f"Unsupported target rank {len(shape)}")
+
+
+@dataclasses.dataclass(kw_only=True)
+class ReshapeLayer(Layer):
+    """Reshape non-batch dims to `target_shape` (Keras `Reshape` /
+    reference `KerasReshape` preprocessor)."""
+
+    target_shape: Tuple[int, ...] = ()
+    REGULARIZABLE: Tuple[str, ...] = ()
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        out = input_type_from_shape(self.target_shape)
+        if input_type.flat_size() != out.flat_size():
+            raise ValueError(
+                f"Reshape: {input_type.shape} has {input_type.flat_size()} "
+                f"elements, target {tuple(self.target_shape)} has "
+                f"{out.flat_size()}")
+        return {}, {}, out
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return x.reshape((x.shape[0],) + tuple(self.target_shape)), state
+
+
+@dataclasses.dataclass(kw_only=True)
+class PermuteLayer(Layer):
+    """Transpose non-batch dims by `dims` (1-indexed, batch excluded —
+    Keras `Permute` semantics / reference `KerasPermute`)."""
+
+    dims: Tuple[int, ...] = ()
+    REGULARIZABLE: Tuple[str, ...] = ()
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        if sorted(self.dims) != list(range(1, len(input_type.shape) + 1)):
+            raise ValueError(f"Permute dims {self.dims} must be a "
+                             f"permutation of 1..{len(input_type.shape)}")
+        out_shape = tuple(input_type.shape[d - 1] for d in self.dims)
+        return {}, {}, input_type_from_shape(out_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return jnp.transpose(x, (0,) + tuple(d for d in self.dims)), state
+
+
+@dataclasses.dataclass(kw_only=True)
+class RepeatVectorLayer(Layer):
+    """[B, F] -> [B, n, F] (Keras `RepeatVector` / reference
+    `KerasRepeatVector`): feed-forward activation repeated into a
+    sequence."""
+
+    n: int = 0
+    REGULARIZABLE: Tuple[str, ...] = ()
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        if input_type.kind != "feedforward":
+            raise ValueError("RepeatVector requires feed-forward input, "
+                             f"got {input_type.kind}")
+        return {}, {}, InputType.recurrent(input_type.shape[0], self.n)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return jnp.repeat(x[:, None, :], self.n, axis=1), state
+
+
+@dataclasses.dataclass(kw_only=True)
+class TimeDistributed(Layer):
+    """Applies a feed-forward inner layer independently at every timestep
+    of a [B, T, ...] input (Keras `TimeDistributed` / reference
+    `KerasTimeDistributed` wrapper): folds time into batch, applies,
+    unfolds.  XLA sees one big batched matmul — the TPU-preferred form."""
+
+    underlying: Optional[Layer] = None
+    STOCHASTIC: bool = True
+    REGULARIZABLE: Tuple[str, ...] = ()
+
+    def __post_init__(self):
+        if self.underlying is None:
+            raise ValueError("TimeDistributed requires underlying=...")
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        if input_type.kind != "recurrent":
+            raise ValueError("TimeDistributed requires recurrent input "
+                             f"[T, F], got {input_type.kind}")
+        T, F = input_type.shape
+        if self.underlying.weight_init is None:
+            self.underlying.weight_init = self.weight_init
+        p, s, ot = self.underlying.initialize(
+            rng, InputType.feed_forward(F), dtype)
+        if ot.kind != "feedforward":
+            raise ValueError("TimeDistributed inner layer must map "
+                             "feed-forward -> feed-forward")
+        return p, s, InputType.recurrent(ot.shape[0], T)
+
+    def regularizable_mask(self, params):
+        return self.underlying.regularizable_mask(params)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        r0 = None
+        if rng is not None:
+            r0, rng = jax.random.split(rng)
+        x = self.maybe_input_dropout(x, train, r0)
+        B, T = x.shape[0], x.shape[1]
+        flat = x.reshape((B * T,) + x.shape[2:])
+        y, s = self.underlying.apply(params, state, flat, train=train,
+                                     rng=rng, mask=None)
+        return y.reshape((B, T) + y.shape[1:]), s
+
+
+@dataclasses.dataclass(kw_only=True)
+class FlattenLayer(Layer):
+    """Flatten all non-batch dims to a feed-forward vector (Keras
+    `Flatten`; the reference realises this as CnnToFeedForward /
+    RnnToFeedForward preprocessors)."""
+
+    REGULARIZABLE: Tuple[str, ...] = ()
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        return {}, {}, InputType.feed_forward(input_type.flat_size())
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return x.reshape(x.shape[0], -1), state
