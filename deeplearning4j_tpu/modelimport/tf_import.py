@@ -386,22 +386,256 @@ def _reduce_min(sd, n, ins):
                  keepdims=bool(n.attr["keep_dims"].b), name=n.name)
 
 
+def _fdef_edge_base(inp: str) -> str:
+    """FunctionDef edges are `arg`, `node:out_name:idx`, or `node:idx` —
+    the producing node is always the first component."""
+    return inp.partition(":")[0]
+
+
+def _import_function_body(scope, fdef, arg_vars, library):
+    """Replay a FunctionDef's nodes into a control-flow child scope
+    (reference `samediff-import-tensorflow` imports TF1 While frames; TF2
+    frozen graphs carry functional While/If whose cond/body live in
+    graph_def.library — the structured form maps 1:1 onto our
+    cond/while_loop subgraphs)."""
+    produced = {a.name: v
+                for a, v in zip(fdef.signature.input_arg, arg_vars)}
+
+    def lookup(inp: str):
+        inp = inp[1:] if inp.startswith("^") else inp
+        if inp in produced:
+            return produced[inp]
+        return produced[_fdef_edge_base(inp)]
+
+    for node in fdef.node_def:
+        _eval_node(scope, node, produced, lookup, library)
+    outs = []
+    for out_arg in fdef.signature.output_arg:
+        outs.append(lookup(fdef.ret[out_arg.name]))
+    return tuple(outs)
+
+
+def _make_branch_fn(fdef, library):
+    def branch(scope, *args):
+        return _import_function_body(scope, fdef, args, library)
+    return branch
+
+
+def _eval_node(sd, node, produced, lookup, library):
+    """Dispatch one GraphDef/FunctionDef node into `sd` (shared by the
+    top-level import walk and control-flow function bodies)."""
+    from tensorflow.python.framework import dtypes
+    if node.op == "Placeholder":
+        shape = _attr_shape(node) or None
+        dt = np.dtype(dtypes.as_dtype(
+            node.attr["dtype"].type).as_numpy_dtype).name \
+            if node.attr["dtype"].type else "float32"
+        produced[node.name] = sd.placeholder(
+            node.name, shape=shape if shape else None, dtype=dt)
+        return
+    if node.op == "Const":
+        produced[node.name] = sd.constant(node.name, _const_value(node))
+        return
+    if node.op == "NoOp":
+        return
+    ins = [lookup(i) for i in node.input if not i.startswith("^")]
+    if node.op in ("While", "StatelessWhile"):
+        cond_f = library[node.attr["cond"].func.name]
+        body_f = library[node.attr["body"].func.name]
+        out = sd.while_loop(_make_branch_fn(cond_f, library),
+                            _make_branch_fn(body_f, library),
+                            *ins, name=node.name)
+    elif node.op in ("If", "StatelessIf"):
+        then_f = library[node.attr["then_branch"].func.name]
+        else_f = library[node.attr["else_branch"].func.name]
+        out = sd.cond(ins[0], _make_branch_fn(then_f, library),
+                      _make_branch_fn(else_f, library),
+                      *ins[1:], name=node.name)
+    else:
+        out = TFImportRegistry.get(node.op)(sd, node, ins)
+    outs = out if isinstance(out, tuple) else (out,)
+    produced[node.name] = outs[0]
+    for i, v in enumerate(outs):
+        produced[f"{node.name}:{i}"] = v
+
+
+def _import_v1_while_frame(sd, frame_nodes, produced, lookup, library,
+                           const_nodes=None):
+    """Deframe one TF1 while loop (Enter/Merge/Switch/NextIteration/Exit/
+    LoopCond — the format the reference interprets per-frame in
+    `AbstractSession.java`) into ONE structured `sd.while_loop`.
+
+    Loop state = the Merge'd variables plus every loop-invariant Enter
+    (passed through unchanged so branch subgraphs stay closure-free).
+    Supports single (non-nested) frames — the shape real frozen TF1
+    graphs carry."""
+    by_name = {n.name: n for n in frame_nodes}
+    merges = [n for n in frame_nodes if n.op == "Merge"]
+    loopconds = [n for n in frame_nodes if n.op == "LoopCond"]
+    if len(loopconds) != 1:
+        raise UnmappedTFOpException(
+            f"while frame needs exactly 1 LoopCond, found {len(loopconds)} "
+            "(nested loops unsupported)")
+    loopcond = loopconds[0]
+    enters = {n.name: n for n in frame_nodes if n.op == "Enter"}
+    # merge k: inputs [Enter, NextIteration]
+    merge_enter = {}
+    merge_next = {}
+    for m in merges:
+        for inp in m.input:
+            b = _fdef_edge_base(inp)
+            if b in enters:
+                merge_enter[m.name] = enters[b]
+            else:
+                merge_next[m.name] = b            # NextIteration node name
+    switches = {}                                  # merge name -> Switch node
+    for n in frame_nodes:
+        if n.op == "Switch":
+            b = _fdef_edge_base(n.input[0])
+            if b in [m.name for m in merges]:
+                switches[b] = n
+    # invariant enters = those not feeding a merge
+    merged_enter_names = {e.name for e in merge_enter.values()}
+    invariants = [e for e in enters.values()
+                  if e.name not in merged_enter_names]
+
+    var_merges = list(merges)
+    n_m = len(var_merges)
+    arg_index = {m.name: i for i, m in enumerate(var_merges)}
+    for j, e in enumerate(invariants):
+        arg_index[e.name] = n_m + j
+    switch_index = {switches[m.name].name: i
+                    for i, m in enumerate(var_merges) if m.name in switches}
+
+    init = [lookup(merge_enter[m.name].input[0]) for m in var_merges] \
+        + [lookup(e.input[0]) for e in invariants]
+
+    def lazy_eval(scope, args, argmap, target_edge, cache):
+        """Demand-driven evaluation of a frame edge inside a child scope."""
+        edge = target_edge[1:] if target_edge.startswith("^") else target_edge
+        base = _fdef_edge_base(edge)
+        if base in argmap:
+            return args[argmap[base]]
+        if edge in cache:
+            return cache[edge]
+        node = by_name.get(base)
+        if node is None:
+            # graph Consts physically sit outside the frame partition but
+            # are referenced from inside: re-declare them in this scope
+            if const_nodes is not None and base in const_nodes:
+                v = scope.constant(base, const_nodes[base])
+                cache[base] = v
+                return v
+            raise UnmappedTFOpException(
+                f"while frame: edge '{edge}' leaves the frame (closure over "
+                "outer graph values is unsupported — freeze them as Const)")
+        if node.op in ("Merge", "Switch", "Enter", "NextIteration", "Exit",
+                       "LoopCond"):
+            raise UnmappedTFOpException(
+                f"while frame: unexpected {node.op} at '{edge}'")
+        local = {}
+
+        def llookup(inp):
+            return lazy_eval(scope, args, argmap, inp, cache)
+
+        _eval_node(scope, node, local, llookup, library)
+        cache.update(local)
+        return cache[edge]
+
+    def cond_fn(scope, *args):
+        return lazy_eval(scope, args, arg_index, loopcond.input[0], {})
+
+    # body arg map: references to Switch outputs (:1) become the args
+    body_argmap = dict(arg_index)
+    body_argmap.update(switch_index)
+
+    def body_fn(scope, *args):
+        cache = {}
+        outs = []
+        for m in var_merges:
+            ni = by_name[merge_next[m.name]]
+            outs.append(lazy_eval(scope, args, body_argmap, ni.input[0],
+                                  cache))
+        # invariants pass through unchanged
+        outs.extend(args[n_m:])
+        return tuple(outs)
+
+    final = sd.while_loop(cond_fn, body_fn, *init)
+    if not isinstance(final, tuple):
+        final = (final,)
+    # map each Exit to its variable's final value
+    for n in frame_nodes:
+        if n.op == "Exit":
+            sw = _fdef_edge_base(n.input[0])
+            if sw not in switch_index:
+                raise UnmappedTFOpException(
+                    f"Exit '{n.name}' input is not a loop-var Switch")
+            produced[n.name] = final[switch_index[sw]]
+
+
+def _frame_partition(graph_def):
+    """Group nodes by loop frame (fixpoint propagation — lowered GraphDefs
+    are NOT topologically ordered): a node is in frame F if it is an Enter
+    with frame_name F, or any of its (data or control) inputs comes from an
+    in-frame node that is not that frame's Exit.  Exit nodes are in-frame;
+    their consumers are not."""
+    frame_of = {}
+    exits = set()
+    nodes = list(graph_def.node)
+    for node in nodes:
+        if node.op == "Enter":
+            frame_of[node.name] = node.attr["frame_name"].s.decode()
+    changed = True
+    while changed:
+        changed = False
+        for node in nodes:
+            if node.name in frame_of or node.op == "Enter":
+                continue
+            for inp in node.input:
+                b = _fdef_edge_base(inp.lstrip("^"))
+                if b in frame_of and b not in exits:
+                    frame_of[node.name] = frame_of[b]
+                    if node.op == "Exit":
+                        exits.add(node.name)
+                    changed = True
+                    break
+    frames = {}
+    for node in nodes:
+        f = frame_of.get(node.name)
+        if f is not None:
+            frames.setdefault(f, []).append(node)
+    return frames, [n for n in nodes if n.name not in frame_of]
+
+
 def import_graph_def(graph_def, input_names: List[str] = None) -> SameDiff:
     """Walk a (frozen) GraphDef into a SameDiff graph.  Variables must be
     frozen to Const (the reference likewise imports frozen graphs).
     Multi-output TF nodes (Split, FusedBatchNorm, ...) register each output
     under `name:i`; plain `name` refers to output 0, matching TF edge
-    naming."""
-    from tensorflow.python.framework import dtypes
+    naming.  Control flow lowers onto SameDiff while_loop/cond in both
+    forms: functional (While/StatelessWhile, If/StatelessIf with bodies in
+    graph_def.library) and TF1 raw frames
+    (Enter/Merge/Switch/NextIteration/Exit/LoopCond), which the reference
+    interprets per-frame in AbstractSession."""
     sd = SameDiff.create()
     produced = {}
+    library = {f.signature.name: f for f in graph_def.library.function}
+    node_by_name = {n.name: n for n in graph_def.node}
+    has_frames = any(n.op == "Enter" for n in graph_def.node)
+    if has_frames:
+        frames, _ = _frame_partition(graph_def)
+        frame_of = {n.name: f for f, ns in frames.items() for n in ns}
+        const_nodes = {n.name: _const_value(n) for n in graph_def.node
+                       if n.op == "Const"}
+    else:
+        frames, frame_of, const_nodes = {}, {}, {}
 
     def lookup(inp: str):
         inp = inp[1:] if inp.startswith("^") else inp
         if inp in produced:
             return produced[inp]
         base, _, idx = inp.partition(":")
-        if idx not in ("", "0"):
+        if idx not in ("", "0") and base in produced:
             # consuming output i>0 of a node whose mapper produced fewer
             # outputs must fail loudly, not alias to output 0
             raise UnmappedTFOpException(
@@ -409,23 +643,56 @@ def import_graph_def(graph_def, input_names: List[str] = None) -> SameDiff:
                 f"'{base}' does not produce")
         return produced[base]
 
+    # Lowered/optimized GraphDefs are NOT topologically ordered, so order
+    # evaluation with an iterative Kahn sort (no recursion — a reverse-
+    # ordered chain of thousands of nodes must not hit Python's stack
+    # limit).  Each while frame is one super-node: deps = its Enter
+    # inputs; it satisfies its Exit names.
+    def owner(name: str):
+        f = frame_of.get(name)
+        return ("frame", f) if f is not None else ("node", name)
+
+    items = {}                     # item key -> set of dep item keys
     for node in graph_def.node:
-        if node.op == "Placeholder":
-            shape = _attr_shape(node) or None
-            dt = np.dtype(dtypes.as_dtype(
-                node.attr["dtype"].type).as_numpy_dtype).name \
-                if node.attr["dtype"].type else "float32"
-            produced[node.name] = sd.placeholder(
-                node.name, shape=shape if shape else None, dtype=dt)
-        elif node.op == "Const":
-            produced[node.name] = sd.constant(node.name, _const_value(node))
-        elif node.op == "NoOp":
+        if node.name in frame_of:
             continue
+        deps = set()
+        for inp in node.input:
+            b = _fdef_edge_base(inp.lstrip("^"))
+            if b in node_by_name:
+                deps.add(owner(b))
+        items[("node", node.name)] = deps
+    for f, ns in frames.items():
+        deps = set()
+        for n in ns:
+            if n.op == "Enter":
+                b = _fdef_edge_base(n.input[0].lstrip("^"))
+                if b in node_by_name:
+                    deps.add(owner(b))
+        deps.discard(("frame", f))
+        items[("frame", f)] = deps
+    ready = [k for k, d in items.items() if not d]
+    dependents = {}
+    for k, d in items.items():
+        for dep in d:
+            dependents.setdefault(dep, []).append(k)
+    remaining = {k: len(d) for k, d in items.items()}
+    n_done = 0
+    while ready:
+        kind, name = ready.pop()
+        n_done += 1
+        if kind == "node":
+            _eval_node(sd, node_by_name[name], produced, lookup, library)
         else:
-            ins = [lookup(i) for i in node.input if not i.startswith("^")]
-            out = TFImportRegistry.get(node.op)(sd, node, ins)
-            outs = out if isinstance(out, tuple) else (out,)
-            produced[node.name] = outs[0]
-            for i, v in enumerate(outs):
-                produced[f"{node.name}:{i}"] = v
+            _import_v1_while_frame(sd, frames[name], produced, lookup,
+                                   library, const_nodes)
+        for dep in dependents.get((kind, name), ()):
+            remaining[dep] -= 1
+            if remaining[dep] == 0:
+                ready.append(dep)
+    if n_done != len(items):
+        stuck = [k for k, c in remaining.items() if c > 0][:5]
+        raise UnmappedTFOpException(
+            f"GraphDef has a dependency cycle outside loop frames "
+            f"(unresolved: {stuck})")
     return sd
