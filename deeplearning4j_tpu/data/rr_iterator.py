@@ -50,11 +50,16 @@ class RecordReaderDataSetIterator(DataSetIterator):
         for i, v in enumerate(rec):
             if li <= i <= hi:
                 labels.append(v)
-            elif isinstance(v, np.ndarray):
-                feats.append(v.ravel())
             else:
-                feats.append([float(v)])
+                feats.append(v)
+        if len(feats) == 1 and isinstance(feats[0], np.ndarray) \
+                and feats[0].ndim >= 2:
+            # single tensor feature (ImageRecordReader): keep its shape —
+            # the reference likewise emits [N, C, H, W] batches for images
+            return np.asarray(feats[0], np.float32), labels
         f = np.concatenate([np.asarray(x, np.float32).ravel()
+                            if isinstance(x, np.ndarray)
+                            else np.asarray([float(x)], np.float32)
                             for x in feats])
         return f, labels
 
