@@ -41,12 +41,48 @@ class ZooModel:
         raise NotImplementedError
 
     def pretrained(self, path: str):
-        """Load externally converted pretrained weights (flat-param .npz or
+        """Load externally converted pretrained weights (positional
+        per-layer .npz from `zoo.convert`, a legacy flat-param .npz, or a
         model zip).  The reference downloads from azure blob storage
         (`ZooModel.initPretrained`); here weights must be local."""
         import numpy as np
         net = self.init_model()
         if path.endswith(".npz"):
-            net.set_params(np.load(path)["params"])
+            data = np.load(path)
+            if "params" in data.files:        # legacy flat form
+                net.set_params(data["params"])
+                return net
+            self._load_positional(net, data)
             return net
         return type(net).load(path)
+
+    @staticmethod
+    def _load_positional(net, data):
+        """Assign `zoo.convert` positional npz keys ("<ordinal>.<param>",
+        nested via dots) onto the net's parameterized layers in topology
+        order, with shape checks."""
+        import jax.numpy as jnp
+        import numpy as np
+        plist = []
+        for i in range(len(net.conf.layers)):
+            p = net.params_.get(net.conf.layer_name(i))
+            if p:
+                plist.append(p)
+        for key in data.files:
+            ordinal, _, rest = key.partition(".")
+            i = int(ordinal)
+            if i >= len(plist):
+                raise ValueError(
+                    f"{key}: artifact has more parameterized layers than "
+                    f"this architecture ({len(plist)})")
+            d = plist[i]
+            parts = rest.split(".")
+            for p in parts[:-1]:
+                d = d[p]
+            tmpl = d[parts[-1]]
+            arr = np.asarray(data[key])
+            if tuple(arr.shape) != tuple(tmpl.shape):
+                raise ValueError(
+                    f"{key}: shape {arr.shape} != architecture's "
+                    f"{tuple(tmpl.shape)}")
+            d[parts[-1]] = jnp.asarray(arr)

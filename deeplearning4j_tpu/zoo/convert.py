@@ -1,0 +1,116 @@
+"""Pretrained-weights converter CLI (reference `ZooModel.initPretrained()`
+role, offline form).
+
+The reference downloads converted checkpoints from blob storage; this
+environment has zero egress, so the equivalent is a local converter that
+turns a source checkpoint (Keras `.h5` or ONNX `.onnx`) into the artifact
+`ZooModel.pretrained()` consumes, using the existing importers:
+
+- ``--format npz``: positional per-layer ``.npz`` — keys ``<ordinal>.
+  <param>`` where ordinal counts the network's PARAMETERIZED layers in
+  topology order (name-independent, unlike the flat `params()` vector
+  whose jax-pytree order sorts by layer name) — loadable by any zoo
+  model whose parameterized-layer sequence matches the source.
+- ``--format zip``: full model zip (config + weights) via the network's
+  own serializer — self-describing, architecture comes from the source.
+
+Usage::
+
+    python -m deeplearning4j_tpu.zoo.convert src.h5 dst.npz
+    python -m deeplearning4j_tpu.zoo.convert model.onnx dst.zip --format zip
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def import_source(src: str):
+    """Import a Keras H5 (sequential, falling back to functional) or ONNX
+    source into a network/graph object exposing save()/params()."""
+    if src.endswith((".h5", ".hdf5", ".keras")):
+        from deeplearning4j_tpu.modelimport import KerasModelImport
+        from deeplearning4j_tpu.modelimport.keras import (
+            UnsupportedKerasConfigurationException)
+        try:
+            return KerasModelImport.import_keras_sequential_model_and_weights(
+                src)
+        except UnsupportedKerasConfigurationException:
+            return KerasModelImport.import_keras_model_and_weights(src)
+    if src.endswith(".onnx"):
+        from deeplearning4j_tpu.modelimport.onnx_import import (
+            import_onnx_model)
+        return import_onnx_model(src)
+    raise ValueError(f"Unsupported source format: {src} "
+                     "(expected .h5/.hdf5/.keras or .onnx)")
+
+
+def positional_params(net) -> dict:
+    """{"<ordinal>.<param>": array} over parameterized layers in topology
+    order (nested dicts dot-flattened) — the name-independent npz form."""
+    import numpy as np
+
+    def flatten(prefix, d, out):
+        for k in sorted(d):
+            v = d[k]
+            if isinstance(v, dict):
+                flatten(f"{prefix}.{k}", v, out)
+            else:
+                out[f"{prefix}.{k}"] = np.asarray(v)
+
+    out = {}
+    ordinal = 0
+    for i in range(len(net.conf.layers)):
+        p = net.params_.get(net.conf.layer_name(i))
+        if not p:
+            continue
+        flatten(str(ordinal), p, out)
+        ordinal += 1
+    return out
+
+
+def convert(src: str, dst: str, fmt: str = None) -> str:
+    """Convert `src` checkpoint to `dst` pretrained artifact.  Returns a
+    one-line description of what was written."""
+    import numpy as np
+    if fmt is None:
+        fmt = "npz" if dst.endswith(".npz") else "zip"
+    net = import_source(src)
+    if fmt == "npz":
+        if not hasattr(net, "conf") or not hasattr(net.conf, "layers"):
+            raise ValueError(
+                "npz format needs a layer-sequence network (MLN); "
+                "graph/SameDiff sources only support --format zip")
+        arrays = positional_params(net)
+        np.savez(dst, **arrays)
+        total = sum(a.size for a in arrays.values())
+        return (f"{dst}: positional params ({len(arrays)} tensors, "
+                f"{total} values) from {src}")
+    if fmt == "zip":
+        net.save(dst, False)
+        return f"{dst}: model zip (config + weights) from {src}"
+    raise ValueError(f"Unknown format {fmt!r} (npz|zip)")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Convert Keras H5 / ONNX checkpoints into "
+                    "ZooModel.pretrained() artifacts")
+    ap.add_argument("src", help="source checkpoint (.h5/.hdf5/.keras/.onnx)")
+    ap.add_argument("dst", help="output artifact (.npz or .zip)")
+    ap.add_argument("--format", choices=["npz", "zip"], default=None,
+                    help="artifact format (default: by dst extension)")
+    ap.add_argument("--platform", default=None,
+                    help="jax platform override (e.g. cpu) — conversion is "
+                         "host work; site plugins that ignore JAX_PLATFORMS "
+                         "make this flag the reliable way to avoid waiting "
+                         "on an accelerator")
+    args = ap.parse_args(argv)
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+    print(convert(args.src, args.dst, args.format))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,84 @@
+"""Zoo pretrained-weights converter round-trip tests (reference
+`ZooModel.initPretrained()`): source checkpoint (synthetic weights) ->
+converter artifact -> `pretrained()` -> predictions match the source.
+"""
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.zoo.convert import convert, main  # noqa: E402
+
+
+def _tf_vgg16(input_shape, n_classes):
+    """TF mirror of zoo VGG16 (`zoo/models.py` BLOCKS) with random
+    (synthetic) weights."""
+    tf.keras.utils.set_random_seed(0)
+    layers = [tf.keras.layers.Input(input_shape)]
+    for n_convs, ch in [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]:
+        for _ in range(n_convs):
+            layers.append(tf.keras.layers.Conv2D(ch, 3, padding="same",
+                                                 activation="relu"))
+        layers.append(tf.keras.layers.MaxPooling2D())
+    layers += [
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(4096, activation="relu"),
+        tf.keras.layers.Dropout(0.5),
+        tf.keras.layers.Dense(4096, activation="relu"),
+        tf.keras.layers.Dropout(0.5),
+        tf.keras.layers.Dense(n_classes, activation="softmax"),
+    ]
+    return tf.keras.Sequential(layers)
+
+
+def test_vgg16_npz_roundtrip_via_pretrained(tmp_path):
+    """Keras VGG16 (synthetic weights) -> npz -> zoo VGG16.pretrained():
+    flat layouts align, predictions match TF."""
+    from deeplearning4j_tpu.zoo import VGG16
+    km = _tf_vgg16((32, 32, 3), 4)
+    src = str(tmp_path / "vgg16.h5")
+    km.save(src)
+    dst = str(tmp_path / "vgg16.npz")
+    msg = convert(src, dst, "npz")
+    assert "positional params" in msg
+    net = VGG16(n_classes=4, input_shape=(32, 32, 3)).pretrained(dst)
+    x = np.random.RandomState(0).rand(2, 32, 32, 3).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               km.predict(x, verbose=0),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_resnet50_zip_roundtrip_via_pretrained(tmp_path):
+    """Keras-applications ResNet50 (synthetic weights) -> model zip ->
+    pretrained(): the zip is self-describing, predictions match TF."""
+    from deeplearning4j_tpu.zoo import ResNet50
+    tf.keras.utils.set_random_seed(0)
+    km = tf.keras.applications.resnet50.ResNet50(
+        weights=None, input_shape=(32, 32, 3), classes=7)
+    src = str(tmp_path / "resnet50.h5")
+    km.save(src)
+    dst = str(tmp_path / "resnet50.zip")
+    msg = convert(src, dst, "zip")
+    assert "model zip" in msg
+    net = ResNet50(n_classes=7, input_shape=(32, 32, 3)).pretrained(dst)
+    x = np.random.RandomState(1).rand(2, 32, 32, 3).astype(np.float32)
+    (got,) = net.output(x)
+    np.testing.assert_allclose(np.asarray(got), km.predict(x, verbose=0),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_convert_cli_entry(tmp_path):
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((6,)),
+        tf.keras.layers.Dense(4, activation="softmax")])
+    src = str(tmp_path / "m.h5")
+    km.save(src)
+    dst = str(tmp_path / "m.npz")
+    main([src, dst])
+    data = np.load(dst)
+    assert sum(data[k].size for k in data.files) == 6 * 4 + 4
