@@ -10,7 +10,7 @@ XLA's SPMD partitioner insert all-reduces over ICI.  See SURVEY.md §2.3/§2.4.
 from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
     MeshSpec, data_sharding, make_mesh, replicated)
 from deeplearning4j_tpu.parallel.wrapper import (  # noqa: F401
-    ParallelInference, ParallelWrapper)
+    DynamicBatchingInference, ParallelInference, ParallelWrapper)
 from deeplearning4j_tpu.parallel.sharding import (  # noqa: F401
     ShardingRules, shard_model_params)
 from deeplearning4j_tpu.parallel.pipeline import (  # noqa: F401

@@ -221,3 +221,52 @@ class LocalLauncher:
             raise RuntimeError(
                 f"multihost rank {rank} failed (rc={rc}):\n{out[-4000:]}")
         return outs
+
+
+# ---------------------------------------------------------------------------
+# Multi-host inference (reference: ParallelInference under
+# SparkDl4jMultiLayer — replica inference across executors; here one SPMD
+# forward over the global mesh, each process feeding/receiving its local
+# slice)
+# ---------------------------------------------------------------------------
+
+class MultiHostParallelInference:
+    """Sharded inference over a multi-process global mesh: every process
+    submits a host-local request batch, the forward runs once as SPMD over
+    the global `data` axis, and each process receives exactly its own
+    rows back (no cross-process result shipping beyond XLA's own
+    collectives)."""
+
+    def __init__(self, model, mesh=None, data_axis: str = "data"):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        self.model = model
+        self.mesh = mesh if mesh is not None else global_mesh()
+        self.data_axis = data_axis
+        repl = NamedSharding(self.mesh, P())
+
+        def replicate(leaf):
+            import numpy as _np
+            leaf = _np.asarray(leaf)
+            return jax.make_array_from_process_local_data(repl, leaf,
+                                                          leaf.shape)
+        model.params_ = jax.tree_util.tree_map(replicate, model.params_)
+        model.state_ = jax.tree_util.tree_map(replicate, model.state_)
+
+    def output(self, x_local):
+        """x_local: this process's [b_local, ...] request batch (equal
+        sizes across processes).  Returns this process's [b_local, ...]
+        predictions as numpy."""
+        xg = shard_host_local_batch(self.mesh, np.asarray(x_local),
+                                    self.data_axis)
+        with self.mesh:
+            out = self.model.output(xg)
+        if isinstance(out, (list, tuple)):   # ComputationGraph
+            out = out[0]
+        # one shard per distinct batch slice: meshes with a non-data axis
+        # replicate each slice across that axis's devices — keep one copy
+        by_start = {}
+        for s in out.addressable_shards:
+            by_start.setdefault(s.index[0].start or 0, s)
+        shards = [by_start[k] for k in sorted(by_start)]
+        return np.concatenate([np.asarray(s.data) for s in shards], axis=0)

@@ -298,3 +298,96 @@ class ParallelInference:
             res.append(out[off: off + s])
             off += s
         return res
+
+
+class DynamicBatchingInference:
+    """Concurrent-request dynamic batching over `ParallelInference`
+    (reference `ParallelInference.ObservablesProvider`: requests queue up
+    and are dispatched together once `max_batch` examples accumulate or
+    `timeout_ms` elapses — amortizing dispatch overhead for many small
+    concurrent clients).
+
+    `submit(x)` returns a `concurrent.futures.Future`; `output(x)` is the
+    blocking convenience form.  One daemon worker thread aggregates and
+    runs the sharded forward; results are split back per request."""
+
+    def __init__(self, inference: "ParallelInference", max_batch: int = 32,
+                 timeout_ms: float = 10.0):
+        import queue
+        import threading
+        self.inference = inference
+        self.max_batch = int(max_batch)
+        self.timeout = float(timeout_ms) / 1000.0
+        self._q: "queue.Queue" = queue.Queue()
+        self._stop = False
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    def submit(self, x: np.ndarray):
+        from concurrent.futures import Future
+        if self._stop:
+            raise RuntimeError("DynamicBatchingInference is shut down")
+        fut: Future = Future()
+        self._q.put((np.asarray(x), fut))
+        return fut
+
+    def output(self, x: np.ndarray) -> np.ndarray:
+        return self.submit(x).result()
+
+    def shutdown(self):
+        import queue
+        self._stop = True
+        self._q.put(None)                     # wake the worker
+        self._worker.join(timeout=5.0)
+        # fail anything still queued so no caller blocks forever on a
+        # Future the worker will never resolve
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                item[1].set_exception(
+                    RuntimeError("DynamicBatchingInference shut down "
+                                 "before this request was dispatched"))
+
+    def _collect(self) -> List:
+        """Block for the first request, then keep aggregating until the
+        batch budget is met or the timeout window closes."""
+        import queue
+        import time
+        first = self._q.get()
+        if first is None:
+            return []
+        batch = [first]
+        total = first[0].shape[0]
+        deadline = time.monotonic() + self.timeout
+        while total < self.max_batch:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                item = self._q.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if item is None:
+                break
+            batch.append(item)
+            total += item[0].shape[0]
+        return batch
+
+    def _loop(self):
+        while not self._stop:
+            batch = self._collect()
+            if not batch:
+                continue
+            xs = [x for x, _ in batch]
+            futs = [f for _, f in batch]
+            try:
+                outs = self.inference._output_batched(xs)
+            except Exception as e:            # propagate to every waiter
+                for f in futs:
+                    f.set_exception(e)
+                continue
+            for f, o in zip(futs, outs):
+                f.set_result(o)

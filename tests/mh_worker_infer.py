@@ -1,0 +1,40 @@
+"""Multi-host sharded-inference worker (spawned by test_multihost via
+LocalLauncher — NOT a pytest file).
+
+Each process joins the cluster, builds the same seeded network, submits its
+local slice of a deterministic global request batch through
+MultiHostParallelInference, and writes its local predictions for the
+driver test to compare against a single-process forward."""
+import os
+import sys
+
+import numpy as np
+
+from deeplearning4j_tpu.parallel import multihost
+
+multihost.initialize()
+
+from deeplearning4j_tpu.nn import (DenseLayer, InputType,  # noqa: E402
+                                   MultiLayerNetwork, NeuralNetConfiguration,
+                                   OutputLayer)
+from deeplearning4j_tpu.parallel.multihost import (  # noqa: E402
+    MultiHostParallelInference)
+
+out_dir = sys.argv[1]
+rank = multihost.process_index()
+world = multihost.process_count()
+
+rng = np.random.default_rng(3)
+X = rng.standard_normal((12, 6)).astype(np.float32)
+per = X.shape[0] // world
+xl = X[rank * per:(rank + 1) * per]
+
+conf = (NeuralNetConfiguration.builder().seed(11)
+        .list([DenseLayer(n_out=8, activation="tanh"),
+               OutputLayer(n_out=3, loss="mcxent", activation="softmax")])
+        .set_input_type(InputType.feed_forward(6)).build())
+net = MultiLayerNetwork(conf).init()
+pi = MultiHostParallelInference(net)
+local_out = pi.output(xl)
+np.savez(os.path.join(out_dir, f"infer_{rank}.npz"), out=local_out)
+print(f"rank {rank}/{world}: local_out={local_out.shape}", flush=True)
