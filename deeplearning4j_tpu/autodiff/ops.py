@@ -2093,10 +2093,11 @@ def _deconv3d(x, w, stride=(1, 1, 1), padding="SAME"):
                               dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
 
 
-@register_op("lstm_layer")
-def _lstm_layer(x, w_ih, w_hh, b=None, h0=None, c0=None):
-    """Full-sequence LSTM via lax.scan of lstm_cell (reference lstmLayer
-    declarable op; cuDNN-LSTM role).  x: [B,T,F] -> [B,T,H]."""
+@register_op("lstm_layer_full")
+def _lstm_layer_full(x, w_ih, w_hh, b=None, h0=None, c0=None):
+    """Reference lstmLayer's full-output mode: (h sequence, last h, last
+    c), IFCO gate order via lstm_cell.  The single-output IFOG form lives
+    under `lstm_layer` (samediff namespace contract).  x: [B,T,F]."""
     Bsz, T, _ = x.shape
     H = w_hh.shape[0]
     h = jnp.zeros((Bsz, H), x.dtype) if h0 is None else h0
@@ -2353,3 +2354,46 @@ def _mhdpa(q, k, v, wq, wk, wv, wo, mask=None, scaled=True):
     scale = None if scaled else 1.0
     ctx = fused_attention(qh, kh, vh, mask=mask, scale=scale)  # [B,H,T,dv]
     return jnp.einsum("bhtd,ohd->bto", ctx, wo)
+
+
+# ---- round-3 tail, part 3: bitmap compression + small parity ops ----
+
+register_op("cube", lambda x: x * x * x)
+register_op("count_zero", lambda x, axis=None:
+            jnp.sum((x == 0).astype(jnp.int32), axis=_axis_tuple(axis)))
+register_op("to_degrees", jnp.degrees)
+register_op("to_radians", jnp.radians)
+register_op("size_at", lambda x, dim: x.shape[int(dim)])
+
+
+@register_op("cosine_distance_loss")
+def _cosine_distance_loss(predictions, labels, axis=-1):
+    """Reference loss-family name for the same mean(1 - cos_sim) math as
+    the reduce3 `cosine_distance` op — delegates to it."""
+    return _cos_dist(labels, predictions, axis=axis)
+
+
+@register_op("encode_bitmap")
+def _encode_bitmap(grad, threshold=1e-3):
+    """Bitmap gradient compression (reference legacy ops encode_bitmap):
+    2-bit flag per value — 0 none, 1 +threshold, 2 -threshold — packed 16
+    flags per int32, plus the flagged count.  Fixed-size output:
+    jit-compatible."""
+    v = grad.reshape(-1)
+    n = v.shape[0]
+    flags = jnp.where(v >= threshold, 1,
+                      jnp.where(v <= -threshold, 2, 0)).astype(jnp.int32)
+    pad = (-n) % 16
+    fp = jnp.concatenate([flags, jnp.zeros((pad,), jnp.int32)])
+    f16 = fp.reshape(-1, 16)
+    shifts = jnp.arange(16, dtype=jnp.int32) * 2
+    packed = jnp.sum(f16 << shifts[None, :], axis=1).astype(jnp.int32)
+    return packed, jnp.sum((flags != 0).astype(jnp.int32))
+
+
+@register_op("decode_bitmap")
+def _decode_bitmap(packed, size, threshold=1e-3):
+    codes = (packed[:, None] >> (jnp.arange(16, dtype=jnp.int32) * 2)) & 3
+    codes = codes.reshape(-1)[:size]
+    return jnp.where(codes == 1, threshold,
+                     jnp.where(codes == 2, -threshold, 0.0))

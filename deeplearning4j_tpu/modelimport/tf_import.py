@@ -671,6 +671,69 @@ def import_graph_def(graph_def, input_names: List[str] = None) -> SameDiff:
                     deps.add(owner(b))
         deps.discard(("frame", f))
         items[("frame", f)] = deps
+    def controlling_switch(edge):
+        """Walk data ancestors of a Merge input to the Switch that gates
+        its OWN branch; returns (switch name, came-from-true-output).
+        Nested conds pair up: crossing another Merge increments a depth
+        counter, and a Switch at depth>0 belongs to that inner cond —
+        skip THROUGH its data input instead of stopping."""
+        seen = set()
+        stack = [(edge.lstrip("^"), 0)]
+        while stack:
+            e, depth = stack.pop()
+            base, _, idx = e.partition(":")
+            node = node_by_name.get(base)
+            if node is None or (base, depth) in seen:
+                continue
+            seen.add((base, depth))
+            if node.op == "Switch":
+                if depth == 0:
+                    return base, idx == "1"
+                stack.append((node.input[0].lstrip("^"), depth - 1))
+                continue
+            d2 = depth + 1 if node.op == "Merge" else depth
+            stack.extend((i.lstrip("^"), d2) for i in node.input
+                         if not i.startswith("^"))
+        raise UnmappedTFOpException(
+            f"Merge input '{edge}' has no controlling Switch")
+
+    def eval_frameless_cond_node(node):
+        """TF1-lowered tf.cond outside loop frames: Switch passes its
+        value to both branch edges (pure graphs — both branches are
+        computable), Merge selects by the Switch predicate.  The
+        reference interprets these per-frame in AbstractSession; here
+        they collapse into one `where` select."""
+        if node.op == "Switch":
+            data = lookup(node.input[0])
+            produced[node.name] = data
+            produced[f"{node.name}:0"] = data
+            produced[f"{node.name}:1"] = data
+            return
+        ins = [i for i in node.input if not i.startswith("^")]
+        if len(ins) == 1:                # grappler-pruned: pass-through
+            out = lookup(ins[0])
+            produced[node.name] = out
+            produced[f"{node.name}:0"] = out
+            return
+        if len(ins) != 2:
+            raise UnmappedTFOpException(
+                f"Merge '{node.name}' has {len(ins)} data inputs — only "
+                "2-way conds are supported (N-way tf.case lowering is "
+                "unmapped)")
+        sw_name, first_is_true = controlling_switch(ins[0])
+        pred = lookup(node_by_name[sw_name].input[1])
+        tv = lookup(ins[0] if first_is_true else ins[1])
+        fv = lookup(ins[1] if first_is_true else ins[0])
+        out = sd.op("where", pred, tv, fv, name=node.name)
+        produced[node.name] = out
+        produced[f"{node.name}:0"] = out
+        # Merge's second output is the taken-branch index
+        produced[f"{node.name}:1"] = sd.op(
+            "where", pred,
+            sd.constant(f"{node.name}__one", np.int32(1)),
+            sd.constant(f"{node.name}__zero", np.int32(0)),
+            name=f"{node.name}__value_index")
+
     ready = [k for k, d in items.items() if not d]
     dependents = {}
     for k, d in items.items():
@@ -682,7 +745,11 @@ def import_graph_def(graph_def, input_names: List[str] = None) -> SameDiff:
         kind, name = ready.pop()
         n_done += 1
         if kind == "node":
-            _eval_node(sd, node_by_name[name], produced, lookup, library)
+            node = node_by_name[name]
+            if node.op in ("Switch", "Merge"):
+                eval_frameless_cond_node(node)
+            else:
+                _eval_node(sd, node, produced, lookup, library)
         else:
             _import_v1_while_frame(sd, frames[name], produced, lookup,
                                    library, const_nodes)

@@ -151,15 +151,41 @@ class ElasticLocalRunner:
     parameter-server state."""
 
     def __init__(self, num_processes: int, devices_per_process: int = 1,
-                 platform: str = "cpu", max_restarts: int = 2):
+                 platform: str = "cpu", max_restarts: int = 2,
+                 backoff_base_s: float = 1.0, backoff_cap_s: float = 30.0):
         self.num_processes = num_processes
         self.devices_per_process = devices_per_process
         self.platform = platform
         self.max_restarts = max_restarts
         self.restarts = 0
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        # (attempt, kind, message-tail) per failure — kind in
+        # crash | hang | peer-loss (see _classify_failure)
+        self.failure_history: List[tuple] = []
+
+    @staticmethod
+    def _classify_failure(message: str) -> str:
+        """Failure taxonomy: `hang` = a rank hit the subprocess timeout
+        (no exit); `peer-loss` = a rank died because the coordination
+        service reported a peer's death (secondary casualty — the real
+        fault is elsewhere); `crash` = a rank exited nonzero on its own."""
+        low = message.lower()
+        if "<rank timed out>" in message:
+            return "hang"
+        if "peer task" in low or "coordination service" in low \
+                or "heartbeat" in low:
+            return "peer-loss"
+        return "crash"
+
+    def backoff_s(self, attempt: int) -> float:
+        """Exponential backoff before restart `attempt` (1-based)."""
+        return min(self.backoff_base_s * (2 ** (attempt - 1)),
+                   self.backoff_cap_s)
 
     def run(self, script: str, args: Sequence[str] = (),
             timeout: float = 300.0) -> List[str]:
+        import time as _time
         last_error: Optional[RuntimeError] = None
         for attempt in range(self.max_restarts + 1):
             launcher = LocalLauncher(self.num_processes,
@@ -169,10 +195,16 @@ class ElasticLocalRunner:
                 return launcher.run(script, args, timeout)
             except RuntimeError as e:
                 last_error = e
+                kind = self._classify_failure(str(e))
+                self.failure_history.append((attempt, kind,
+                                             str(e)[-500:]))
                 self.restarts = min(attempt + 1, self.max_restarts)
+                if attempt < self.max_restarts:
+                    _time.sleep(self.backoff_s(attempt + 1))
+        kinds = [k for _, k, _ in self.failure_history]
         raise RuntimeError(
-            f"training failed after {self.max_restarts} restarts"
-        ) from last_error
+            f"training failed after {self.max_restarts} restarts "
+            f"(failure kinds: {kinds})") from last_error
 
 
 class LocalLauncher:

@@ -588,3 +588,61 @@ def test_tf_cond_import_matches_tf():
         want = f(tf.constant(x)).numpy()
         got = np.asarray(sd.output({"x": x}, out_name)[out_name])
         np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_tf_cond_v1_switch_merge_import_matches_tf():
+    """Default (lowered) freezing turns tf.cond into frameless
+    Switch/Merge; the importer collapses them into a `where` select."""
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+
+    @tf.function
+    def f(x):
+        return tf.cond(tf.reduce_sum(x) > 0.0,
+                       lambda: x * 2.0,
+                       lambda: x - 1.0)
+
+    frozen = convert_variables_to_constants_v2(
+        f.get_concrete_function(tf.TensorSpec((4,), tf.float32)))
+    gd = frozen.graph.as_graph_def()
+    assert any(n.op == "Switch" for n in gd.node) \
+        and not any(n.op == "Enter" for n in gd.node), \
+        "expected frameless v1 cond lowering"
+    sd = import_graph_def(gd)
+    out_name = frozen.outputs[0].name.split(":")[0]
+    for x in (np.asarray([1.0, 2.0, 3.0, 4.0], np.float32),
+              np.asarray([-1.0, -2.0, -3.0, -4.0], np.float32)):
+        want = f(tf.constant(x)).numpy()
+        got = np.asarray(sd.output({"x": x}, out_name)[out_name])
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_tf_nested_cond_v1_import_matches_tf():
+    """Nested tf.cond (v1 lowering): the outer Merge must be gated by the
+    OUTER Switch — the ancestor walk pairs inner Merge/Switch so nesting
+    doesn't select the wrong predicate."""
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+
+    @tf.function
+    def f(x):
+        def true_branch():
+            return tf.cond(tf.reduce_max(x) > 2.0,
+                           lambda: x * 10.0, lambda: x * 2.0)
+        return tf.cond(tf.reduce_sum(x) > 0.0,
+                       true_branch, lambda: x - 1.0)
+
+    frozen = convert_variables_to_constants_v2(
+        f.get_concrete_function(tf.TensorSpec((3,), tf.float32)))
+    gd = frozen.graph.as_graph_def()
+    if not any(n.op == "Switch" for n in gd.node):
+        import pytest as _pytest
+        _pytest.skip("this TF version did not lower the nested cond")
+    sd = import_graph_def(gd)
+    out_name = frozen.outputs[0].name.split(":")[0]
+    # (outer, inner) truth table: TT, TF, F
+    for x in ([1.0, 2.0, 3.0], [1.0, 1.0, 1.0], [-1.0, -5.0, 2.5]):
+        xv = np.asarray(x, np.float32)
+        want = f(tf.constant(xv)).numpy()
+        got = np.asarray(sd.output({"x": xv}, out_name)[out_name])
+        np.testing.assert_allclose(got, want, rtol=1e-6, err_msg=str(x))

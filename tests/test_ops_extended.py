@@ -850,7 +850,7 @@ def test_round3_rnn_layer_ops():
                        * 0.3)
     w_hh = jnp.asarray(rng.standard_normal((H, 4 * H)).astype(np.float32)
                        * 0.3)
-    ys, h, c = op("lstm_layer")(x, w_ih, w_hh)
+    ys, h, c = op("lstm_layer_full")(x, w_ih, w_hh)
     assert ys.shape == (B, T, H)
     # oracle: manual cell loop
     hh = np.zeros((B, H), np.float32)
@@ -973,3 +973,29 @@ def test_round3b_multi_head_attention_op():
     ctx = mha_reference(jnp.asarray(qh), jnp.asarray(kh), jnp.asarray(vh))
     want = np.einsum("bhtd,ohd->bto", np.asarray(ctx), wo)
     np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4, atol=1e-5)
+
+
+def test_round3c_bitmap_and_small_ops():
+    g = jnp.asarray([2e-3, -5e-3, 1e-4, 0.0, 3e-3])
+    packed, cnt = op("encode_bitmap")(g, 1e-3)
+    assert int(cnt) == 3
+    dec = np.asarray(op("decode_bitmap")(packed, 5, 1e-3))
+    np.testing.assert_allclose(dec, [1e-3, -1e-3, 0.0, 0.0, 1e-3])
+    # jit-compatible end to end
+    f = jax.jit(lambda x: op("decode_bitmap")(
+        op("encode_bitmap")(x, 1e-3)[0], 5, 1e-3))
+    np.testing.assert_allclose(np.asarray(f(g)), dec)
+    np.testing.assert_allclose(np.asarray(op("cube")(jnp.asarray([2.0]))),
+                               [8.0])
+    assert int(op("count_zero")(jnp.asarray([0., 1., 0.]))) == 2
+    np.testing.assert_allclose(float(op("to_degrees")(jnp.asarray(np.pi))),
+                               180.0, rtol=1e-6)
+    np.testing.assert_allclose(float(op("to_radians")(jnp.asarray(180.0))),
+                               np.pi, rtol=1e-6)
+    assert op("size_at")(jnp.zeros((3, 7)), 1) == 7
+    # cosine distance loss: identical vectors -> 0, opposite -> 2
+    a = jnp.asarray([[1.0, 0.0]]); b = jnp.asarray([[-1.0, 0.0]])
+    np.testing.assert_allclose(float(op("cosine_distance_loss")(a, a)), 0.0,
+                               atol=1e-6)
+    np.testing.assert_allclose(float(op("cosine_distance_loss")(a, b)), 2.0,
+                               atol=1e-6)
