@@ -2093,6 +2093,32 @@ def _deconv3d(x, w, stride=(1, 1, 1), padding="SAME"):
                               dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
 
 
+@register_op("lstm_layer")
+def _lstm_layer_ifog(x, w, rw, b):
+    """Whole-sequence LSTM, IFOG gate order, single [B,T,H] output — the
+    samediff `sd.rnn.lstm_layer` contract (SURVEY §7 hard part (d):
+    cuDNN-LSTM → lax.scan).  Registered here (not via samediff's
+    setdefault) so the duplicate guard protects the name.  The reference
+    lstmLayer's full-output mode is `lstm_layer_full` below."""
+    H = rw.shape[0]
+
+    def cell(carry, xt):
+        h, c = carry
+        z = xt @ w + h @ rw + b
+        i, f, o, g = (jax.nn.sigmoid(z[:, :H]),
+                      jax.nn.sigmoid(z[:, H:2 * H]),
+                      jax.nn.sigmoid(z[:, 2 * H:3 * H]),
+                      jnp.tanh(z[:, 3 * H:]))
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    Bsz = x.shape[0]
+    h0 = jnp.zeros((Bsz, H), x.dtype)
+    (_, _), hs = jax.lax.scan(cell, (h0, h0), jnp.swapaxes(x, 0, 1))
+    return jnp.swapaxes(hs, 0, 1)
+
+
 @register_op("lstm_layer_full")
 def _lstm_layer_full(x, w_ih, w_hh, b=None, h0=None, c0=None):
     """Reference lstmLayer's full-output mode: (h sequence, last h, last
@@ -2397,3 +2423,102 @@ def _decode_bitmap(packed, size, threshold=1e-3):
     codes = codes.reshape(-1)[:size]
     return jnp.where(codes == 1, threshold,
                      jnp.where(codes == 2, -threshold, 0.0))
+
+
+# ---- round-3 tail, part 4: random family completion, dynamic RNNs,
+# legacy pairwise leftovers (reference generic/random/**, generic/recurrent/
+# dynamic_rnn.cpp, legacy pairwise ops) ----
+
+register_op("random_binomial", lambda rng, shape, n, p=0.5:
+            jax.random.binomial(_key(rng), n, p, shape=tuple(shape)))
+register_op("random_lognormal", lambda rng, shape, mean=0.0, stddev=1.0:
+            jnp.exp(mean + stddev * jax.random.normal(_key(rng),
+                                                      tuple(shape))))
+register_op("random_choice", lambda rng, source, probabilities, n:
+            source[jax.random.choice(
+                _key(rng), source.shape[0], (n,),
+                p=probabilities / jnp.sum(probabilities))])
+register_op("reverse_mod", lambda a, b: b % a)
+register_op("axpy", lambda alpha, x, y: alpha * x + y)
+register_op("adjust_contrast_v2", lambda x, factor:
+            OP_TABLE["adjust_contrast"](x, factor))
+
+
+@register_op("logdet")
+def _logdet(a):
+    """log|det| for symmetric positive-definite input via Cholesky
+    (reference parity op logdet)."""
+    c = jnp.linalg.cholesky(a)
+    return 2.0 * jnp.sum(jnp.log(jnp.diagonal(c, axis1=-2, axis2=-1)),
+                         axis=-1)
+
+
+@register_op("assert_equal")
+def _assert_equal(a, b, eps=0.0):
+    """Equality assertion (reference Assert/assert ops): raises on
+    mismatch, passes `a` through.  Eager inputs check synchronously;
+    under jit (the SameDiff execution path) the check runs as a host
+    debug callback so graphs containing it still compile."""
+    import numpy as onp
+
+    def host_check(av, bv):
+        av, bv = onp.asarray(av), onp.asarray(bv)
+        if not onp.allclose(av, bv, atol=eps, rtol=0.0):
+            raise ValueError(
+                f"assert_equal failed: max |a-b| = "
+                f"{onp.max(onp.abs(av - bv)):.3g} > {eps}")
+
+    if isinstance(a, jax.core.Tracer) or isinstance(b, jax.core.Tracer):
+        jax.debug.callback(host_check, a, b)
+        return a
+    host_check(a, b)
+    return a
+
+
+@register_op("dynamic_rnn")
+def _dynamic_rnn(x, w, rw, b=None, h0=None, seq_lengths=None):
+    """Plain-RNN whole sequence (reference dynamic_rnn.cpp):
+    h_t = tanh(x_t W + h_{t-1} R + b), zeroing steps past seq_lengths.
+    x: [B,T,F] -> (outputs [B,T,H], final h [B,H])."""
+    B, T, _ = x.shape
+    H = rw.shape[0]
+    h = jnp.zeros((B, H), x.dtype) if h0 is None else h0
+    bias = 0 if b is None else b
+    steps = jnp.arange(T)
+
+    def cell(h, inp):
+        xt, t = inp
+        h_new = jnp.tanh(xt @ w + h @ rw + bias)
+        if seq_lengths is not None:
+            live = (t < seq_lengths)[:, None]
+            h_new = jnp.where(live, h_new, h)
+        return h_new, h_new
+
+    h_final, ys = lax.scan(cell, h, (jnp.swapaxes(x, 0, 1), steps))
+    out = jnp.swapaxes(ys, 0, 1)
+    if seq_lengths is not None:
+        out = out * (steps[None, :] < seq_lengths[:, None])[..., None]
+    return out, h_final
+
+
+@register_op("dynamic_bidirectional_rnn")
+def _dynamic_bidirectional_rnn(x, w_f, rw_f, b_f, w_b, rw_b, b_b,
+                               seq_lengths=None):
+    """Two dynamic_rnns over opposite time directions (reference
+    dynamic_bidirectional_rnn.cpp); returns (fwd_out, bwd_out,
+    fwd_final, bwd_final) with the bwd sequence re-flipped to input
+    order."""
+    fwd, hf = _dynamic_rnn(x, w_f, rw_f, b_f, seq_lengths=seq_lengths)
+    if seq_lengths is None:
+        xr = jnp.flip(x, axis=1)
+        bwd, hb = _dynamic_rnn(xr, w_b, rw_b, b_b)
+        return fwd, jnp.flip(bwd, axis=1), hf, hb
+    # per-example reversal up to each sequence's length
+    T = x.shape[1]
+    idx = jnp.arange(T)[None, :]
+    rev = jnp.clip(seq_lengths[:, None] - 1 - idx, 0, T - 1)
+    take = jnp.where(idx < seq_lengths[:, None], rev, idx)
+    xr = jnp.take_along_axis(x, take[..., None], axis=1)
+    bwd, hb = _dynamic_rnn(xr, w_b, rw_b, b_b, seq_lengths=seq_lengths)
+    bwd = jnp.take_along_axis(bwd, take[..., None], axis=1)
+    return fwd, bwd, hf, hb

@@ -302,25 +302,9 @@ class SDLoss(_Namespace):
                            name=name)
 
 
-def _lstm_layer(x, w, rw, b):
-    H = rw.shape[0]
-
-    def cell(carry, xt):
-        h, c = carry
-        z = xt @ w + h @ rw + b
-        i, f, o, g = (jax.nn.sigmoid(z[:, :H]), jax.nn.sigmoid(z[:, H:2*H]),
-                      jax.nn.sigmoid(z[:, 2*H:3*H]), jnp.tanh(z[:, 3*H:]))
-        c = f * c + i * g
-        h = o * jnp.tanh(c)
-        return (h, c), h
-
-    B = x.shape[0]
-    h0 = jnp.zeros((B, H), x.dtype)
-    (_, _), hs = jax.lax.scan(cell, (h0, h0), jnp.swapaxes(x, 0, 1))
-    return jnp.swapaxes(hs, 0, 1)
-
-
-OP_TABLE.setdefault("lstm_layer", _lstm_layer)
+# lstm_layer is registered in autodiff.ops (IFOG single-output form —
+# the sd.rnn.lstm_layer contract); lstm_layer_full carries the reference
+# lstmLayer's (ys, h, c) output mode.
 
 
 # ---------------------------------------------------------------------------

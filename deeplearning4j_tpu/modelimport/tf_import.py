@@ -720,7 +720,14 @@ def import_graph_def(graph_def, input_names: List[str] = None) -> SameDiff:
                 f"Merge '{node.name}' has {len(ins)} data inputs — only "
                 "2-way conds are supported (N-way tf.case lowering is "
                 "unmapped)")
-        sw_name, first_is_true = controlling_switch(ins[0])
+        # A constant branch is gated only by CONTROL edges (no data path
+        # to the Switch) — fall back to the other input's walk with the
+        # branch sense flipped.
+        try:
+            sw_name, first_is_true = controlling_switch(ins[0])
+        except UnmappedTFOpException:
+            sw_name, other_is_true = controlling_switch(ins[1])
+            first_is_true = not other_is_true
         pred = lookup(node_by_name[sw_name].input[1])
         tv = lookup(ins[0] if first_is_true else ins[1])
         fv = lookup(ins[1] if first_is_true else ins[0])

@@ -230,3 +230,39 @@ def test_samediff_custom_layer_gradients():
     ], InputType.feed_forward(3))
     assert check_gradients(score_fn_for(net, x, y), net.params_,
                            max_params_per_leaf=None, verbose=True)
+
+
+def test_shape_op_layers_gradients():
+    """Reshape/Permute/RepeatVector/Flatten/TimeDistributed path: the
+    shape pipeline is param-free but must route gradients exactly through
+    to surrounding layers (round-3 layers, reference KerasReshape etc.)."""
+    from deeplearning4j_tpu.nn import (FlattenLayer, PermuteLayer,
+                                       RepeatVectorLayer, ReshapeLayer,
+                                       TimeDistributed)
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(4, 6))
+    y = np.eye(2)[rng.integers(0, 2, 4)]
+    net = build_net([
+        DenseLayer(n_out=8, activation="tanh"),
+        RepeatVectorLayer(n=4),             # [B,4,8]
+        TimeDistributed(underlying=DenseLayer(n_out=6, activation="tanh")),
+        PermuteLayer(dims=(2, 1)),          # [B,6,4]
+        ReshapeLayer(target_shape=(3, 8)),  # [B,3,8]
+        FlattenLayer(),                     # [B,24]
+        OutputLayer(n_out=2, loss="mcxent", activation="softmax"),
+    ], InputType.feed_forward(6))
+    assert check_gradients(score_fn_for(net, x, y), net.params_,
+                           max_params_per_leaf=None, verbose=True)
+
+
+def test_bidirectional_return_last_gradients():
+    from deeplearning4j_tpu.nn import Bidirectional, LSTM
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(3, 5, 4))
+    y = np.eye(2)[rng.integers(0, 2, 3)]
+    net = build_net([
+        Bidirectional(fwd=LSTM(n_out=3), mode="CONCAT", return_last=True),
+        OutputLayer(n_out=2, loss="mcxent", activation="softmax"),
+    ], InputType.recurrent(4, 5))
+    assert check_gradients(score_fn_for(net, x, y), net.params_,
+                           max_params_per_leaf=8, verbose=True)

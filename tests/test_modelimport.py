@@ -646,3 +646,31 @@ def test_tf_nested_cond_v1_import_matches_tf():
         want = f(tf.constant(xv)).numpy()
         got = np.asarray(sd.output({"x": xv}, out_name)[out_name])
         np.testing.assert_allclose(got, want, rtol=1e-6, err_msg=str(x))
+
+
+def test_tf_cond_constant_branch_import_matches_tf():
+    """A cond branch that returns a constant has no data path to its
+    Switch (control-edge gating only); the importer falls back to the
+    other input's walk with flipped branch sense."""
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+
+    @tf.function
+    def f(x):
+        return tf.cond(tf.reduce_sum(x) > 0.0,
+                       lambda: tf.constant([9.0, 9.0, 9.0]),
+                       lambda: x - 1.0)
+
+    frozen = convert_variables_to_constants_v2(
+        f.get_concrete_function(tf.TensorSpec((3,), tf.float32)))
+    gd = frozen.graph.as_graph_def()
+    if not any(n.op == "Switch" for n in gd.node):
+        import pytest as _pytest
+        _pytest.skip("not lowered to v1 cond by this TF version")
+    sd = import_graph_def(gd)
+    out_name = frozen.outputs[0].name.split(":")[0]
+    for x in ([1.0, 1.0, 1.0], [-1.0, -1.0, -1.0]):
+        xv = np.asarray(x, np.float32)
+        np.testing.assert_allclose(
+            np.asarray(sd.output({"x": xv}, out_name)[out_name]),
+            f(tf.constant(xv)).numpy(), rtol=1e-6)

@@ -999,3 +999,63 @@ def test_round3c_bitmap_and_small_ops():
                                atol=1e-6)
     np.testing.assert_allclose(float(op("cosine_distance_loss")(a, b)), 2.0,
                                atol=1e-6)
+
+
+def test_round3d_random_rnn_legacy_ops():
+    import jax.random as jr
+    key = jr.PRNGKey(0)
+    rb = np.asarray(op("random_binomial")(key, (2000,), 10, 0.5))
+    assert 4.0 < rb.mean() < 6.0 and rb.min() >= 0 and rb.max() <= 10
+    rl = np.asarray(op("random_lognormal")(key, (2000,)))
+    assert rl.min() > 0
+    src = jnp.asarray([10.0, 20.0, 30.0])
+    ch = np.asarray(op("random_choice")(key, src,
+                                        jnp.asarray([0.0, 0.0, 1.0]), 50))
+    np.testing.assert_allclose(ch, 30.0)
+    np.testing.assert_allclose(
+        np.asarray(op("reverse_mod")(jnp.asarray([3.0]),
+                                     jnp.asarray([7.0]))), [1.0])
+    np.testing.assert_allclose(
+        np.asarray(op("axpy")(2.0, jnp.asarray([1.0, 2.0]),
+                              jnp.asarray([10.0, 10.0]))), [12.0, 14.0])
+    a = np.asarray([[4.0, 2.0], [2.0, 3.0]])
+    ld = float(op("logdet")(jnp.asarray(a)))
+    np.testing.assert_allclose(ld, np.log(np.linalg.det(a)), rtol=1e-6)
+    out = op("assert_equal")(jnp.asarray([1.0]), jnp.asarray([1.0]))
+    np.testing.assert_allclose(np.asarray(out), [1.0])
+    with pytest.raises(ValueError):
+        op("assert_equal")(jnp.asarray([1.0]), jnp.asarray([2.0]))
+
+
+def test_round3d_dynamic_rnn_ops():
+    r = np.random.RandomState(0)
+    B, T, F, H = 2, 5, 3, 4
+    x = jnp.asarray(r.randn(B, T, F).astype(np.float32) * 0.4)
+    w = jnp.asarray(r.randn(F, H).astype(np.float32) * 0.4)
+    rw = jnp.asarray(r.randn(H, H).astype(np.float32) * 0.4)
+    b = jnp.asarray(r.randn(H).astype(np.float32) * 0.1)
+    out, hT = op("dynamic_rnn")(x, w, rw, b)
+    # oracle loop
+    h = np.zeros((B, H), np.float32)
+    outs = []
+    for t in range(T):
+        h = np.tanh(np.asarray(x)[:, t] @ np.asarray(w)
+                    + h @ np.asarray(rw) + np.asarray(b))
+        outs.append(h)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.stack(outs, 1), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(hT), outs[-1], rtol=1e-5)
+    # seq_lengths freeze + zero past the end
+    sl = jnp.asarray([3, 5])
+    out2, h2 = op("dynamic_rnn")(x, w, rw, b, seq_lengths=sl)
+    o2 = np.asarray(out2)
+    assert np.all(o2[0, 3:] == 0)
+    np.testing.assert_allclose(np.asarray(h2)[1], outs[-1][1], rtol=1e-5)
+    # bidirectional: bwd equals fwd of the reversed input, re-flipped
+    fwd, bwd, hf, hb = op("dynamic_bidirectional_rnn")(x, w, rw, b,
+                                                       w, rw, b)
+    ref_b, ref_hb = op("dynamic_rnn")(jnp.flip(x, 1), w, rw, b)
+    np.testing.assert_allclose(np.asarray(bwd),
+                               np.asarray(jnp.flip(ref_b, 1)), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(hb), np.asarray(ref_hb),
+                               rtol=1e-5)
