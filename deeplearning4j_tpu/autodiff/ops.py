@@ -2522,3 +2522,149 @@ def _dynamic_bidirectional_rnn(x, w_f, rw_f, b_f, w_b, rw_b, b_b,
     bwd, hb = _dynamic_rnn(xr, w_b, rw_b, b_b, seq_lengths=seq_lengths)
     bwd = jnp.take_along_axis(bwd, take[..., None], axis=1)
     return fwd, bwd, hf, hb
+
+
+# ---- round-3 tail, part 5: TensorList family (reference
+# generic/list/*.cpp — the graph-interpreter's TensorArray; host-side
+# Python list, same as the reference's non-compiled list store), LSTM
+# block ops, static RNN forms ----
+
+class TensorList:
+    """Host-side list-of-arrays handle (reference NDArrayList)."""
+
+    def __init__(self, arrays=None):
+        self.arrays = list(arrays) if arrays is not None else []
+
+    def __len__(self):
+        return len(self.arrays)
+
+
+register_op("create_list", lambda *, size=0: TensorList(
+    [None] * int(size) if size else []))
+register_op("size_list", lambda lst: jnp.asarray(len(lst.arrays),
+                                                 jnp.int32))
+@register_op("read_list")
+def _read_list(lst, idx):
+    v = lst.arrays[int(idx)]
+    if v is None:
+        raise ValueError(f"read_list: slot {int(idx)} was never written")
+    return v
+
+
+@register_op("write_list")
+def _write_list(lst, idx, value):
+    i = int(idx)
+    if i >= len(lst.arrays):
+        lst.arrays.extend([None] * (i + 1 - len(lst.arrays)))
+    lst.arrays[i] = value
+    return lst
+
+
+@register_op("stack_list")
+def _stack_list(lst):
+    for i, a in enumerate(lst.arrays):
+        if a is None:
+            raise ValueError(f"stack_list: slot {i} was never written")
+    return jnp.stack([jnp.asarray(a) for a in lst.arrays])
+
+
+@register_op("unstack_list")
+def _unstack_list(lst, x):
+    lst.arrays = [x[i] for i in range(x.shape[0])]
+    return lst
+
+
+@register_op("gather_list")
+def _gather_list(lst, indices):
+    return jnp.stack([jnp.asarray(_read_list(lst, int(i)))
+                      for i in np.asarray(indices)])
+
+
+@register_op("scatter_list")
+def _scatter_list(lst, indices, x):
+    for j, i in enumerate(np.asarray(indices)):
+        _write_list(lst, int(i), x[j])
+    return lst
+
+
+@register_op("split_list")
+def _split_list(lst, x, sizes):
+    sizes = [int(s) for s in np.asarray(sizes)]
+    if sum(sizes) != x.shape[0]:
+        raise ValueError(
+            f"split_list: sizes {sizes} sum to {sum(sizes)} but the "
+            f"input has {x.shape[0]} rows (TensorArraySplit contract)")
+    out, off = [], 0
+    for sz in sizes:
+        out.append(x[off:off + sz])
+        off += sz
+    lst.arrays = out
+    return lst
+
+
+@register_op("pick_list")
+def _pick_list(lst, indices):
+    return jnp.concatenate([jnp.asarray(_read_list(lst, int(i)))
+                            for i in np.asarray(indices)], axis=0)
+
+
+@register_op("tear")
+def _tear(x, axis=0):
+    """Split into a TensorList along `axis` (reference parity op tear)."""
+    moved = jnp.moveaxis(x, axis, 0)
+    return TensorList([moved[i] for i in range(moved.shape[0])])
+
+
+register_op("real_div", lambda a, b: a / b)    # TF RealDiv declarable
+
+
+@register_op("print_variable")
+def _print_variable(x, message=""):
+    """Reference parity op print_variable: prints (host callback under
+    jit) and passes through."""
+    if isinstance(x, jax.core.Tracer):
+        safe = message.replace("{", "{{").replace("}", "}}")
+        jax.debug.print(safe + "{x}", x=x)
+        return x
+    print(f"{message}{np.asarray(x)}")
+    return x
+
+
+@register_op("lstm_block_cell")
+def _lstm_block_cell(x, h, c, w_ih, w_hh, b=None):
+    """Reference lstmBlockCell: one step returning the full gate trace
+    (i, c_new, f, o, z, h_new, y=h_new), IFCO gate order."""
+    g = x @ w_ih + h @ w_hh + (0 if b is None else b)
+    H = h.shape[-1]
+    i = jax.nn.sigmoid(g[..., :H])
+    f = jax.nn.sigmoid(g[..., H:2 * H])
+    z = jnp.tanh(g[..., 2 * H:3 * H])
+    o = jax.nn.sigmoid(g[..., 3 * H:])
+    c_new = f * c + i * z
+    h_new = o * jnp.tanh(c_new)
+    return i, c_new, f, o, z, h_new, h_new
+
+
+@register_op("lstm_block")
+def _lstm_block(x, w_ih, w_hh, b=None):
+    """Reference lstmBlock: whole-sequence lstmBlockCell scan; returns
+    the stacked (i, c, f, o, z, h, y) sequences, time axis 1."""
+    Bsz, T, _ = x.shape
+    H = w_hh.shape[0]
+    h0 = jnp.zeros((Bsz, H), x.dtype)
+
+    def step(carry, xt):
+        h, c = carry
+        i, c_new, f, o, z, h_new, y = _lstm_block_cell(xt, h, c, w_ih,
+                                                       w_hh, b)
+        return (h_new, c_new), (i, c_new, f, o, z, h_new, y)
+
+    (_, _), seqs = lax.scan(step, (h0, h0), jnp.swapaxes(x, 0, 1))
+    return tuple(jnp.swapaxes(s, 0, 1) for s in seqs)
+
+
+register_op("static_rnn", lambda x, w, rw, b=None, h0=None:
+            _dynamic_rnn(x, w, rw, b, h0))
+register_op("static_bidirectional_rnn",
+            lambda x, w_f, rw_f, b_f, w_b, rw_b, b_b:
+            _dynamic_bidirectional_rnn(x, w_f, rw_f, b_f, w_b, rw_b, b_b))
