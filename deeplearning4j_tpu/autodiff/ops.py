@@ -2668,3 +2668,45 @@ register_op("static_rnn", lambda x, w, rw, b=None, h0=None:
 register_op("static_bidirectional_rnn",
             lambda x, w_f, rw_f, b_f, w_b, rw_b, b_b:
             _dynamic_bidirectional_rnn(x, w_f, rw_f, b_f, w_b, rw_b, b_b))
+
+
+# ---- round-3 tail, part 6: select + the word2vec training ops ----
+
+register_op("select", lambda cond, a, b: jnp.where(cond, a, b))
+
+
+@register_op("skipgram")
+def _skipgram(syn0, syn1, centers, contexts, negatives, lr=0.025):
+    """Reference skipgram declarable op (generic/nn/skipgram.cpp,
+    negative-sampling form): one batched SGD update of the embedding
+    matrices, functional (params in -> updated params out, loss).  The
+    per-PAIR lr semantics (sum over batch, not mean) match
+    nlp.Word2Vec."""
+    def loss_fn(params):
+        s0, s1 = params
+        v = s0[centers]
+        pos = jnp.sum(v * s1[contexts], -1)
+        negs = jnp.einsum("bd,bnd->bn", v, s1[negatives])
+        return -(jnp.sum(jax.nn.log_sigmoid(pos))
+                 + jnp.sum(jax.nn.log_sigmoid(-negs)))
+
+    loss, (g0, g1) = jax.value_and_grad(loss_fn)((syn0, syn1))
+    return syn0 - lr * g0, syn1 - lr * g1, loss
+
+
+@register_op("cbow")
+def _cbow(syn0, syn1, ctx, ctx_mask, centers, negatives, lr=0.025):
+    """Reference cbow declarable op: window-mean input embedding predicts
+    the center word; one batched functional SGD update."""
+    def loss_fn(params):
+        s0, s1 = params
+        e = s0[ctx] * ctx_mask[..., None]
+        v = jnp.sum(e, 1) / jnp.maximum(
+            jnp.sum(ctx_mask, 1, keepdims=True), 1.0)
+        pos = jnp.sum(v * s1[centers], -1)
+        negs = jnp.einsum("bd,bnd->bn", v, s1[negatives])
+        return -(jnp.sum(jax.nn.log_sigmoid(pos))
+                 + jnp.sum(jax.nn.log_sigmoid(-negs)))
+
+    loss, (g0, g1) = jax.value_and_grad(loss_fn)((syn0, syn1))
+    return syn0 - lr * g0, syn1 - lr * g1, loss

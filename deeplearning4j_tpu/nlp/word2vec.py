@@ -212,48 +212,27 @@ class Word2Vec(WordVectorsMixin):
 
     # ---- compiled updates ----
     def _make_step(self):
-        """Skip-gram: maximize log σ(v_c·u_o) + Σ log σ(-v_c·u_neg)."""
+        """Skip-gram: maximize log σ(v_c·u_o) + Σ log σ(-v_c·u_neg) —
+        the registered `skipgram` declarable op (single implementation;
+        batch-SUM reduction = classic per-PAIR lr semantics)."""
+        from deeplearning4j_tpu.autodiff.ops import OP_TABLE
         lr = self.learning_rate
 
         def step(syn0, syn1, center, context, negatives):
-            def loss_fn(params):
-                s0, s1 = params
-                v = s0[center]                         # [B, D]
-                u_pos = s1[context]                    # [B, D]
-                u_neg = s1[negatives]                  # [B, neg, D]
-                pos = jnp.sum(v * u_pos, -1)
-                negs = jnp.einsum("bd,bnd->bn", v, u_neg)
-                # SUM over the batch: classic word2vec applies lr per PAIR;
-                # mean-reduction would shrink the step by batch_size
-                return -(jnp.sum(jax.nn.log_sigmoid(pos))
-                         + jnp.sum(jax.nn.log_sigmoid(-negs)))
-
-            loss, grads = jax.value_and_grad(loss_fn)((syn0, syn1))
-            g0, g1 = grads
-            return syn0 - lr * g0, syn1 - lr * g1, loss
+            return OP_TABLE["skipgram"](syn0, syn1, center, context,
+                                        negatives, lr)
 
         return jax.jit(step, donate_argnums=(0, 1))
 
     def _make_cbow_step(self):
-        """CBOW: window-mean input embedding predicts the center word."""
+        """CBOW: window-mean input embedding predicts the center word —
+        the registered `cbow` declarable op (single implementation)."""
+        from deeplearning4j_tpu.autodiff.ops import OP_TABLE
         lr = self.learning_rate
 
         def step(syn0, syn1, ctx, ctx_mask, center, negatives):
-            def loss_fn(params):
-                s0, s1 = params
-                e = s0[ctx] * ctx_mask[..., None]      # [B, 2w, D]
-                v = jnp.sum(e, 1) / jnp.maximum(
-                    jnp.sum(ctx_mask, 1, keepdims=True), 1.0)
-                u_pos = s1[center]
-                u_neg = s1[negatives]
-                pos = jnp.sum(v * u_pos, -1)
-                negs = jnp.einsum("bd,bnd->bn", v, u_neg)
-                return -(jnp.sum(jax.nn.log_sigmoid(pos))
-                         + jnp.sum(jax.nn.log_sigmoid(-negs)))
-
-            loss, grads = jax.value_and_grad(loss_fn)((syn0, syn1))
-            g0, g1 = grads
-            return syn0 - lr * g0, syn1 - lr * g1, loss
+            return OP_TABLE["cbow"](syn0, syn1, ctx, ctx_mask, center,
+                                    negatives, lr)
 
         return jax.jit(step, donate_argnums=(0, 1))
 

@@ -1123,3 +1123,29 @@ def test_round3e_lstm_block_and_static_rnn():
         [2.0])
     out = op("print_variable")(jnp.asarray([1.0]), "v=")
     np.testing.assert_allclose(np.asarray(out), [1.0])
+
+
+def test_round3f_select_and_word2vec_ops():
+    np.testing.assert_allclose(
+        np.asarray(op("select")(jnp.asarray([True, False]),
+                                jnp.asarray([1.0, 1.0]),
+                                jnp.asarray([2.0, 2.0]))), [1.0, 2.0])
+    r = np.random.RandomState(0)
+    V, D, B, N = 20, 8, 4, 3
+    syn0 = jnp.asarray(r.randn(V, D).astype(np.float32) * 0.1)
+    syn1 = jnp.asarray(np.zeros((V, D), np.float32))
+    centers = jnp.asarray(r.randint(0, V, B))
+    contexts = jnp.asarray(r.randint(0, V, B))
+    negs = jnp.asarray(r.randint(0, V, (B, N)))
+    s0, s1, l0 = op("skipgram")(syn0, syn1, centers, contexts, negs)
+    losses = [float(l0)]
+    for _ in range(30):
+        s0, s1, l = op("skipgram")(s0, s1, centers, contexts, negs)
+        losses.append(float(l))
+    assert losses[-1] < losses[0]            # the update actually learns
+    ctx = jnp.asarray(r.randint(0, V, (B, 4)))
+    cm = jnp.asarray(np.ones((B, 4), np.float32))
+    c0, c1, cl0 = op("cbow")(syn0, syn1, ctx, cm, centers, negs)
+    for _ in range(30):
+        c0, c1, cl = op("cbow")(c0, c1, ctx, cm, centers, negs)
+    assert float(cl) < float(cl0)
