@@ -29,12 +29,15 @@ NEG_INF = -1e30
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None, mask=None):
     """[B, H, T_local, D] per device; returns the local output shard.
 
     Causal masking uses global positions: device i holds sequence chunk i
     (contiguous layout).  Per ring step the KV chunk's source device index
-    is tracked so query/key global offsets stay correct.
+    is tracked so query/key global offsets stay correct.  ``mask``:
+    optional [B, T_local] 1/0 keep-mask over the local KV chunk — it
+    rotates around the ring with its K/V chunk, giving padded long-
+    context batches the same semantics as `fused_attention`'s mask.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -43,20 +46,22 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     B, H, T, D = q.shape
     qs = q * scale
 
-    def chunk_scores(kc, src):
+    def chunk_scores(kc, mc, src):
         # f32 scores/stats regardless of input dtype — same accumulation
         # invariant as ops/attention_kernels.py (bf16 normalizer drift
         # grows with ring length, exactly where this path is used)
         s = jnp.einsum("bhqd,bhkd->bhqk", qs, kc,
                        preferred_element_type=jnp.float32)
+        if mc is not None:
+            s = jnp.where(mc[:, None, None, :] > 0, s, NEG_INF)
         if causal:
             qpos = my * T + jnp.arange(T)[:, None]
             kpos = src * T + jnp.arange(kc.shape[2])[None, :]
             s = jnp.where(qpos >= kpos, s, NEG_INF)
         return s
 
-    def accumulate(acc, m, l, kc, vc, src):
-        s = chunk_scores(kc, src)
+    def accumulate(acc, m, l, kc, vc, mc, src):
+        s = chunk_scores(kc, mc, src)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[..., None])
         corr = jnp.exp(m - m_new)
@@ -67,13 +72,16 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         return acc_new, m_new, l_new
 
     def step(i, carry):
-        acc, m, l, kc, vc = carry
-        # rotate KV around the ring (ICI neighbour exchange), then consume
+        acc, m, l, kc, vc, mc = carry
+        # rotate KV (+ its mask chunk) around the ring (ICI neighbour
+        # exchange), then consume
         perm = [(j, (j + 1) % n) for j in range(n)]
         kc = jax.lax.ppermute(kc, axis_name, perm)
         vc = jax.lax.ppermute(vc, axis_name, perm)
-        acc, m, l = accumulate(acc, m, l, kc, vc, (my - i) % n)
-        return acc, m, l, kc, vc
+        if mc is not None:
+            mc = jax.lax.ppermute(mc, axis_name, perm)
+        acc, m, l = accumulate(acc, m, l, kc, vc, mc, (my - i) % n)
+        return acc, m, l, kc, vc, mc
 
     # derive from q so the carries inherit shard_map's varying-axis type,
     # then promote to f32 accumulation
@@ -81,6 +89,15 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     m = jnp.full_like(q[..., 0], NEG_INF, dtype=jnp.float32)
     l = jnp.zeros_like(q[..., 0], dtype=jnp.float32)
     # step 0: local chunk, no communication; n-1 rotations total
-    acc, m, l = accumulate(acc, m, l, k, v, my)
-    acc, m, l, _, _ = jax.lax.fori_loop(1, n, step, (acc, m, l, k, v))
+    acc, m, l = accumulate(acc, m, l, k, v, mask, my)
+    if mask is None:
+        def step_unmasked(i, carry):
+            acc_, m_, l_, kc, vc, _ = step(i, carry + (None,))
+            return acc_, m_, l_, kc, vc
+
+        acc, m, l, _, _ = jax.lax.fori_loop(
+            1, n, step_unmasked, (acc, m, l, k, v))
+    else:
+        acc, m, l, _, _, _ = jax.lax.fori_loop(
+            1, n, step, (acc, m, l, k, v, mask))
     return (acc / l[..., None]).astype(q.dtype)
