@@ -285,20 +285,29 @@ def main():
         sps = bench_resnet50()
     per_chip = sps / n_chips
 
-    extras = {}
-    try:
-        extras["lenet_mnist_samples_sec"] = round(bench_lenet(), 1)
-        extras["lstm_charlm_tokens_sec"] = round(
-            bench_lstm_charlm(steps=3 if quick else 10), 1)
-        extras["bert_base_mlm_tokens_sec"] = round(
-            bench_bert_base(steps=3 if quick else 10), 1)
-        if not quick:
-            extras["bert_tf_import_finetune_tokens_sec"] = round(
-                bench_bert_tf_import(), 1)
-    except Exception as e:  # extras must never break the headline line
-        print(f"extra benches failed: {e}", file=sys.stderr)
-    if extras:
-        print(json.dumps({"extras": extras}), file=sys.stderr)
+    # One JSON line per BASELINE config on stdout (VERDICT r3 #9) so the
+    # recorded artifact carries all metrics, not just the headline.  Each
+    # config is independent: a failure prints an error line for that metric
+    # only.  The headline is printed LAST — the driver's `parsed` field
+    # takes the final stdout JSON line.
+    configs = [
+        ("lenet_mnist_samples_per_sec", "samples/sec", lambda: bench_lenet()),
+        ("lstm_charlm_tokens_per_sec", "tokens/sec",
+         lambda: bench_lstm_charlm(steps=3 if quick else 10)),
+        ("bert_base_mlm_tokens_per_sec", "tokens/sec",
+         lambda: bench_bert_base(steps=3 if quick else 10)),
+    ]
+    if not quick:
+        configs.append(("bert_tf_import_finetune_tokens_per_sec",
+                        "tokens/sec", lambda: bench_bert_tf_import()))
+    for metric, unit, fn in configs:
+        try:
+            v = fn()
+            print(json.dumps({"metric": metric, "value": round(v, 1),
+                              "unit": unit}), flush=True)
+        except Exception as e:  # a failing extra must not break the headline
+            print(json.dumps({"metric": metric, "value": None, "unit": unit,
+                              "error": repr(e)[:300]}), flush=True)
 
     print(json.dumps({
         "metric": "resnet50_train_samples_per_sec_per_chip",

@@ -1111,7 +1111,13 @@ def _conv3d(x, w, b=None, stride=(1, 1, 1), padding="SAME",
 
 @register_op("deconv2d")
 def _deconv2d(x, w, b=None, stride=(2, 2), padding="SAME"):
-    y = lax.conv_transpose(x, w, strides=tuple(stride), padding=padding,
+    """Gradient-form transposed conv (reference deconv2d.cpp; same
+    convention as TF/Keras/torch).  lax.conv_transpose slides the kernel
+    in CORRELATION orientation over the dilated input — spatially flipped
+    relative to the gradient form — so flip here (validated against a
+    scatter-accumulate golden in tests/opval_specs_nn.py)."""
+    y = lax.conv_transpose(x, jnp.flip(w, (0, 1)), strides=tuple(stride),
+                           padding=padding,
                            dimension_numbers=("NHWC", "HWIO", "NHWC"))
     return y if b is None else y + b
 
@@ -2088,8 +2094,10 @@ def _separable_conv2d(x, w_depth, w_point, stride=(1, 1), padding="SAME"):
 @register_op("deconv3d")
 def _deconv3d(x, w, stride=(1, 1, 1), padding="SAME"):
     """[B,D,H,W,Ci] x [Kd,Kh,Kw,Ci,Co] transpose conv (reference
-    deconv3d.cpp)."""
-    return lax.conv_transpose(x, w, tuple(stride), padding,
+    deconv3d.cpp) — gradient form, so the kernel is flipped before
+    lax.conv_transpose (see deconv2d)."""
+    return lax.conv_transpose(x, jnp.flip(w, (0, 1, 2)), tuple(stride),
+                              padding,
                               dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
 
 

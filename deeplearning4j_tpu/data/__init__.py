@@ -5,7 +5,13 @@ from deeplearning4j_tpu.data.iterators import (  # noqa: F401
     ListDataSetIterator)
 from deeplearning4j_tpu.data.records import (  # noqa: F401
     CollectionRecordReader, CSVRecordReader, CSVSequenceRecordReader,
-    ImageRecordReader, LineRecordReader, RecordReader, VideoRecordReader)
+    ImageRecordReader, JacksonLineRecordReader, LibSvmRecordReader,
+    LineRecordReader, RecordReader, RegexLineRecordReader,
+    RegexSequenceRecordReader, SVMLightRecordReader,
+    TransformProcessRecordReader, TransformProcessSequenceRecordReader,
+    VideoRecordReader)
+from deeplearning4j_tpu.data.local_execution import (  # noqa: F401
+    LocalTransformExecutor)
 from deeplearning4j_tpu.data.transform import (  # noqa: F401
     ColumnMeta, Schema, TransformProcess)
 from deeplearning4j_tpu.data.normalizers import (  # noqa: F401

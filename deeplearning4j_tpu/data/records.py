@@ -224,3 +224,188 @@ class VideoRecordReader(RecordReader):
                 raise ValueError(
                     f"VideoRecordReader: {p} is neither a frame directory "
                     "nor a .gif")
+
+
+# ---------------------------------------------------------------------------
+# Round-4 reader tail (VERDICT r3 #6): Jackson/JSON, SVMLight/LibSvm,
+# regex, and TransformProcess-wrapping readers — the remaining
+# `datavec-api` reader families.
+# ---------------------------------------------------------------------------
+
+class JacksonLineRecordReader(RecordReader):
+    """One JSON object per line -> one record (reference
+    `datavec-api/.../impl/jackson/JacksonLineRecordReader` with a
+    `FieldSelection`): `fields` names the paths to extract, in order; a
+    path is a '/'-joined key chain into nested objects ("a/b"). Missing
+    paths yield the per-field default (None unless given)."""
+
+    def __init__(self, fields: Sequence[str],
+                 path: Optional[str] = None,
+                 text: Optional[str] = None,
+                 defaults: Optional[Sequence[Any]] = None):
+        if (path is None) == (text is None):
+            raise ValueError("Exactly one of path/text required")
+        self.path, self.text = path, text
+        self.fields = list(fields)
+        self.defaults = (list(defaults) if defaults is not None
+                         else [None] * len(self.fields))
+
+    def _extract(self, obj, field, default):
+        cur = obj
+        for key in field.split("/"):
+            if not isinstance(cur, dict) or key not in cur:
+                return default
+            cur = cur[key]
+        return cur
+
+    def __iter__(self):
+        import json as _json
+        f = open(self.path) if self.path else io.StringIO(self.text)
+        try:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                obj = _json.loads(line)
+                yield [self._extract(obj, fld, d)
+                       for fld, d in zip(self.fields, self.defaults)]
+        finally:
+            f.close()
+
+
+class SVMLightRecordReader(RecordReader):
+    """SVMLight/LibSVM sparse format (reference `SVMLightRecordReader` /
+    `LibSvmRecordReader`, which upstream is the same parser):
+    ``label [label2,...] idx:val idx:val ...`` with 1-based indices by
+    default.  Yields ``[f0, f1, ..., f{n-1}, label]`` dense records; with
+    `append_label=False` only the features.  `num_features` bounds the
+    dense width (the reference requires it too).  '#' comments and
+    qid:* tokens are skipped."""
+
+    def __init__(self, num_features: int,
+                 path: Optional[str] = None, text: Optional[str] = None,
+                 zero_based: bool = False, append_label: bool = True):
+        if (path is None) == (text is None):
+            raise ValueError("Exactly one of path/text required")
+        self.path, self.text = path, text
+        self.num_features = num_features
+        self.zero_based = zero_based
+        self.append_label = append_label
+
+    def __iter__(self):
+        f = open(self.path) if self.path else io.StringIO(self.text)
+        try:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                toks = line.split()
+                label = toks[0]
+                feats = [0.0] * self.num_features
+                for t in toks[1:]:
+                    if ":" not in t:
+                        raise ValueError(
+                            f"SVMLight: malformed token {t!r}")
+                    k, v = t.split(":", 1)
+                    if k == "qid":
+                        continue
+                    idx = int(k) - (0 if self.zero_based else 1)
+                    if not 0 <= idx < self.num_features:
+                        raise ValueError(
+                            f"SVMLight: index {k} out of range for "
+                            f"num_features={self.num_features}")
+                    feats[idx] = float(v)
+                if self.append_label:
+                    # multilabel "1,3" stays a string; plain labels parse
+                    lab = (label if "," in label else float(label))
+                    yield feats + [lab]
+                else:
+                    yield feats
+        finally:
+            f.close()
+
+
+#: Upstream `LibSvmRecordReader` subclasses SVMLightRecordReader with no
+#: behavior change — same aliasing here.
+LibSvmRecordReader = SVMLightRecordReader
+
+
+class RegexLineRecordReader(RecordReader):
+    """Regex groups -> record fields, one record per line (reference
+    `RegexLineRecordReader`).  Lines that don't match raise — silent
+    drops hide data bugs (the reference throws likewise)."""
+
+    def __init__(self, regex: str, skip_lines: int = 0,
+                 path: Optional[str] = None, text: Optional[str] = None):
+        import re
+        if (path is None) == (text is None):
+            raise ValueError("Exactly one of path/text required")
+        self.path, self.text = path, text
+        self.pattern = re.compile(regex)
+        self.skip_lines = skip_lines
+
+    def __iter__(self):
+        f = open(self.path) if self.path else io.StringIO(self.text)
+        try:
+            for i, line in enumerate(f):
+                if i < self.skip_lines:
+                    continue
+                line = line.rstrip("\n")
+                m = self.pattern.match(line)
+                if m is None:
+                    raise ValueError(
+                        f"line {i}: {line!r} does not match "
+                        f"{self.pattern.pattern!r}")
+                yield list(m.groups())
+        finally:
+            f.close()
+
+
+class RegexSequenceRecordReader(RecordReader):
+    """One file -> one sequence of regex-group records (reference
+    `RegexSequenceRecordReader`; the canonical use is log files, one
+    timestep per line)."""
+
+    def __init__(self, regex: str, paths: Sequence[str],
+                 skip_lines: int = 0):
+        self.regex = regex
+        self.paths = list(paths)
+        self.skip_lines = skip_lines
+
+    def __iter__(self):
+        for p in self.paths:
+            yield list(RegexLineRecordReader(self.regex, self.skip_lines,
+                                             path=p))
+
+
+class TransformProcessRecordReader(RecordReader):
+    """Wrap a reader with a TransformProcess applied per record
+    (reference `TransformProcessRecordReader`): filtered records are
+    skipped transparently, so downstream iterators never see them."""
+
+    def __init__(self, reader: RecordReader, transform_process):
+        self.reader = reader
+        self.tp = transform_process
+
+    def __iter__(self):
+        for rec in self.reader:
+            out = self.tp.execute_record(rec)
+            if out is not None:
+                yield out
+
+
+class TransformProcessSequenceRecordReader(RecordReader):
+    """Sequence-reader counterpart (reference
+    `TransformProcessSequenceRecordReader`): the process runs per
+    timestep; a sequence survives with its surviving timesteps."""
+
+    def __init__(self, reader: RecordReader, transform_process):
+        self.reader = reader
+        self.tp = transform_process
+
+    def __iter__(self):
+        for seq in self.reader:
+            out = [t for t in (self.tp.execute_record(r) for r in seq)
+                   if t is not None]
+            if out:
+                yield out

@@ -456,9 +456,15 @@ class Deconvolution2DLayer(Layer):
             kh, kw = _pair(self.kernel_size)
             ph, pw = _pair(self.padding)
             pad = [(kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)]
+        # gradient-form transposed conv (TF/Keras/reference convention):
+        # lax.conv_transpose slides the kernel in correlation orientation,
+        # spatially flipped relative to the gradient form — flip here.
+        # Without this, Conv2DTranspose imports are spatially mirrored
+        # (caught by the op-validation sweep; the old conformance test's
+        # deconv fed an avg-pool, which is flip-invariant).
         y = lax.conv_transpose(
-            x, params["W"], strides=_pair(self.stride), padding=pad,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            x, jnp.flip(params["W"], (0, 1)), strides=_pair(self.stride),
+            padding=pad, dimension_numbers=("NHWC", "HWIO", "NHWC"))
         if self.has_bias:
             y = y + params["b"]
         return self.act_fn()(y), state

@@ -120,8 +120,11 @@ class Deconvolution3DLayer(Layer):
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         x = self.maybe_input_dropout(x, train, rng)
         pad = "SAME" if self.convolution_mode.lower() == "same" else "VALID"
+        # gradient-form transposed conv — flip the kernel for
+        # lax.conv_transpose (see Deconvolution2DLayer.apply)
         y = lax.conv_transpose(
-            x, params["W"], strides=_triple(self.stride), padding=pad,
+            x, jnp.flip(params["W"], (0, 1, 2)),
+            strides=_triple(self.stride), padding=pad,
             dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
         if self.has_bias:
             y = y + params["b"]

@@ -1,0 +1,238 @@
+"""Sharded multi-host checkpointing (orbax-style).
+
+Reference role: `ModelSerializer` + the Spark `TrainingMaster`'s
+driver-side model sync (SURVEY.md §5.4) — but at real multi-host scale a
+single process cannot (and must not) gather the model: every process
+writes exactly the shards it owns, and restore re-assembles each leaf for
+whatever mesh the *new* job uses, which may differ from the mesh at save
+time (elastic resume / topology change).
+
+Format (one checkpoint = one directory, assumed on storage every process
+can reach — shared FS or fused GCS mount on real pods):
+
+- ``shards-{rank}.npz``  — per-process chunk payloads.  Each process
+  writes only the addressable shards with ``replica_id == 0``, so every
+  global chunk lands exactly once across the job.
+- ``index-{rank}.json``  — for each written chunk: the flat leaf id and
+  the global index window ``[[start, stop], ...]`` it covers.
+- ``manifest.json``      — written by rank 0 AFTER a global barrier: flat
+  leaf specs (global shape/dtype), tree structure token, user metadata
+  (step counters, config).  Its presence commits the checkpoint — a
+  loader never sees a torn write (the reference's CheckpointListener
+  tmp-and-rename ritual, distributed).
+
+Resharding on load: for every addressable shard the NEW sharding wants,
+the loader assembles the window from whichever saved chunks intersect it
+— restoring a dp=4 checkpoint into a dp=2×tp=2 job (or into one process)
+is the same code path.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+MANIFEST = "manifest.json"
+
+
+def _window(index, shape) -> List[List[int]]:
+    """jax shard .index (tuple of slices) -> [[start, stop], ...]."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def save_sharded(directory: str, tree: Any,
+                 metadata: Optional[Dict[str, Any]] = None) -> None:
+    """Write `tree` (params / opt state / anything pytree) as a sharded
+    checkpoint.  Every process participates; host numpy leaves are treated
+    as replicated (rank 0 writes them)."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    rank = jax.process_index()
+    os.makedirs(directory, exist_ok=True)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+
+    chunks: Dict[str, np.ndarray] = {}
+    index: List[Dict[str, Any]] = []
+    specs = []
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, jax.Array):
+            specs.append({"shape": list(leaf.shape),
+                          "dtype": str(leaf.dtype)})
+            for j, shard in enumerate(leaf.addressable_shards):
+                if shard.replica_id != 0:
+                    continue
+                key = f"leaf{i}_chunk{j}"
+                chunks[key] = np.asarray(shard.data)
+                index.append({"leaf": i, "key": key,
+                              "window": _window(shard.index, leaf.shape)})
+        else:
+            arr = np.asarray(leaf)
+            specs.append({"shape": list(arr.shape),
+                          "dtype": str(arr.dtype)})
+            if rank == 0:
+                key = f"leaf{i}_chunk0"
+                chunks[key] = arr
+                index.append({"leaf": i, "key": key,
+                              "window": _window(
+                                  (slice(None),) * arr.ndim, arr.shape)})
+
+    np.savez(os.path.join(directory, f"shards-{rank}.npz"), **chunks)
+    with open(os.path.join(directory, f"index-{rank}.json"), "w") as f:
+        json.dump(index, f)
+
+    if jax.process_count() > 1:
+        multihost_utils.sync_global_devices(f"ckpt-save:{directory}")
+    if rank == 0:
+        manifest = {"format": "deeplearning4j_tpu.sharded.v1",
+                    "num_ranks_at_save": jax.process_count(),
+                    "leaves": specs,
+                    "metadata": metadata or {}}
+        tmp = os.path.join(directory, MANIFEST + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, os.path.join(directory, MANIFEST))
+    if jax.process_count() > 1:
+        multihost_utils.sync_global_devices(f"ckpt-commit:{directory}")
+
+
+def read_metadata(directory: str) -> Dict[str, Any]:
+    with open(os.path.join(directory, MANIFEST)) as f:
+        return json.load(f)["metadata"]
+
+
+class _ChunkStore:
+    """Lazy reader over every rank's chunk files at save time."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self.by_leaf: Dict[int, List[Dict[str, Any]]] = {}
+        self._files: Dict[int, Any] = {}
+        for name in sorted(os.listdir(directory)):
+            if not (name.startswith("index-") and name.endswith(".json")):
+                continue
+            rank = int(name[len("index-"):-len(".json")])
+            with open(os.path.join(directory, name)) as f:
+                for entry in json.load(f):
+                    entry = dict(entry, rank=rank)
+                    self.by_leaf.setdefault(entry["leaf"], []).append(entry)
+
+    def _file(self, rank: int):
+        if rank not in self._files:
+            self._files[rank] = np.load(
+                os.path.join(self.directory, f"shards-{rank}.npz"))
+        return self._files[rank]
+
+    def assemble(self, leaf: int, window: Sequence[Sequence[int]],
+                 dtype) -> np.ndarray:
+        """Assemble the global index window [[start, stop], ...] of a leaf
+        from every intersecting saved chunk (the resharding core)."""
+        shape = tuple(stop - start for start, stop in window)
+        out = np.empty(shape, dtype)
+        filled = np.zeros(shape, bool)
+        for entry in self.by_leaf.get(leaf, []):
+            cw = entry["window"]
+            inter = [(max(a0, b0), min(a1, b1))
+                     for (a0, a1), (b0, b1) in zip(window, cw)]
+            if any(lo >= hi for lo, hi in inter):
+                continue
+            data = self._file(entry["rank"])[entry["key"]]
+            src = tuple(slice(lo - c0, hi - c0)
+                        for (lo, hi), (c0, _) in zip(inter, cw))
+            dst = tuple(slice(lo - w0, hi - w0)
+                        for (lo, hi), (w0, _) in zip(inter, window))
+            out[dst] = data[src]
+            filled[dst] = True
+        if not filled.all():
+            raise ValueError(
+                f"checkpoint is missing data for leaf {leaf} window "
+                f"{window} — saved with an incompatible layout?")
+        return out
+
+
+def load_sharded(directory: str, like: Any) -> Any:
+    """Restore a tree saved with `save_sharded`.
+
+    `like` supplies the tree structure and the TARGET placement: each leaf
+    may be a `jax.Array` (its sharding — possibly over a different mesh
+    than at save time — is reused), a `jax.ShapeDtypeStruct` with a
+    `.sharding`, or anything else (restored as host numpy).  Shapes and
+    dtypes must match the manifest."""
+    import jax
+
+    if not os.path.exists(os.path.join(directory, MANIFEST)):
+        raise FileNotFoundError(
+            f"{directory}: no committed checkpoint (manifest.json absent)")
+    with open(os.path.join(directory, MANIFEST)) as f:
+        manifest = json.load(f)
+    store = _ChunkStore(directory)
+    leaves, treedef = jax.tree_util.tree_flatten(like)
+    if len(leaves) != len(manifest["leaves"]):
+        raise ValueError(
+            f"template has {len(leaves)} leaves but checkpoint has "
+            f"{len(manifest['leaves'])}")
+
+    out = []
+    for i, (leaf, spec) in enumerate(zip(leaves, manifest["leaves"])):
+        shape = tuple(spec["shape"])
+        dtype = np.dtype(spec["dtype"])
+        t_shape = tuple(getattr(leaf, "shape", shape))
+        if t_shape != shape:
+            raise ValueError(
+                f"leaf {i}: template shape {t_shape} != saved {shape}")
+        t_dtype = getattr(leaf, "dtype", dtype)
+        if np.dtype(t_dtype) != dtype:
+            raise ValueError(
+                f"leaf {i}: template dtype {t_dtype} != saved {dtype} — "
+                "cast after load for precision changes (a silent dtype "
+                "swap would poison the first jitted step)")
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and isinstance(leaf, (jax.Array,
+                                                      jax.ShapeDtypeStruct)):
+            def cb(index, _leaf=i, _shape=shape, _dtype=dtype,
+                   _store=store):
+                win = _window(index, _shape)
+                return _store.assemble(_leaf, win, _dtype)
+
+            out.append(jax.make_array_from_callback(shape, sharding, cb))
+        else:
+            full = store.assemble(
+                i, [[0, d] for d in shape], dtype)
+            out.append(full)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# Model-level convenience (the multi-host ModelSerializer face)
+# ---------------------------------------------------------------------------
+
+def save_model_sharded(net, directory: str) -> None:
+    """Sharded save of a MultiLayerNetwork/ComputationGraph: params, layer
+    state, updater state, and counters; config travels in the manifest."""
+    tree = {"params": net.params_, "state": net.state_,
+            "opt": net.opt_state_}
+    save_sharded(directory, tree, metadata={
+        "config": net.conf.to_json(), "iteration": net.iteration,
+        "epoch": net.epoch})
+
+
+def load_model_sharded(net, directory: str):
+    """Restore into an already-init()ed net whose current arrays define
+    the target sharding (call under the NEW mesh).  Returns `net`."""
+    like = {"params": net.params_, "state": net.state_,
+            "opt": net.opt_state_}
+    tree = load_sharded(directory, like)
+    meta = read_metadata(directory)
+    net.params_ = tree["params"]
+    net.state_ = tree["state"]
+    net.opt_state_ = tree["opt"]
+    net.iteration = meta["iteration"]
+    net.epoch = meta["epoch"]
+    return net

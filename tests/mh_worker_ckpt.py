@@ -1,0 +1,103 @@
+"""Sharded-checkpoint worker (spawned by test_checkpoint_sharded via
+LocalLauncher — NOT a pytest file).
+
+Modes:
+  save <dir>              — build a deterministic tree sharded over the
+                            2-process global mesh and save_sharded it.
+  train_save <dir> <k>    — train k steps, save_model_sharded, train k
+                            more, dump final params (exact-resume oracle).
+  resume <dir> <k>        — restore under a fresh cluster, train k steps,
+                            dump final params (must match the oracle).
+"""
+import os
+import sys
+
+import numpy as np
+
+from deeplearning4j_tpu.parallel import multihost
+
+multihost.initialize()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from deeplearning4j_tpu.parallel.checkpoint import (  # noqa: E402
+    load_model_sharded, save_model_sharded, save_sharded)
+
+mode = sys.argv[1]
+out_dir = sys.argv[2]
+rank = multihost.process_index()
+mesh = multihost.global_mesh()
+
+
+def make_net():
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.train import Adam
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(0.05))
+            .list([DenseLayer(n_out=16, activation="tanh"),
+                   OutputLayer(n_out=2, loss="mcxent",
+                               activation="softmax")])
+            .set_input_type(InputType.feed_forward(10)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def local_batch():
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((16, 10)).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[(X.sum(1) > 0).astype(int)]
+    world = multihost.process_count()
+    per = X.shape[0] // world
+    return (X[rank * per:(rank + 1) * per],
+            Y[rank * per:(rank + 1) * per])
+
+
+if mode == "save":
+    # deterministic global values, sharded + replicated + host leaves
+    big = np.arange(48, dtype=np.float32).reshape(8, 6)
+    sharded = multihost.shard_host_local_batch(
+        mesh, big[rank * 4:(rank + 1) * 4])        # [8, 6] over 'data'
+    replicated = jax.device_put(
+        jnp.asarray(np.arange(5, dtype=np.float32) * 2),
+        NamedSharding(mesh, P()))
+    tree = {"w": sharded, "b": replicated,
+            "step": np.int64(17), "host": np.full(3, 9.0, np.float32)}
+    save_sharded(out_dir, tree, metadata={"note": "roundtrip"})
+    print(f"rank {rank}: saved", flush=True)
+
+elif mode == "train_save":
+    k = int(sys.argv[3])
+    from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+    net = make_net()
+    pw = ParallelWrapper(net, mesh)
+    xl, yl = local_batch()
+    for _ in range(k):
+        pw.fit_host_local(xl, yl)
+    save_model_sharded(net, out_dir)
+    for _ in range(k):
+        pw.fit_host_local(xl, yl)
+    if rank == 0:
+        np.savez(os.path.join(out_dir, "oracle.npz"),
+                 params=np.asarray(net.params()))
+    print(f"rank {rank}: trained+saved score={net.score():.6f}",
+          flush=True)
+
+elif mode == "resume":
+    k = int(sys.argv[3])
+    from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+    net = make_net()
+    pw = ParallelWrapper(net, mesh)
+    xl, yl = local_batch()
+    pw.fit_host_local(xl, yl)          # materialize opt state to restore
+    load_model_sharded(net, out_dir)
+    for _ in range(k):
+        pw.fit_host_local(xl, yl)
+    if rank == 0:
+        np.savez(os.path.join(out_dir, "resumed.npz"),
+                 params=np.asarray(net.params()))
+    print(f"rank {rank}: resumed score={net.score():.6f}", flush=True)
+
+else:
+    raise SystemExit(f"unknown mode {mode}")

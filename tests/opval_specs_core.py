@@ -1,0 +1,705 @@
+"""OpValidation specs, part 1: elementwise / reductions / shape / linalg /
+losses / special functions (reference OpValidation case corpus:
+`platform-tests/.../nd4j/autodiff/opvalidation/*.java` — goldens here are
+independent numpy/scipy closed forms, NOT re-derivations of the op impls)."""
+import numpy as np
+import scipy.special as ss
+
+from deeplearning4j_tpu.autodiff.validation import OpTestCase
+
+rs = np.random.RandomState(1234)
+
+
+def F(*s, lo=-2.0, hi=2.0):
+    """float32 tensor arg in [lo, hi)."""
+    return rs.uniform(lo, hi, s).astype(np.float32)
+
+
+def FP(*s, lo=0.1, hi=2.0):
+    return rs.uniform(lo, hi, s).astype(np.float32)
+
+
+def F01(*s):
+    return rs.uniform(0.05, 0.95, s).astype(np.float32)
+
+
+def I32(*s, lo=0, hi=10):
+    return rs.randint(lo, hi, s).astype(np.int32)
+
+
+def PSD(n):
+    a = rs.uniform(-1, 1, (n, n)).astype(np.float32)
+    return a @ a.T + n * np.eye(n, dtype=np.float32)
+
+
+def C(op, *args, g=None, kw=None, grad=(), tol=1e-5, gtol=5e-3,
+      check=None, jit=True, custom=None, tag=""):
+    return OpTestCase(op=op, args=args, kwargs=kw or {}, golden=g,
+                      grad=grad, tol=tol, gtol=gtol, check=check, jit=jit,
+                      custom=custom, tag=tag)
+
+
+CASES = []
+_a, _b = F(3, 4), F(3, 4)
+_pos = FP(3, 4)
+
+# ---- elementwise arithmetic ----
+CASES += [
+    C("add", _a, _b, g=lambda a, b: a + b, grad=(0, 1)),
+    C("sub", _a, _b, g=lambda a, b: a - b, grad=(0, 1)),
+    C("mul", _a, _b, g=lambda a, b: a * b, grad=(0, 1)),
+    C("div", _a, _pos, g=lambda a, b: a / b, grad=(0, 1)),
+    C("rsub", _a, _b, g=lambda a, b: b - a, grad=(0, 1)),
+    C("rdiv", _pos, _a, g=lambda a, b: b / a, grad=(0, 1)),
+    C("pow", FP(3, 4), F(3, 4, lo=-1.5, hi=1.5),
+      g=lambda a, b: a ** b, grad=(0, 1)),
+    C("neg", _a, g=lambda a: -a, grad=(0,)),
+    C("abs", FP(3, 4), g=np.abs, grad=(0,)),
+    C("exp", _a, g=np.exp, grad=(0,)),
+    C("log", _pos, g=np.log, grad=(0,)),
+    C("log1p", _pos, g=np.log1p, grad=(0,)),
+    C("sqrt", _pos, g=np.sqrt, grad=(0,)),
+    C("square", _a, g=lambda a: a * a, grad=(0,)),
+    C("cube", _a, g=lambda a: a ** 3, grad=(0,)),
+    C("reciprocal", _pos, g=lambda a: 1.0 / a, grad=(0,)),
+    C("sign", _a, g=np.sign),
+    C("floor", _a, g=np.floor),
+    C("ceil", _a, g=np.ceil),
+    C("round", _a, g=np.round),
+    C("rint", _a, g=np.rint),
+    C("trunc", _a, g=np.trunc),
+    C("clip", F(3, 4), g=lambda a, lo=None, hi=None: np.clip(a, lo, hi),
+      kw={"lo": -0.5, "hi": 0.5}),
+    C("maximum", _a, _b, g=np.maximum, grad=(0, 1)),
+    C("minimum", _a, _b, g=np.minimum, grad=(0, 1)),
+    C("expm1", _a, g=np.expm1, grad=(0,)),
+    C("rsqrt", _pos, g=lambda a: 1.0 / np.sqrt(a), grad=(0,)),
+    C("cbrt", FP(3, 4), g=np.cbrt, grad=(0,)),
+    C("mod", F(3, 4), FP(3, 4), g=np.mod),
+    C("fmod", F(3, 4), FP(3, 4), g=np.fmod),
+    C("remainder", F(3, 4), FP(3, 4), g=np.remainder),
+    C("reverse_mod", FP(3, 4), F(3, 4), g=lambda a, b: b % a),
+    C("truncate_div", F(3, 4), FP(3, 4),
+      g=lambda a, b: np.trunc(a / b).astype(np.float32)),
+    C("floor_div", I32(3, 4, lo=1, hi=9), I32(3, 4, lo=1, hi=4),
+      g=np.floor_divide),
+    C("real_div", _a, _pos, g=lambda a, b: a / b, grad=(0, 1)),
+    C("divide_no_nan",
+      F(4), np.asarray([0.0, 2.0, 0.0, -1.5], np.float32),
+      g=lambda a, b: np.where(b == 0, 0.0, a / np.where(b == 0, 1.0, b))),
+    C("squared_difference", _a, _b, g=lambda a, b: (a - b) ** 2,
+      grad=(0, 1)),
+    C("axpy", np.float32(1.7), F(3), F(3),
+      g=lambda al, x, y: al * x + y, grad=(1, 2)),
+    C("hypot", _a, _b, g=np.hypot, grad=(0, 1)),
+    C("atan2", _a, _pos, g=np.arctan2, grad=(0, 1)),
+    C("xlogy", FP(3, 4), FP(3, 4), g=ss.xlogy, grad=(0, 1)),
+    C("sinc", FP(3, 4), g=np.sinc, grad=(0,)),
+]
+
+# ---- trig / hyperbolic ----
+_sm = F(2, 3, lo=-0.9, hi=0.9)
+CASES += [
+    C("sin", _a, g=np.sin, grad=(0,)),
+    C("cos", _a, g=np.cos, grad=(0,)),
+    C("tan", _sm, g=np.tan, grad=(0,)),
+    C("asin", _sm, g=np.arcsin, grad=(0,)),
+    C("acos", _sm, g=np.arccos, grad=(0,)),
+    C("atan", _a, g=np.arctan, grad=(0,)),
+    C("sinh", _a, g=np.sinh, grad=(0,)),
+    C("cosh", _a, g=np.cosh, grad=(0,)),
+    C("tanh", _a, g=np.tanh, grad=(0,)),
+    C("asinh", _a, g=np.arcsinh, grad=(0,)),
+    C("acosh", FP(2, 3, lo=1.2, hi=3.0), g=np.arccosh, grad=(0,)),
+    C("atanh", _sm, g=np.arctanh, grad=(0,)),
+    C("to_degrees", _a, g=np.degrees, grad=(0,)),
+    C("to_radians", _a, g=np.radians, grad=(0,)),
+]
+
+# ---- comparisons / logic ----
+_bo = rs.rand(3, 4) > 0.5
+_bo2 = rs.rand(3, 4) > 0.5
+CASES += [
+    C("less", _a, _b, g=np.less),
+    C("less_equal", _a, _b, g=np.less_equal),
+    C("greater", _a, _b, g=np.greater),
+    C("greater_equal", _a, _b, g=np.greater_equal),
+    C("equal", I32(3, 4, hi=3), I32(3, 4, hi=3), g=np.equal),
+    C("not_equal", I32(3, 4, hi=3), I32(3, 4, hi=3), g=np.not_equal),
+    C("eq", I32(3, 4, hi=3), I32(3, 4, hi=3), g=np.equal),
+    C("neq", I32(3, 4, hi=3), I32(3, 4, hi=3), g=np.not_equal),
+    C("gt", _a, _b, g=np.greater),
+    C("gte", _a, _b, g=np.greater_equal),
+    C("lt", _a, _b, g=np.less),
+    C("lte", _a, _b, g=np.less_equal),
+    C("logical_and", _bo, _bo2, g=np.logical_and),
+    C("logical_or", _bo, _bo2, g=np.logical_or),
+    C("logical_not", _bo, g=np.logical_not),
+    C("where", _bo, _a, _b, g=np.where),
+    C("select", _bo, _a, _b, g=np.where),
+    C("isnan", np.asarray([1.0, np.nan, np.inf], np.float32), g=np.isnan),
+    C("isinf", np.asarray([1.0, np.nan, np.inf], np.float32), g=np.isinf),
+    C("is_finite", np.asarray([1.0, np.nan, np.inf], np.float32),
+      g=np.isfinite),
+    C("is_finite_all", np.asarray([1.0, 2.0], np.float32),
+      g=lambda a: np.asarray(True)),
+    C("isclose", _a, _a + 1e-7, g=lambda a, b: np.isclose(a, b)),
+    C("equals_with_eps", _a, _a, g=lambda a, b, eps=1e-5:
+      np.asarray(True)),
+    C("compare_and_set", np.asarray([1.0, 2.0, 1.0], np.float32),
+      g=lambda a, compare, set_val, eps=1e-7:
+      np.where(np.abs(a - compare) < eps, set_val, a),
+      kw={"compare": 1.0, "set_val": 9.0}),
+    C("assign", _a, np.float32(3.5),
+      g=lambda a, b: np.full_like(a, 3.5)),
+    C("assign_add", _a, _b, g=lambda a, b: a + b, grad=(0, 1)),
+    C("assign_sub", _a, _b, g=lambda a, b: a - b, grad=(0, 1)),
+    C("is_non_decreasing", np.asarray([1.0, 1.0, 2.0], np.float32),
+      g=lambda a: np.asarray(True)),
+    C("is_strictly_increasing", np.asarray([1.0, 1.0, 2.0], np.float32),
+      g=lambda a: np.asarray(False)),
+    C("is_numeric_tensor", _a, jit=False,
+      check=lambda out: np.testing.assert_array_equal(out[0], True)),
+]
+
+# ---- reductions ----
+_r = F(3, 4, 5)
+CASES += [
+    C("sum", _r, g=lambda a, axis=None, keepdims=False:
+      np.sum(a, axis=axis, keepdims=keepdims), kw={"axis": 1}, grad=(0,)),
+    C("sum", _r, g=lambda a, **k: np.sum(a), tag="all"),
+    C("mean", _r, g=lambda a, axis=None, keepdims=False:
+      np.mean(a, axis=axis, keepdims=keepdims),
+      kw={"axis": (0, 2), "keepdims": True}, grad=(0,)),
+    C("max", _r, g=lambda a, axis=None, keepdims=False:
+      np.max(a, axis=axis, keepdims=keepdims), kw={"axis": 2}),
+    C("min", _r, g=lambda a, axis=None, keepdims=False:
+      np.min(a, axis=axis, keepdims=keepdims), kw={"axis": 0}),
+    C("prod", F(3, 4), g=lambda a, axis=None, keepdims=False:
+      np.prod(a, axis=axis, keepdims=keepdims), kw={"axis": 1}, grad=(0,)),
+    C("std", _r, g=lambda a, axis=None, keepdims=False, ddof=0:
+      np.std(a, axis=axis, keepdims=keepdims, ddof=ddof),
+      kw={"axis": 1, "ddof": 1}, tol=1e-4),
+    C("var", _r, g=lambda a, axis=None, keepdims=False, ddof=0:
+      np.var(a, axis=axis, keepdims=keepdims, ddof=ddof),
+      kw={"axis": 1}, grad=(0,), tol=1e-4),
+    C("norm2", _r, g=lambda a, axis=None, keepdims=False:
+      np.sqrt(np.sum(a * a, axis=axis, keepdims=keepdims)),
+      kw={"axis": 2}, grad=(0,)),
+    C("norm1", _r, g=lambda a, axis=None, keepdims=False:
+      np.sum(np.abs(a), axis=axis, keepdims=keepdims), kw={"axis": 1}),
+    C("norm_max", _r, g=lambda a, axis=None, keepdims=False:
+      np.max(np.abs(a), axis=axis, keepdims=keepdims), kw={"axis": 1}),
+    C("norm_p", FP(3, 4), g=lambda a, p=2, axis=None, keepdims=False:
+      np.sum(np.abs(a) ** p, axis=axis, keepdims=keepdims) ** (1.0 / p),
+      kw={"p": 3, "axis": 1}, tol=1e-4),
+    C("norm_fro", F(4, 4), g=np.linalg.norm, grad=(0,)),
+    C("amax", _r, g=lambda a, axis=None, **k: np.max(np.abs(a), axis=axis),
+      kw={"axis": 1}),
+    C("amin", _r, g=lambda a, axis=None, **k: np.min(np.abs(a), axis=axis),
+      kw={"axis": 1}),
+    C("asum", _r, g=lambda a, axis=None, **k: np.sum(np.abs(a), axis=axis),
+      kw={"axis": 1}),
+    C("amean", _r, g=lambda a, axis=None, **k:
+      np.mean(np.abs(a), axis=axis), kw={"axis": 1}),
+    C("square_sum", _r, g=lambda a, axis=None, **k:
+      np.sum(a * a, axis=axis), kw={"axis": 1}, grad=(0,)),
+    C("argmax", _r, g=lambda a, axis=-1: np.argmax(a, axis=axis)),
+    C("argmin", _r, g=lambda a, axis=-1: np.argmin(a, axis=axis)),
+    C("logsumexp", _r, g=lambda a, axis=None, keepdims=False:
+      ss.logsumexp(a, axis=axis, keepdims=keepdims), kw={"axis": 1},
+      grad=(0,)),
+    C("reduce_any", _bo, g=lambda a, axis=None, **k:
+      np.any(a, axis=axis), kw={"axis": 1}),
+    C("reduce_all", _bo, g=lambda a, axis=None, **k:
+      np.all(a, axis=axis), kw={"axis": 1}),
+    C("entropy", F01(3, 4), g=lambda a, axis=None:
+      -np.sum(a * np.log(a), axis=axis), kw={"axis": 1}, grad=(0,)),
+    C("log_entropy", F01(3, 4), g=lambda a, axis=None:
+      np.log(-np.sum(a * np.log(a), axis=axis)), kw={"axis": 1}),
+    C("shannon_entropy", F01(3, 4), g=lambda a, axis=None:
+      -np.sum(a * np.log2(a), axis=axis), kw={"axis": 1}),
+    C("zero_fraction", np.asarray([0.0, 1.0, 0.0, 2.0], np.float32),
+      g=lambda a: np.float32(0.5)),
+    C("count_nonzero", np.asarray([[0, 1], [2, 0]], np.float32),
+      g=lambda a, axis=None: np.count_nonzero(a, axis=axis)),
+    C("count_zero", np.asarray([[0, 1], [2, 0]], np.float32),
+      g=lambda a, axis=None: np.sum(a == 0, axis=axis).astype(np.int32)),
+    C("percentile", F(40), g=lambda a, q, axis=None, interpolation="linear":
+      np.percentile(a, q, axis=axis, method=interpolation),
+      kw={"q": 30.0}, tol=1e-4),
+    C("median", F(3, 9), g=lambda a, axis=None:
+      np.median(a, axis=axis), kw={"axis": 1}),
+    C("nth_element", F(3, 8), g=lambda a, n, reverse=False:
+      np.flip(np.sort(a, -1), -1)[..., n] if reverse
+      else np.sort(a, -1)[..., n], kw={"n": 2, "reverse": True}),
+    C("moments", _r, g=lambda a, axis=None, keepdims=False:
+      (np.mean(a, axis=axis), np.var(a, axis=axis)), kw={"axis": 1},
+      tol=1e-4),
+    C("normalize_moments", np.float32(8.0), F(4), FP(4, lo=5.0, hi=9.0),
+      g=lambda count, m_ss, v_ss, shift=0.0:
+      (m_ss / count + shift, v_ss / count - (m_ss / count) ** 2),
+      kw={"shift": 0.5}),
+    C("sufficient_statistics", _r, g=lambda x, axes, shift=None:
+      (np.float32(x.shape[1]), np.sum(x - shift, axis=1),
+       np.sum((x - shift) ** 2, axis=1), np.float32(shift)),
+      kw={"axes": 1, "shift": 0.5}),
+]
+
+# ---- cumulative / windowed ----
+CASES += [
+    C("cumsum", F(3, 4), g=lambda a, axis=0: np.cumsum(a, axis=axis),
+      kw={"axis": 1}, grad=(0,)),
+    C("cumprod", FP(3, 4), g=lambda a, axis=0: np.cumprod(a, axis=axis),
+      kw={"axis": 1}, grad=(0,)),
+    C("cummax", F(3, 4), g=lambda a, axis=0:
+      np.maximum.accumulate(a, axis=axis), kw={"axis": 1}),
+    C("cummin", F(3, 4), g=lambda a, axis=0:
+      np.minimum.accumulate(a, axis=axis), kw={"axis": 1}),
+    C("cumsum_ext", F(5), g=lambda a, axis=0, exclusive=False,
+      reverse=False: np.flip(np.cumsum(np.flip(a)) - np.flip(a))
+      if (exclusive and reverse) else None,
+      kw={"exclusive": True, "reverse": True}),
+    C("cumsum_ext", F(5), g=lambda a, axis=0, exclusive=False,
+      reverse=False: np.concatenate([[0.0], np.cumsum(a)[:-1]]),
+      kw={"exclusive": True}, tag="excl"),
+    C("bincount", I32(20, hi=6), g=lambda a, length:
+      np.bincount(a, minlength=length)[:length], kw={"length": 6}),
+    C("histogram", F(30), g=lambda a, bins: np.histogram(a, bins=bins)[0],
+      kw={"bins": 5}),
+    C("histogram_fixed_width", F(30),
+      g=lambda a, lo, hi, nbins=100:
+      np.histogram(a, bins=nbins, range=(lo, hi))[0],
+      kw={"lo": -2.0, "hi": 2.0, "nbins": 8}),
+]
+
+# ---- clipping ----
+_big = F(3, 4, lo=-5, hi=5)
+CASES += [
+    C("clip_by_value", _big, g=lambda a, lo, hi: np.clip(a, lo, hi),
+      kw={"lo": -1.0, "hi": 1.0}),
+    C("clip_by_norm", _big, g=lambda a, clip_norm, axis=None:
+      a * min(1.0, clip_norm / np.linalg.norm(a)), kw={"clip_norm": 2.0},
+      tol=1e-4),
+    C("clip_by_avg_norm", _big, g=lambda a, clip_norm:
+      a * min(1.0, clip_norm / (np.linalg.norm(a) / a.size)),
+      kw={"clip_norm": 0.1}, tol=1e-4),
+    C("clip_by_global_norm", F(3), F(4),
+      g=lambda cap, x, y: tuple(
+          v * min(1.0, cap / np.sqrt(np.sum(x * x) + np.sum(y * y)))
+          for v in (x, y)),
+      kw={}, tag="pair", tol=1e-4),
+]
+# first positional arg of clip_by_global_norm is the cap (static float)
+CASES[-1] = C("clip_by_global_norm", np.float32(1.5), F(3), F(4),
+              g=lambda cap, x, y: tuple(
+                  v * min(1.0, 1.5 / np.sqrt(np.sum(x * x)
+                                             + np.sum(y * y)))
+                  for v in (x, y)), tol=1e-4)
+
+# ---- shape / layout ----
+_m = F(3, 4)
+_t3 = F(2, 3, 4)
+CASES += [
+    C("matmul", F(3, 4), F(4, 5), g=np.matmul, grad=(0, 1)),
+    C("mmul", F(3, 4), F(4, 5), g=np.matmul, grad=(0, 1)),
+    C("batched_matmul", F(2, 3, 4), F(2, 4, 5), g=np.matmul, grad=(0, 1)),
+    C("tensordot", F(2, 3, 4), F(3, 4, 5),
+      g=lambda a, b, axes=2: np.tensordot(a, b, axes), grad=(0, 1)),
+    C("transpose", _t3, g=lambda a, perm=None: np.transpose(a, perm),
+      kw={"perm": (2, 0, 1)}),
+    C("permute", _t3, (1, 2, 0), g=lambda a, p: np.transpose(a, p)),
+    C("reshape", _t3, (4, 6), g=lambda a, s: np.reshape(a, s)),
+    C("expand_dims", _m, g=lambda a, axis=0: np.expand_dims(a, axis),
+      kw={"axis": 1}),
+    C("squeeze", F(3, 1, 4), g=lambda a, axis=None:
+      np.squeeze(a, axis), kw={"axis": 1}),
+    C("concat", _m, F(2, 4), g=lambda a, b, axis=0:
+      np.concatenate([a, b], axis), kw={"axis": 0}),
+    C("stack", _m, F(3, 4), g=lambda a, b, axis=0:
+      np.stack([a, b], axis), kw={"axis": 1}),
+    C("unstack_at", _t3, g=lambda a, index=0, axis=0:
+      np.take(a, 1, axis=1), kw={"index": 1, "axis": 1}),
+    C("unstack", _t3, g=lambda a, axis=0:
+      tuple(a[i] for i in range(a.shape[0]))),
+    C("tile", _m, (2, 3), g=lambda a, r: np.tile(a, r)),
+    C("slice", _t3, (0, 1, 2), (2, 2, 2),
+      g=lambda a, b, s: a[0:2, 1:3, 2:4]),
+    C("strided_slice", _t3, (0, 1, 0), (2, 3, 4), (1, 1, 2),
+      g=lambda a, b, e, s: a[0:2, 1:3, 0:4:2]),
+    C("gather", F(5, 3), I32(4, hi=5), g=lambda a, i, axis=0:
+      np.take(a, i, axis=axis), grad=(0,)),
+    C("gather_nd", F(4, 5), np.asarray([[0, 1], [3, 2]], np.int32),
+      g=lambda a, i: a[i[:, 0], i[:, 1]]),
+    C("take_along_axis", F(3, 5), I32(3, 2, hi=5),
+      g=lambda a, i, axis=-1: np.take_along_axis(a, i, axis=axis)),
+    C("one_hot", I32(4, hi=5), g=lambda i, depth, dtype="float32":
+      np.eye(depth, dtype=np.float32)[i], kw={"depth": 5}),
+    C("cast", _m, g=lambda a, dtype: a.astype(dtype),
+      kw={"dtype": "int32"}),
+    C("shape_of", _t3, g=lambda a: np.asarray(a.shape, np.int32)),
+    C("size_of", _t3, g=lambda a: np.asarray(a.size, np.int32)),
+    C("rank_of", _t3, g=lambda a: np.asarray(a.ndim, np.int32)),
+    C("size_at", _t3, jit=False, kw={"dim": 1},
+      check=lambda out: np.testing.assert_array_equal(out[0], 3)),
+    C("zeros_like", _m, g=np.zeros_like),
+    C("ones_like", _m, g=np.ones_like),
+    C("fill_like", _m, g=lambda a, value: np.full_like(a, value),
+      kw={"value": 2.5}),
+    C("eye_like", F(3, 5), g=lambda a: np.eye(3, 5, dtype=np.float32)),
+    C("eye", g=lambda n, m=None, dtype="float32": np.eye(n, dtype=np.float32),
+      kw={"n": 4}, jit=False),
+    C("pad", _m, ((1, 0), (0, 2)),
+      g=lambda a, p, value=0.0: np.pad(a, p, constant_values=value),
+      kw={"value": 1.5}),
+    C("pad_mode", _m, ((1, 1), (2, 0)),
+      g=lambda a, p, mode="constant", value=0.0: np.pad(a, p, mode="reflect"),
+      kw={"mode": "reflect"}),
+    C("mirror_pad", _m, ((1, 1), (1, 1)),
+      g=lambda a, p, mode="REFLECT": np.pad(a, p, mode="symmetric"),
+      kw={"mode": "SYMMETRIC"}),
+    C("identity", _m, g=lambda a: a, grad=(0,)),
+    C("broadcast_to", F(1, 4), (3, 4),
+      g=lambda a, s: np.broadcast_to(a, s)),
+    C("repeat", _m, g=lambda a, repeats, axis=None:
+      np.repeat(a, repeats, axis), kw={"repeats": 2, "axis": 1}),
+    C("flip", _t3, g=lambda a, axis=None: np.flip(a, axis),
+      kw={"axis": 1}),
+    C("reverse", _t3, (0, 2), g=lambda a, ax: np.flip(a, ax)),
+    C("roll", _m, g=lambda a, shift, axis=None:
+      np.roll(a, shift, axis), kw={"shift": 2, "axis": 1}),
+    C("swap_axes", _t3, 0, 2, g=lambda a, i, j: np.swapaxes(a, i, j)),
+    C("swap_last2", _t3, g=lambda a: np.swapaxes(a, -1, -2)),
+    C("moveaxis", _t3, 0, 2, g=lambda a, s, d: np.moveaxis(a, s, d)),
+    C("atleast_2d", F(5), g=np.atleast_2d),
+    C("ravel", _t3, g=np.ravel),
+    C("linspace", g=lambda start, stop, num=50:
+      np.linspace(start, stop, num, dtype=np.float32),
+      kw={"start": 0.0, "stop": 1.0, "num": 7}, jit=False, tol=1e-6),
+    C("arange", g=lambda start, stop=None, step=1, dtype="float32":
+      np.arange(start, stop, step, dtype=np.float32),
+      kw={"start": 1.0, "stop": 7.0, "step": 2.0}, jit=False),
+    C("full", g=lambda shape, value, dtype="float32":
+      np.full(shape, value, np.float32),
+      kw={"shape": (2, 3), "value": 1.5}, jit=False),
+    C("meshgrid", F(3), F(4), g=lambda a, b, indexing="xy":
+      tuple(np.meshgrid(a, b, indexing=indexing)), kw={"indexing": "ij"}),
+    C("split_axis", F(7, 3), (3, 2, 2),
+      g=lambda x, s, axis=0: (x[:3], x[3:5], x[5:])),
+    C("split_equal", F(6, 3), 3,
+      g=lambda x, n, axis=0: tuple(np.split(x, n, 0))),
+    C("sequence_mask", np.asarray([1, 3, 0], np.int32),
+      g=lambda l, maxlen, dtype="float32":
+      (np.arange(maxlen)[None, :] < l[:, None]).astype(np.float32),
+      kw={"maxlen": 4}),
+    C("reverse_sequence", F(3, 5, 2), np.asarray([2, 5, 3], np.int32),
+      g=lambda a, lengths, seq_axis=1, batch_axis=0: np.stack([
+          np.concatenate([a[i, :n][::-1], a[i, n:]], 0)
+          for i, n in enumerate(lengths)])),
+    C("invert_permutation", np.asarray([2, 0, 1, 3], np.int32),
+      g=lambda p: np.argsort(p)),
+    C("unravel_index", np.asarray([1, 7, 11], np.int32), (3, 4),
+      g=lambda i, s: np.stack(np.unravel_index(i, s), 0)),
+    C("stop_gradient", _m, g=lambda a: a),
+    C("tri", g=lambda n, m=None, k=0: np.tri(n, m, k, dtype=np.float32),
+      kw={"n": 4, "m": 5, "k": 1}, jit=False),
+    C("tuple_get", jit=False, custom=lambda fn: np.testing.assert_allclose(
+        fn((np.float32(1.0), np.float32(2.0)), 1), 2.0)),
+]
+
+# ---- sort / search ----
+CASES += [
+    C("sort", F(3, 6), g=lambda a, axis=-1, descending=False:
+      -np.sort(-a, axis=axis) if descending else np.sort(a, axis=axis),
+      kw={"descending": True}),
+    C("argsort", F(3, 6), g=lambda a, axis=-1: np.argsort(a, axis=axis)),
+    C("top_k", F(3, 8), g=lambda a, k=1:
+      (np.sort(a, -1)[..., ::-1][..., :k],
+       np.argsort(-a, -1, kind="stable")[..., :k]), kw={"k": 3}),
+    C("searchsorted", np.sort(F(8)), F(5),
+      g=lambda s, v: np.searchsorted(s, v)),
+    C("bucketize", F(6), g=lambda x, boundaries:
+      np.searchsorted(boundaries, x, side="right").astype(np.int32),
+      kw={"boundaries": [-1.0, 0.0, 1.0]}),
+    C("unique", np.asarray([3, 1, 3, 2, 1], np.int32),
+      g=lambda a, size=None: np.asarray([1, 2, 3, 1, 1], np.int32),
+      kw={"size": 5}),
+    C("unique_with_counts", np.asarray([3, 1, 3, 2, 1], np.int32),
+      g=lambda a, size=None: (np.asarray([1, 2, 3], np.int32),
+                              np.asarray([2, 1, 2], np.int32)),
+      kw={"size": 3}),
+    C("setdiff1d", np.asarray([1, 2, 3, 4, 5], np.int32),
+      np.asarray([2, 4], np.int32),
+      g=lambda a, b, size=None: np.asarray([1, 3, 5], np.int32),
+      kw={"size": 3}),
+    C("nonzero", np.asarray([[0, 1], [2, 0]], np.float32),
+      g=lambda a, size=None: np.stack(np.nonzero(a), -1),
+      kw={"size": 2}),
+    C("isin", I32(6, hi=5), np.asarray([1, 3], np.int32), g=np.isin),
+    C("in_top_k", F(4, 6), I32(4, hi=6),
+      g=lambda p, t, k=1: np.asarray(
+          [np.sum(p[i] > p[i, t[i]]) < k for i in range(p.shape[0])]),
+      kw={"k": 2}),
+    C("is_max", F(3, 5), g=lambda a, axis=-1:
+      (a == np.max(a, axis=axis, keepdims=True)).astype(a.dtype)),
+    C("confusion_matrix", np.asarray([0, 1, 2, 1], np.int32),
+      np.asarray([0, 2, 2, 1], np.int32),
+      g=lambda l, p, num_classes, weights=None: np.asarray(
+          [[1, 0, 0], [0, 1, 1], [0, 0, 1]], np.float32),
+      kw={"num_classes": 3}),
+]
+
+# ---- linalg ----
+_A4 = PSD(4)
+_b4 = F(4, 2)
+_sq = F(4, 4)
+CASES += [
+    C("cholesky", _A4, g=np.linalg.cholesky, tol=1e-4),
+    C("solve", _A4, _b4, g=np.linalg.solve, tol=1e-4, grad=(0, 1),
+      gtol=2e-2),
+    C("triangular_solve", np.linalg.cholesky(_A4).astype(np.float32), _b4,
+      g=lambda a, b, lower=True: np.linalg.solve(a, b), tol=1e-4),
+    C("cholesky_solve", np.linalg.cholesky(_A4).astype(np.float32), _b4,
+      g=lambda c, b: np.linalg.solve(_A4.astype(np.float64), b), tol=1e-3),
+    C("lu_solve", _A4, _b4, g=np.linalg.solve, tol=1e-3),
+    C("matrix_inverse", _A4, g=np.linalg.inv, tol=1e-4),
+    C("matrix_determinant", _sq, g=np.linalg.det, tol=1e-4, grad=(0,),
+      gtol=2e-2),
+    C("log_matrix_determinant", _A4,
+      g=lambda a: np.linalg.slogdet(a)[1], tol=1e-4),
+    C("slogdet", _sq, g=np.linalg.slogdet, tol=1e-4),
+    C("logdet", _A4, g=lambda a: np.log(np.linalg.det(a)), tol=1e-3),
+    C("matrix_rank", _A4, g=lambda a: np.linalg.matrix_rank(a)),
+    C("pinv", F(4, 3), g=np.linalg.pinv, tol=1e-4),
+    C("lstsq", F(5, 3), F(5, 2),
+      g=lambda a, b: np.linalg.lstsq(a, b, rcond=None)[0], tol=1e-3),
+    C("qr", F(4, 3), check=lambda out: (
+        np.testing.assert_allclose(out[0] @ out[1],
+                                   np.asarray(CASES_QR_IN), atol=1e-4),
+        np.testing.assert_allclose(out[0].T @ out[0], np.eye(3),
+                                   atol=1e-4))),
+    C("svd", F(4, 3), check=lambda out: (
+        np.testing.assert_allclose(
+            out[0][:, :out[1].shape[0]] @ np.diag(out[1])
+            @ out[2][:out[1].shape[0]],
+            np.asarray(CASES_SVD_IN), atol=1e-4))),
+    C("eig_sym", _A4, check=lambda out: np.testing.assert_allclose(
+        np.asarray(_A4, np.float64) @ out[1],
+        out[1] * out[0][None, :], atol=1e-3)),
+    C("lu", _sq, check=lambda out: np.testing.assert_allclose(
+        out[0] @ out[1] @ out[2], np.asarray(_sq, np.float64),
+        atol=1e-4)),
+    C("expm", F(3, 3, lo=-0.5, hi=0.5),
+      g=lambda a: __import__("scipy.linalg", fromlist=["expm"]).expm(
+          a.astype(np.float64)), tol=1e-4),
+    C("matrix_band_part", _sq, 1, 1, g=lambda a, lo, hi:
+      np.triu(np.tril(a, 1), -1)),
+    C("trace", _sq, g=np.trace, grad=(0,)),
+    C("diag", F(4), g=np.diag),
+    C("diag_part", _sq, g=np.diagonal),
+    C("tril", _sq, g=lambda a, k=0: np.tril(a, k), kw={"k": 1}),
+    C("triu", _sq, g=lambda a, k=0: np.triu(a, k), kw={"k": -1}),
+    C("matrix_diag", F(2, 3), g=lambda d:
+      np.stack([np.diag(d[i]) for i in range(d.shape[0])])),
+    C("matrix_diag_part", F(2, 4, 4), g=lambda a:
+      np.diagonal(a, axis1=-2, axis2=-1)),
+    C("matrix_set_diag", F(3, 3), F(3), g=lambda a, d:
+      np.where(np.eye(3, dtype=bool), d[None, :], a)),
+    C("outer", F(3), F(4), g=np.outer, grad=(0, 1)),
+    C("kron", F(2, 2), F(3, 2), g=np.kron),
+    C("cross", F(3, 3), F(3, 3), g=np.cross, grad=(0, 1)),
+    C("dot", F(4), F(4), g=np.dot, grad=(0, 1)),
+    C("vdot", F(4), F(4), g=np.vdot, grad=(0, 1)),
+    C("einsum", "ij,jk->ik", F(3, 4), F(4, 2),
+      g=lambda eq, a, b: np.einsum(eq, a, b), grad=(1, 2)),
+    C("gemm", F(3, 4), F(5, 4), F(3, 5),
+      g=lambda a, b, c=None, alpha=1.0, beta=1.0, trans_a=0, trans_b=0:
+      alpha * (a @ b.T) + beta * c, kw={"alpha": 0.5, "beta": 2.0,
+                                        "trans_b": 1}, grad=(0, 1, 2)),
+    C("xw_plus_b", F(3, 4), F(4, 2), F(2),
+      g=lambda x, w, b: x @ w + b, grad=(0, 1, 2)),
+    C("linear", F(3, 4), F(4, 2), F(2),
+      g=lambda x, w, b=None: x @ w + b, grad=(0, 1, 2)),
+    C("relu_layer", F(3, 4), F(4, 2), F(2),
+      g=lambda x, w, b: np.maximum(x @ w + b, 0.0), grad=(0, 1, 2)),
+    C("bias_add", F(3, 4), F(4), g=lambda x, b: x + b, grad=(0, 1)),
+]
+# fixed inputs for the qr/svd property checks above (case args are bound
+# AFTER this module builds, so regenerate the same arrays by index)
+CASES_QR_IN = [c for c in CASES if c.op == "qr"][0].args[0]
+CASES_SVD_IN = [c for c in CASES if c.op == "svd"][0].args[0]
+
+# ---- distances / reduce3 ----
+_d1, _d2 = F(3, 5), F(3, 5)
+CASES += [
+    C("euclidean_distance", _d1, _d2, g=lambda a, b, axis=None:
+      np.sqrt(np.sum((a - b) ** 2, axis=axis)), kw={"axis": 1},
+      grad=(0, 1)),
+    C("manhattan_distance", _d1, _d2, g=lambda a, b, axis=None:
+      np.sum(np.abs(a - b), axis=axis), kw={"axis": 1}),
+    C("cosine_similarity", _d1, _d2, g=lambda a, b, axis=-1, eps=0:
+      np.sum(a * b, -1) / (np.linalg.norm(a, axis=-1)
+                           * np.linalg.norm(b, axis=-1)), tol=1e-4,
+      grad=(0, 1)),
+    C("cosine_distance", _d1, _d2, g=lambda l, p, axis=-1, eps=0:
+      np.mean(1.0 - np.sum(
+          (l / np.linalg.norm(l, axis=-1, keepdims=True))
+          * (p / np.linalg.norm(p, axis=-1, keepdims=True)), -1)),
+      tol=1e-4, grad=(0, 1)),
+    C("cosine_distance_loss", _d1, _d2, g=lambda p, l, axis=-1:
+      np.mean(1.0 - np.sum(
+          (l / np.linalg.norm(l, axis=-1, keepdims=True))
+          * (p / np.linalg.norm(p, axis=-1, keepdims=True)), -1)),
+      tol=1e-4),
+    C("jaccard_distance", F01(3, 5), F01(3, 5), g=lambda a, b, axis=None:
+      1.0 - np.sum(np.minimum(a, b), 1) / np.sum(np.maximum(a, b), 1),
+      kw={"axis": 1}, tol=1e-4),
+    C("hamming_distance", I32(3, 5, hi=3), I32(3, 5, hi=3),
+      g=lambda a, b, axis=None: np.sum((a != b).astype(np.float32),
+                                       axis=axis), kw={"axis": 1}),
+    C("bits_hamming_distance", I32(6, hi=100), I32(6, hi=100),
+      g=lambda a, b: np.sum([bin(int(x) ^ int(y)).count("1")
+                             for x, y in zip(a, b)])),
+    C("knn_mindistance", F(4), F(4, lo=3.0, hi=5.0), F(4, lo=2.0, hi=4.0),
+      g=lambda lo, hi, p: np.sqrt(np.sum(np.maximum(
+          np.maximum(lo - p, 0), np.maximum(p - hi, 0)) ** 2, -1))),
+    C("cell_contains", F(3), np.float32(10.0), F(3),
+      g=lambda c, w, p: np.all((p >= c - 5.0) & (p <= c + 5.0), -1)),
+]
+
+# ---- losses ----
+_labels = np.eye(5, dtype=np.float32)[rs.randint(0, 5, 6)]
+_logits = F(6, 5)
+_probs = F01(6, 5)
+
+
+def _np_softmax(x, axis=-1):
+    e = np.exp(x - np.max(x, axis=axis, keepdims=True))
+    return e / np.sum(e, axis=axis, keepdims=True)
+
+
+CASES += [
+    C("softmax_cross_entropy", _labels, _logits,
+      g=lambda l, z, axis=-1: np.mean(-np.sum(
+          l * np.log(_np_softmax(z)), -1)), grad=(1,), tol=1e-4),
+    C("sparse_softmax_cross_entropy", I32(6, hi=5), _logits,
+      g=lambda l, z: np.mean(-np.log(_np_softmax(z))[np.arange(6), l]),
+      grad=(1,), tol=1e-4),
+    C("sigmoid_cross_entropy", _labels, _logits,
+      g=lambda l, z: np.mean(np.maximum(z, 0) - z * l
+                             + np.log1p(np.exp(-np.abs(z)))),
+      grad=(1,), tol=1e-4),
+    C("weighted_cross_entropy_with_logits", _labels, _logits,
+      np.float32(2.0),
+      g=lambda l, z, w: np.mean((1 - l) * z + (1 + (w - 1) * l) * (
+          np.log1p(np.exp(-np.abs(z))) + np.maximum(-z, 0))),
+      tol=1e-4),
+    C("mean_squared_error", _labels, _probs,
+      g=lambda l, p: np.mean((l - p) ** 2), grad=(1,)),
+    C("absolute_difference", _labels, _probs,
+      g=lambda l, p: np.mean(np.abs(l - p))),
+    C("l2_loss", _m, g=lambda a: 0.5 * np.sum(a * a), grad=(0,)),
+    C("huber_loss", _labels, _probs * 3,
+      g=lambda l, p, delta=1.0: np.mean(np.where(
+          np.abs(l - p) <= delta, 0.5 * (l - p) ** 2,
+          delta * (np.abs(l - p) - 0.5 * delta))), kw={"delta": 0.7},
+      tol=1e-4),
+    C("log_loss", _labels, _probs,
+      g=lambda l, p, eps=1e-7: -np.mean(
+          l * np.log(np.clip(p, eps, 1 - eps))
+          + (1 - l) * np.log1p(-np.clip(p, eps, 1 - eps))), tol=1e-4),
+    C("hinge_loss", _labels, _logits,
+      g=lambda l, z: np.mean(np.maximum(0.0, 1.0 - (2 * l - 1) * z))),
+    C("poisson_loss", FP(4, 3), FP(4, 3),
+      g=lambda l, p, log_input=False, eps=1e-8:
+      np.mean(p - l * np.log(p + eps)), tol=1e-4),
+    C("log_poisson_loss", FP(4, 3), F(4, 3),
+      g=lambda l, li, compute_full_loss=False:
+      np.mean(np.exp(li) - l * li), tol=1e-4),
+    C("kl_divergence", F01(4, 5), F01(4, 5),
+      g=lambda l, p, eps=1e-12: np.mean(np.sum(
+          l * (np.log(l) - np.log(p)), -1)), tol=1e-4, grad=(1,)),
+    C("mean_pairwise_squared_error", F(3, 4), F(3, 4),
+      g=lambda l, p: np.mean([
+          np.mean([((p - l)[i, a] - (p - l)[i, b]) ** 2
+                   for a in range(4) for b in range(4) if a != b])
+          for i in range(3)]), tol=1e-3),
+]
+
+# ---- special functions ----
+CASES += [
+    C("erf", _a, g=ss.erf, grad=(0,)),
+    C("erfc", _a, g=ss.erfc, grad=(0,)),
+    C("erfinv", F(2, 3, lo=-0.9, hi=0.9), g=ss.erfinv, grad=(0,),
+      tol=1e-4),
+    C("digamma", FP(3, 4, lo=0.5, hi=4.0), g=ss.digamma, grad=(0,),
+      tol=1e-4),
+    C("lgamma", FP(3, 4, lo=0.5, hi=4.0), g=ss.gammaln, grad=(0,),
+      tol=1e-4),
+    C("betainc", FP(3, lo=0.5, hi=3.0), FP(3, lo=0.5, hi=3.0), F01(3),
+      g=ss.betainc, tol=1e-4),
+    C("zeta", FP(3, lo=1.5, hi=4.0), FP(3, lo=0.5, hi=2.0),
+      g=lambda x, q: ss.zeta(x, q), tol=1e-3),
+    C("igamma", FP(3, lo=0.5, hi=3.0), FP(3, lo=0.5, hi=3.0),
+      g=ss.gammainc, tol=1e-4),
+    C("igammac", FP(3, lo=0.5, hi=3.0), FP(3, lo=0.5, hi=3.0),
+      g=ss.gammaincc, tol=1e-4),
+    C("lbeta", FP(3, 4, lo=0.5, hi=3.0),
+      g=lambda x: np.sum(ss.gammaln(x), -1) - ss.gammaln(np.sum(x, -1)),
+      tol=1e-4),
+    C("polyval", [2.0, -1.0, 3.0], F(4),
+      g=lambda c, x: np.polyval(c, x), grad=(1,)),
+]
+# fix polygamma golden (the lambda-in-expression trick above is fragile)
+CASES = [c for c in CASES if c.op != "polygamma"]
+CASES.append(
+    C("polygamma", np.asarray([1, 2, 3], np.int32),
+      FP(3, lo=0.5, hi=4.0),
+      g=lambda n, x: np.asarray([ss.polygamma(int(ni), float(xi))
+                                 for ni, xi in zip(n, x)], np.float64),
+      tol=1e-3))
+
+# ---- signal / FFT ----
+_f_sig = F(3, 8)
+_c_sig = (rs.randn(3, 8) + 1j * rs.randn(3, 8)).astype(np.complex64)
+CASES += [
+    C("fft", _f_sig, g=lambda a, axis=-1: np.fft.fft(a, axis=axis),
+      tol=1e-4),
+    C("ifft", _c_sig, g=lambda a, axis=-1: np.fft.ifft(a, axis=axis),
+      tol=1e-4),
+    C("rfft", _f_sig, g=lambda a, axis=-1: np.fft.rfft(a, axis=axis),
+      tol=1e-4),
+    C("irfft", np.fft.rfft(_f_sig).astype(np.complex64),
+      g=lambda a, n=None, axis=-1: np.fft.irfft(a, n=n, axis=axis),
+      tol=1e-4),
+    C("fft2", F(2, 4, 4), g=lambda a: np.fft.fft2(a), tol=1e-4),
+    C("ifft2", (rs.randn(2, 4, 4) + 1j * rs.randn(2, 4, 4)).astype(
+        np.complex64), g=lambda a: np.fft.ifft2(a), tol=1e-4),
+]
+
+# ---- bitwise ----
+_i1, _i2 = I32(5, hi=200), I32(5, hi=200)
+CASES += [
+    C("bitwise_and", _i1, _i2, g=np.bitwise_and),
+    C("bitwise_or", _i1, _i2, g=np.bitwise_or),
+    C("bitwise_xor", _i1, _i2, g=np.bitwise_xor),
+    C("bitwise_not", _i1, g=np.bitwise_not),
+    C("toggle_bits", _i1, g=np.bitwise_not),
+    C("shift_left", _i1, np.asarray([1, 2, 3, 1, 2], np.int32),
+      g=np.left_shift),
+    C("shift_right", _i1, np.asarray([1, 2, 3, 1, 2], np.int32),
+      g=np.right_shift),
+    C("cyclic_shift_left", _i1, 3, g=lambda a, n: (
+        (a.astype(np.uint32) << np.uint32(3))
+        | (a.astype(np.uint32) >> np.uint32(29))).astype(np.int32)),
+    C("cyclic_shift_right", _i1, 3, g=lambda a, n: (
+        (a.astype(np.uint32) >> np.uint32(3))
+        | (a.astype(np.uint32) << np.uint32(29))).astype(np.int32)),
+    C("population_count", _i1, g=lambda a: np.asarray(
+        [bin(int(x) & 0xFFFFFFFF).count("1") for x in a], np.int32)),
+    C("bitcast", np.asarray([1.0, -2.0], np.float32),
+      g=lambda a, dtype: a.view(np.int32), kw={"dtype": "int32"}),
+    C("compare_and_bitpack", F(2, 16), np.float32(0.0),
+      g=lambda x, t: np.packbits((x > t).astype(np.uint8),
+                                 axis=-1)),
+]

@@ -1,0 +1,784 @@
+"""OpValidation specs, part 3: image / random / scatter / segment /
+TensorList / compression / word2vec / host-side ops.  TF goldens are used
+for the TF-defined image semantics (adjust_hue, central_crop,
+crop_and_resize, space_to_depth family, fake_quant) — the same golden
+source the reference's TFGraphTestAllSameDiff corpus uses."""
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.validation import OpTestCase
+from tests.opval_specs_core import C, F, FP, F01, I32, rs
+
+CASES = []
+
+_img = F01(2, 6, 6, 3)
+
+
+def _tf():
+    import tensorflow as tf
+    return tf
+
+
+# ---- colorspace ----
+def _hsv_golden(x):
+    import colorsys
+    flat = x.reshape(-1, 3)
+    return np.asarray([colorsys.rgb_to_hsv(*p) for p in flat],
+                      np.float64).reshape(x.shape)
+
+
+def _hsv_inv_golden(x):
+    import colorsys
+    flat = x.reshape(-1, 3)
+    return np.asarray([colorsys.hsv_to_rgb(*p) for p in flat],
+                      np.float64).reshape(x.shape)
+
+
+_YIQ_M = np.asarray([[0.299, 0.587, 0.114],
+                     [0.5959, -0.2746, -0.3213],
+                     [0.2115, -0.5227, 0.3112]])
+_YUV_M = np.asarray([[0.299, 0.587, 0.114],
+                     [-0.14714119, -0.28886916, 0.43601035],
+                     [0.61497538, -0.51496512, -0.10001026]])
+
+CASES += [
+    C("rgb_to_grs", _img, g=lambda x:
+      np.sum(x * [0.2989, 0.5870, 0.1140], -1, keepdims=True), tol=1e-4),
+    C("rgb_to_hsv", _img, g=_hsv_golden, tol=1e-4),
+    C("hsv_to_rgb", _hsv_golden(F01(2, 4, 4, 3)).astype(np.float32),
+      g=_hsv_inv_golden, tol=1e-4),
+    C("rgb_to_yiq", _img, g=lambda x: x @ _YIQ_M.T, tol=1e-4),
+    C("yiq_to_rgb", (_img @ _YIQ_M.T).astype(np.float32),
+      g=lambda x: x @ np.linalg.inv(_YIQ_M).T, tol=1e-4),
+    C("rgb_to_yuv", _img, g=lambda x: x @ _YUV_M.T, tol=1e-4),
+    C("yuv_to_rgb", (_img @ _YUV_M.T).astype(np.float32),
+      g=lambda x: x @ np.linalg.inv(_YUV_M).T, tol=1e-4),
+    C("adjust_hue", _img, g=lambda x, delta: _tf().image.adjust_hue(
+        x, delta).numpy(), kw={"delta": 0.15}, tol=1e-3),
+    C("adjust_saturation", _img, g=lambda x, factor:
+      _tf().image.adjust_saturation(x, factor).numpy(),
+      kw={"factor": 1.4}, tol=1e-3),
+    C("adjust_contrast", _img, g=lambda x, factor:
+      _tf().image.adjust_contrast(x, factor).numpy().astype(np.float64),
+      kw={"factor": 1.8}, tol=1e-4),
+    C("adjust_contrast_v2", _img, g=lambda x, factor:
+      _tf().image.adjust_contrast(x, factor).numpy().astype(np.float64),
+      kw={"factor": 0.6}, tol=1e-4),
+    C("per_image_standardization", _img, g=lambda x:
+      _tf().image.per_image_standardization(x).numpy(), tol=1e-4),
+    C("image_central_crop", F01(2, 8, 8, 3), g=lambda x, fraction:
+      _tf().image.central_crop(x, fraction).numpy(),
+      kw={"fraction": 0.5}),
+    C("image_flip_left_right", _img, g=lambda x: x[:, :, ::-1]),
+    C("image_flip_up_down", _img, g=lambda x: x[:, ::-1]),
+    C("image_rot90", _img, g=lambda x, k=1: np.rot90(
+        x, k, axes=(-3, -2)), kw={"k": 3}),
+    C("crop_and_resize", F01(2, 8, 8, 2),
+      np.asarray([[0.1, 0.1, 0.7, 0.9], [0.0, 0.0, 1.0, 1.0]],
+                 np.float32),
+      np.asarray([0, 1], np.int32), (4, 4),
+      g=lambda img, boxes, bi, size, method="bilinear":
+      _tf().image.crop_and_resize(
+          img, boxes, bi, size,
+          method="bilinear").numpy(), tol=1e-3),
+    C("extract_image_patches", None, g=None),  # placeholder, removed below
+]
+CASES = [c for c in CASES if c.op != "extract_image_patches"]
+
+# ---- space/depth/batch reshuffles (TF goldens) ----
+_s2d = F(2, 4, 4, 3)
+CASES += [
+    C("space_to_depth", _s2d, g=lambda x, block_size=2:
+      _tf().nn.space_to_depth(x, block_size).numpy()),
+    C("depth_to_space", F(2, 2, 2, 12), g=lambda x, block_size=2:
+      _tf().nn.depth_to_space(x, block_size).numpy()),
+    C("space_to_batch", _s2d, g=lambda x, block=2,
+      paddings=((0, 0), (0, 0)): _tf().space_to_batch(
+          x, [block, block], paddings).numpy()),
+    C("batch_to_space", F(8, 2, 2, 3), g=lambda x, block=2,
+      crops=((0, 0), (0, 0)): _tf().batch_to_space(
+          x, [block, block], crops).numpy()),
+    C("space_to_batch_nd", _s2d, (2, 2), ((0, 0), (0, 0)),
+      g=lambda x, bs, p: _tf().space_to_batch_nd(x, list(bs),
+                                                 list(p)).numpy()),
+    C("batch_to_space_nd", F(8, 2, 2, 3), (2, 2), ((0, 0), (0, 0)),
+      g=lambda x, bs, c: _tf().batch_to_space(x, list(bs),
+                                              list(c)).numpy()),
+    C("batch_to_space", F(8, 3, 3, 2), kw={"crops": ((1, 1), (0, 2))},
+      g=lambda x, block=2, crops=((0, 0), (0, 0)): _tf().batch_to_space(
+          x, [block, block], crops).numpy(), tag="crops"),
+]
+
+# ---- resize family ----
+_r_in = F01(1, 4, 4, 2)
+CASES += [
+    C("resize_nearest", _r_in, (8, 8), g=lambda x, size:
+      np.repeat(np.repeat(x, 2, 1), 2, 2)),
+    C("resize_bilinear", _r_in, (4, 4), g=lambda x, size: x,
+      tag="same"),
+    C("resize_bilinear", np.ones((1, 4, 4, 1), np.float32), (7, 7),
+      g=lambda x, size: np.ones((1, 7, 7, 1)), tag="const"),
+    C("image_resize", _r_in, (4, 4), g=lambda x, size,
+      method="bilinear": x),
+    C("resize_bicubic", np.ones((1, 4, 4, 1), np.float32), (6, 6),
+      g=lambda x, size: np.ones((1, 6, 6, 1)), tol=1e-4),
+    C("resize_lanczos", np.ones((1, 4, 4, 1), np.float32), (6, 6),
+      g=lambda x, size: np.ones((1, 6, 6, 1)), tol=1e-4),
+    C("resize_area", F01(1, 6, 6, 2), (3, 3), g=lambda x, size:
+      x.reshape(1, 3, 2, 3, 2, 2).mean((2, 4)), tol=1e-5),
+]
+
+# ---- nms / boxes ----
+_boxes = np.asarray([[0.0, 0.0, 0.5, 0.5],
+                     [0.05, 0.05, 0.55, 0.55],     # IoU with 0 > 0.5
+                     [0.6, 0.6, 1.0, 1.0],
+                     [0.0, 0.6, 0.4, 1.0]], np.float32)
+_scores = np.asarray([0.9, 0.8, 0.7, 0.3], np.float32)
+
+
+def _iou_matrix(b):
+    n = b.shape[0]
+    out = np.zeros((n, n), np.float32)
+    area = np.maximum(b[:, 2] - b[:, 0], 0) * np.maximum(
+        b[:, 3] - b[:, 1], 0)
+    for i in range(n):
+        for j in range(n):
+            yy1, xx1 = max(b[i, 0], b[j, 0]), max(b[i, 1], b[j, 1])
+            yy2, xx2 = min(b[i, 2], b[j, 2]), min(b[i, 3], b[j, 3])
+            inter = max(yy2 - yy1, 0) * max(xx2 - xx1, 0)
+            out[i, j] = inter / max(area[i] + area[j] - inter, 1e-12)
+    return out
+
+
+CASES += [
+    C("non_max_suppression", _boxes, _scores, 3,
+      g=lambda b, s, m, iou_threshold=0.5, score_threshold=None:
+      _tf().image.non_max_suppression(b, s, m, 0.5).numpy(),
+      kw={"iou_threshold": 0.5}),
+    C("non_max_suppression_overlaps", _iou_matrix(_boxes), _scores, 3,
+      g=lambda o, s, m, overlap_threshold=0.5, score_threshold=None:
+      np.asarray([0, 2, 3]), kw={"overlap_threshold": 0.5}),
+]
+
+
+def _draw_boxes_check(out):
+    img = out[0]
+    # box edge pixel colored, far-away pixel untouched
+    assert np.allclose(img[0, 2, 2], 1.0)       # corner of the box
+    assert np.allclose(img[0, 7, 7], _DRAW_IMG[0, 7, 7])
+
+
+_DRAW_IMG = np.zeros((1, 8, 8, 3), np.float32) + 0.2
+CASES += [
+    C("draw_bounding_boxes", _DRAW_IMG,
+      np.asarray([[[2 / 7, 2 / 7, 5 / 7, 5 / 7]]], np.float32),
+      check=_draw_boxes_check),
+]
+
+# ---- random family (fixed-key property checks) ----
+
+
+def _rand_case(op, kwargs, check, tag=""):
+    def custom(fn):
+        import jax
+        k = jax.random.PRNGKey(5)
+        out = fn(k, **kwargs)
+        a = np.asarray(out)
+        out2 = np.asarray(fn(k, **kwargs))
+        np.testing.assert_array_equal(a, out2)   # deterministic per key
+        check(a)
+    return C(op, custom=custom, tag=tag)
+
+
+CASES += [
+    _rand_case("random_uniform", {"shape": (2000,), "minval": 1.0,
+                                  "maxval": 3.0},
+               lambda a: (np.testing.assert_allclose(a.mean(), 2.0,
+                                                     atol=0.1),
+                          np.testing.assert_array_less(a, 3.0),
+                          np.testing.assert_array_less(0.999, a))),
+    _rand_case("random_normal", {"shape": (4000,), "mean": 1.0,
+                                 "stddev": 2.0},
+               lambda a: (np.testing.assert_allclose(a.mean(), 1.0,
+                                                     atol=0.15),
+                          np.testing.assert_allclose(a.std(), 2.0,
+                                                     atol=0.15))),
+    _rand_case("random_bernoulli", {"shape": (4000,), "p": 0.3},
+               lambda a: np.testing.assert_allclose(a.mean(), 0.3,
+                                                    atol=0.05)),
+    _rand_case("random_exponential", {"shape": (4000,), "lam": 2.0},
+               lambda a: np.testing.assert_allclose(a.mean(), 0.5,
+                                                    atol=0.06)),
+    _rand_case("random_gamma", {"shape": (4000,), "alpha": 2.0,
+                                "beta": 2.0},
+               lambda a: np.testing.assert_allclose(a.mean(), 1.0,
+                                                    atol=0.1)),
+    _rand_case("random_poisson", {"shape": (4000,), "lam": 3.0},
+               lambda a: np.testing.assert_allclose(a.mean(), 3.0,
+                                                    atol=0.2)),
+    _rand_case("random_lognormal", {"shape": (4000,), "mean": 0.0,
+                                    "stddev": 0.5},
+               lambda a: np.testing.assert_allclose(
+                   np.log(a).mean(), 0.0, atol=0.1)),
+    _rand_case("random_binomial", {"shape": (3000,), "n": 10, "p": 0.4},
+               lambda a: np.testing.assert_allclose(a.mean(), 4.0,
+                                                    atol=0.3)),
+    _rand_case("truncated_normal", {"shape": (3000,)},
+               lambda a: (np.testing.assert_array_less(np.abs(a), 2.001),
+                          np.testing.assert_allclose(a.mean(), 0.0,
+                                                     atol=0.1))),
+    _rand_case("random_randint", {"shape": (2000,), "minval": 2,
+                                  "maxval": 7},
+               lambda a: (np.testing.assert_array_less(a, 7),
+                          np.testing.assert_array_less(1, a))),
+]
+
+
+def _shuffle_custom(fn):
+    import jax
+    x = np.arange(40, dtype=np.float32)
+    y = np.asarray(fn(jax.random.PRNGKey(1), x))
+    assert not np.array_equal(y, x)
+    np.testing.assert_array_equal(np.sort(y), x)
+
+
+def _multinomial_custom(fn):
+    import jax
+    logits = np.log(np.asarray([[0.8, 0.1, 0.1]], np.float32))
+    s = np.asarray(fn(jax.random.PRNGKey(2), logits, 500))
+    assert s.shape == (1, 500)
+    assert set(np.unique(s)) <= {0, 1, 2}
+    assert (s == 0).mean() > 0.6
+
+
+def _choice_custom(fn):
+    import jax
+    src = np.asarray([10.0, 20.0, 30.0], np.float32)
+    p = np.asarray([0.0, 1.0, 0.0], np.float32)
+    out = np.asarray(fn(jax.random.PRNGKey(3), src, p, 50))
+    np.testing.assert_array_equal(out, np.full(50, 20.0))
+
+
+def _crop_custom(fn):
+    import jax
+    x = np.arange(36, dtype=np.float32).reshape(6, 6)
+    out = np.asarray(fn(jax.random.PRNGKey(4), x, (3, 3)))
+    assert out.shape == (3, 3)
+    r0, c0 = int(out[0, 0]) // 6, int(out[0, 0]) % 6
+    np.testing.assert_array_equal(out, x[r0:r0 + 3, c0:c0 + 3])
+
+
+def _rng_fold_custom(fn):
+    import jax
+    k = jax.random.PRNGKey(0)
+    a, b = np.asarray(fn(k, 1)), np.asarray(fn(k, 2))
+    assert not np.array_equal(a, b)
+    np.testing.assert_array_equal(np.asarray(fn(k, 1)), a)
+
+
+def _rng_fold_opt_custom(fn):
+    import jax
+    assert fn(None, 3) is None
+    k = jax.random.PRNGKey(0)
+    assert fn(k, 3) is not None
+
+
+CASES += [
+    C("random_shuffle", custom=_shuffle_custom),
+    C("multinomial", custom=_multinomial_custom),
+    C("random_choice", custom=_choice_custom),
+    C("random_crop", custom=_crop_custom),
+    C("rng_fold", custom=_rng_fold_custom),
+    C("rng_fold_opt", custom=_rng_fold_opt_custom),
+]
+
+# ---- scatter / segment (independent numpy loops) ----
+_sc_a = F(6, 3)
+_sc_idx = np.asarray([0, 2, 5], np.int32)           # unique
+_sc_dup = np.asarray([0, 2, 2], np.int32)           # duplicates (add-only)
+_sc_upd = F(3, 3)
+
+
+def _np_scatter(a, idx, upd, op):
+    out = a.astype(np.float64).copy()
+    for i, j in enumerate(idx):
+        if op == "add":
+            out[j] += upd[i]
+        elif op == "set":
+            out[j] = upd[i]
+        elif op == "max":
+            out[j] = np.maximum(out[j], upd[i])
+        elif op == "min":
+            out[j] = np.minimum(out[j], upd[i])
+        elif op == "mul":
+            out[j] *= upd[i]
+        elif op == "div":
+            out[j] /= upd[i]
+        elif op == "sub":
+            out[j] -= upd[i]
+    return out
+
+
+CASES += [
+    C("scatter_add", _sc_a, _sc_dup, _sc_upd,
+      g=lambda a, i, u: _np_scatter(a, i, u, "add"), tol=1e-5),
+    C("scatter_sub", _sc_a, _sc_dup, _sc_upd,
+      g=lambda a, i, u: _np_scatter(a, i, u, "sub"), tol=1e-5),
+    C("scatter_update", _sc_a, _sc_idx, _sc_upd,
+      g=lambda a, i, u: _np_scatter(a, i, u, "set")),
+    C("scatter_max", _sc_a, _sc_idx, _sc_upd,
+      g=lambda a, i, u: _np_scatter(a, i, u, "max")),
+    C("scatter_min", _sc_a, _sc_idx, _sc_upd,
+      g=lambda a, i, u: _np_scatter(a, i, u, "min")),
+    C("scatter_mul", _sc_a, _sc_idx, _sc_upd,
+      g=lambda a, i, u: _np_scatter(a, i, u, "mul"), tol=1e-5),
+    C("scatter_div", _sc_a, _sc_idx, FP(3, 3),
+      g=lambda a, i, u: _np_scatter(a, i, u, "div"), tol=1e-5),
+]
+
+_nd_idx = np.asarray([[0, 1], [2, 0], [3, 2]], np.int32)
+_nd_upd = F(3)
+
+
+def _np_scatter_nd(a, idx, upd, op):
+    out = a.astype(np.float64).copy()
+    for k in range(idx.shape[0]):
+        i, j = idx[k]
+        if op == "add":
+            out[i, j] += upd[k]
+        elif op == "sub":
+            out[i, j] -= upd[k]
+        elif op == "set":
+            out[i, j] = upd[k]
+        elif op == "max":
+            out[i, j] = max(out[i, j], upd[k])
+        elif op == "min":
+            out[i, j] = min(out[i, j], upd[k])
+    return out
+
+
+CASES += [
+    C("scatter_nd_add", F(4, 3), _nd_idx, _nd_upd,
+      g=lambda a, i, u: _np_scatter_nd(a, i, u, "add"), tol=1e-5),
+    C("scatter_nd_sub", F(4, 3), _nd_idx, _nd_upd,
+      g=lambda a, i, u: _np_scatter_nd(a, i, u, "sub"), tol=1e-5),
+    C("scatter_nd_update", F(4, 3), _nd_idx, _nd_upd,
+      g=lambda a, i, u: _np_scatter_nd(a, i, u, "set")),
+    C("scatter_nd_max", F(4, 3), _nd_idx, _nd_upd,
+      g=lambda a, i, u: _np_scatter_nd(a, i, u, "max")),
+    C("scatter_nd_min", F(4, 3), _nd_idx, _nd_upd,
+      g=lambda a, i, u: _np_scatter_nd(a, i, u, "min")),
+    C("scatter_nd", _nd_idx, _nd_upd, (4, 3),
+      g=lambda i, u, s: _np_scatter_nd(np.zeros(s, np.float32), i, u,
+                                       "add"), tol=1e-5),
+]
+
+_seg_data = F(6, 2)
+_seg_ids = np.asarray([0, 0, 1, 2, 2, 2], np.int32)
+
+
+def _np_segment(data, ids, n, op):
+    init = {"sum": 0.0, "prod": 1.0, "max": -np.inf, "min": np.inf}[op]
+    out = np.full((n,) + data.shape[1:], init)
+    for i, s in enumerate(ids):
+        if op == "sum":
+            out[s] += data[i]
+        elif op == "prod":
+            out[s] *= data[i]
+        elif op == "max":
+            out[s] = np.maximum(out[s], data[i])
+        elif op == "min":
+            out[s] = np.minimum(out[s], data[i])
+    return out
+
+
+CASES += [
+    C("segment_sum", _seg_data, _seg_ids, 3,
+      g=lambda d, i, n: _np_segment(d, i, n, "sum"), tol=1e-5),
+    C("segment_max", _seg_data, _seg_ids, 3,
+      g=lambda d, i, n: _np_segment(d, i, n, "max")),
+    C("segment_min", _seg_data, _seg_ids, 3,
+      g=lambda d, i, n: _np_segment(d, i, n, "min")),
+    C("segment_prod", _seg_data, _seg_ids, 3,
+      g=lambda d, i, n: _np_segment(d, i, n, "prod"), tol=1e-5),
+    C("segment_mean", _seg_data, _seg_ids, 3,
+      g=lambda d, i, n: _np_segment(d, i, n, "sum")
+      / np.asarray([2, 1, 3])[:, None], tol=1e-5),
+    C("unsorted_segment_sum", _seg_data,
+      np.asarray([2, 0, 1, 0, 2, 2], np.int32), 3,
+      g=lambda d, i, n: _np_segment(d, i, n, "sum"), tol=1e-5),
+    C("unsorted_segment_max", _seg_data,
+      np.asarray([2, 0, 1, 0, 2, 2], np.int32), 3,
+      g=lambda d, i, n: _np_segment(d, i, n, "max")),
+    C("unsorted_segment_min", _seg_data,
+      np.asarray([2, 0, 1, 0, 2, 2], np.int32), 3,
+      g=lambda d, i, n: _np_segment(d, i, n, "min")),
+    C("unsorted_segment_prod", _seg_data,
+      np.asarray([2, 0, 1, 0, 2, 2], np.int32), 3,
+      g=lambda d, i, n: _np_segment(d, i, n, "prod"), tol=1e-5),
+    C("unsorted_segment_mean", _seg_data,
+      np.asarray([2, 0, 1, 0, 2, 2], np.int32), 3,
+      g=lambda d, i, n: _np_segment(d, i, n, "sum")
+      / np.asarray([2, 1, 3])[:, None], tol=1e-5),
+    C("unsorted_segment_sqrt_n", _seg_data,
+      np.asarray([2, 0, 1, 0, 2, 2], np.int32), 3,
+      g=lambda d, i, n: _np_segment(d, i, n, "sum")
+      / np.sqrt(np.asarray([2, 1, 3]))[:, None], tol=1e-5),
+]
+
+# ---- dynamic partition / stitch (host-side) ----
+CASES += [
+    C("dynamic_partition", jit=False, custom=lambda fn: (
+        lambda out: (
+            np.testing.assert_allclose(np.asarray(out[0]),
+                                       [[1., 2.], [5., 6.]]),
+            np.testing.assert_allclose(np.asarray(out[1]),
+                                       [[3., 4.]]))
+    )(fn(np.asarray([[1., 2.], [3., 4.], [5., 6.]], np.float32),
+         np.asarray([0, 1, 0], np.int32), 2))),
+    C("dynamic_stitch",
+      [np.asarray([0, 2], np.int32), np.asarray([1, 3], np.int32)],
+      [np.asarray([[1., 1.], [3., 3.]], np.float32),
+       np.asarray([[2., 2.], [4., 4.]], np.float32)],
+      g=lambda idx, data: np.asarray(
+          [[1., 1.], [2., 2.], [3., 3.], [4., 4.]]), jit=False),
+]
+
+# ---- sparse / misc transforms ----
+CASES += [
+    C("sparse_to_dense", np.asarray([[0, 1], [2, 2]], np.int32), (3, 4),
+      np.asarray([5.0, 7.0], np.float32),
+      g=lambda i, s, v, default_value=0.0: np.asarray(
+          [[0, 5, 0, 0], [0, 0, 0, 0], [0, 0, 7, 0]], np.float64)),
+    C("mergemax", F(3, 4), F(3, 4), F(3, 4),
+      g=lambda *xs: np.maximum(np.maximum(xs[0], xs[1]), xs[2])),
+    C("mergeadd", F(3, 4), F(3, 4), F(3, 4), g=lambda *xs: sum(xs)),
+    C("mergeavg", F(3, 4), F(3, 4), F(3, 4),
+      g=lambda *xs: sum(xs) / 3, tol=1e-5),
+    C("mergemaxindex", F(3, 4), F(3, 4), F(3, 4),
+      g=lambda *xs: np.argmax(np.stack(xs), 0).astype(np.int32)),
+    C("fake_quant_with_min_max_args", F(3, 5, lo=-8, hi=8),
+      kw={"min": -6.0, "max": 6.0, "num_bits": 8},
+      g=lambda x, min=-6.0, max=6.0, num_bits=8, narrow_range=False:
+      _tf().quantization.fake_quant_with_min_max_args(
+          x, min, max, num_bits, narrow_range).numpy(), tol=1e-4),
+    C("fake_quant_with_min_max_vars", F(3, 5, lo=-8, hi=8),
+      np.float32(-4.0), np.float32(4.0),
+      g=lambda x, mn, mx, num_bits=8, narrow_range=False:
+      _tf().quantization.fake_quant_with_min_max_vars(
+          x, float(mn), float(mx), num_bits, narrow_range).numpy(),
+      tol=1e-4),
+    C("dilation2d", F(1, 5, 5, 2), F(2, 2, 2, lo=-0.3, hi=0.3),
+      g=lambda x, f, stride=(1, 1), padding="SAME":
+      _tf().nn.dilation2d(
+          x, f, strides=(1, 1, 1, 1), padding="SAME",
+          data_format="NHWC", dilations=(1, 1, 1, 1)).numpy(),
+      tol=1e-4),
+    C("max_pool_with_argmax", F(1, 4, 4, 2),
+      g=lambda x, kernel=(2, 2), stride=(2, 2), padding="VALID": (
+          _tf().nn.max_pool_with_argmax(
+              x, (1, 2, 2, 1), (1, 2, 2, 1), "VALID",
+              include_batch_in_index=False)[0].numpy(),
+          _tf().nn.max_pool_with_argmax(
+              x, (1, 2, 2, 1), (1, 2, 2, 1), "VALID",
+              include_batch_in_index=False)[1].numpy())),
+]
+
+# ---- compression (round-trip property checks) ----
+
+
+def _threshold_check(fn):
+    from deeplearning4j_tpu.autodiff.ops import OP_TABLE
+    g = np.asarray([0.5, -0.2, 0.001, 0.9, -0.0005, -0.7], np.float32)
+    enc = np.asarray(fn(g, threshold=0.1, max_elements=6))
+    dec = np.asarray(OP_TABLE["decode_threshold"](enc, 6, threshold=0.1))
+    want = np.where(np.abs(g) >= 0.1, np.sign(g) * 0.1, 0.0)
+    np.testing.assert_allclose(dec, want, atol=1e-6)
+
+
+def _bitmap_check(fn):
+    from deeplearning4j_tpu.autodiff.ops import OP_TABLE
+    g = np.asarray([0.5, -0.2, 0.001, 0.9] * 5, np.float32)
+    packed, cnt = fn(g, threshold=0.1)
+    dec = np.asarray(OP_TABLE["decode_bitmap"](packed, 20, threshold=0.1))
+    want = np.where(np.abs(g) >= 0.1, np.sign(g) * 0.1, 0.0)
+    np.testing.assert_allclose(dec, want, atol=1e-6)
+    assert int(cnt) == int(np.sum(np.abs(g) >= 0.1))
+
+
+CASES += [
+    C("encode_threshold", custom=_threshold_check),
+    C("decode_threshold", np.asarray([1, -2, 0, 4], np.int32), 4,
+      kw={"threshold": 0.5},
+      g=lambda e, size, threshold=0.5: np.asarray(
+          [0.5, -0.5, 0, 0.5], np.float64) * [1, 1, 0, 1] * 1.0),
+    C("encode_bitmap", custom=_bitmap_check),
+    C("decode_bitmap", custom=lambda fn: _bitmap_check.__wrapped__(fn)
+      if hasattr(_bitmap_check, "__wrapped__") else None, jit=False),
+]
+CASES = [c for c in CASES if not (c.op == "decode_bitmap"
+                                  and c.custom is not None)]
+
+
+def _decode_bitmap_custom(fn):
+    packed = np.asarray([0b1001], np.int32)   # flags: [1, 2, 0, ...]
+    dec = np.asarray(fn(packed, 4, threshold=0.2))
+    np.testing.assert_allclose(dec, [0.2, -0.2, 0.0, 0.0], atol=1e-7)
+
+
+CASES.append(C("decode_bitmap", custom=_decode_bitmap_custom))
+
+# fix decode_threshold golden above: codes ±(idx+1) scatter ±thr at idx
+CASES = [c for c in CASES if c.op != "decode_threshold"]
+CASES.append(
+    C("decode_threshold", np.asarray([1, -2, 0, 4], np.int32), 4,
+      kw={"threshold": 0.5},
+      g=lambda e, size, threshold=0.5: np.asarray(
+          [0.5, -0.5, 0.0, 0.5], np.float64)))
+
+# ---- TensorList family (host-side stateful) ----
+
+
+def _list_flow(fn_name, flow):
+    def custom(fn):
+        flow(fn)
+    return C(fn_name, jit=False, custom=custom)
+
+
+def _f_create(fn):
+    lst = fn(size=3)
+    assert len(lst) == 3
+    assert len(fn()) == 0
+
+
+def _f_write_read(fn):
+    from deeplearning4j_tpu.autodiff.ops import OP_TABLE
+    lst = OP_TABLE["create_list"]()
+    fn(lst, 2, np.asarray([1.0, 2.0], np.float32))
+    got = np.asarray(OP_TABLE["read_list"](lst, 2))
+    np.testing.assert_allclose(got, [1.0, 2.0])
+
+
+def _f_read(fn):
+    from deeplearning4j_tpu.autodiff.ops import OP_TABLE
+    lst = OP_TABLE["create_list"]()
+    OP_TABLE["write_list"](lst, 0, np.float32(7.0))
+    np.testing.assert_allclose(np.asarray(fn(lst, 0)), 7.0)
+
+
+def _f_size(fn):
+    from deeplearning4j_tpu.autodiff.ops import OP_TABLE
+    lst = OP_TABLE["create_list"](size=4)
+    assert int(fn(lst)) == 4
+
+
+def _f_stack(fn):
+    from deeplearning4j_tpu.autodiff.ops import OP_TABLE
+    lst = OP_TABLE["create_list"]()
+    for i in range(3):
+        OP_TABLE["write_list"](lst, i, np.full(2, i, np.float32))
+    np.testing.assert_allclose(np.asarray(fn(lst)),
+                               [[0, 0], [1, 1], [2, 2]])
+
+
+def _f_unstack(fn):
+    from deeplearning4j_tpu.autodiff.ops import OP_TABLE
+    lst = OP_TABLE["create_list"]()
+    x = np.asarray([[1.0], [2.0]], np.float32)
+    fn(lst, x)
+    np.testing.assert_allclose(np.asarray(lst.arrays[1]), [2.0])
+
+
+def _f_gather(fn):
+    from deeplearning4j_tpu.autodiff.ops import OP_TABLE
+    lst = OP_TABLE["create_list"]()
+    for i in range(4):
+        OP_TABLE["write_list"](lst, i, np.full(2, i, np.float32))
+    got = np.asarray(fn(lst, np.asarray([3, 1], np.int32)))
+    np.testing.assert_allclose(got, [[3, 3], [1, 1]])
+
+
+def _f_scatter(fn):
+    from deeplearning4j_tpu.autodiff.ops import OP_TABLE
+    lst = OP_TABLE["create_list"]()
+    fn(lst, np.asarray([1, 0], np.int32),
+       np.asarray([[5.0], [6.0]], np.float32))
+    np.testing.assert_allclose(np.asarray(lst.arrays[0]), [6.0])
+    np.testing.assert_allclose(np.asarray(lst.arrays[1]), [5.0])
+
+
+def _f_split(fn):
+    from deeplearning4j_tpu.autodiff.ops import OP_TABLE
+    lst = OP_TABLE["create_list"]()
+    x = np.arange(6, dtype=np.float32).reshape(6, 1)
+    fn(lst, x, np.asarray([2, 4], np.int32))
+    assert len(lst) == 2
+    np.testing.assert_allclose(np.asarray(lst.arrays[1]),
+                               x[2:])
+
+
+def _f_pick(fn):
+    from deeplearning4j_tpu.autodiff.ops import OP_TABLE
+    lst = OP_TABLE["create_list"]()
+    for i in range(3):
+        OP_TABLE["write_list"](
+            lst, i, np.full((1, 2), i, np.float32))
+    got = np.asarray(fn(lst, np.asarray([2, 0], np.int32)))
+    np.testing.assert_allclose(got, [[2, 2], [0, 0]])
+
+
+def _f_tear(fn):
+    lst = fn(np.asarray([[1.0, 2.0], [3.0, 4.0]], np.float32), axis=1)
+    assert len(lst) == 2
+    np.testing.assert_allclose(np.asarray(lst.arrays[0]), [1.0, 3.0])
+
+
+CASES += [
+    _list_flow("create_list", _f_create),
+    _list_flow("write_list", _f_write_read),
+    _list_flow("read_list", _f_read),
+    _list_flow("size_list", _f_size),
+    _list_flow("stack_list", _f_stack),
+    _list_flow("unstack_list", _f_unstack),
+    _list_flow("gather_list", _f_gather),
+    _list_flow("scatter_list", _f_scatter),
+    _list_flow("split_list", _f_split),
+    _list_flow("pick_list", _f_pick),
+    _list_flow("tear", _f_tear),
+]
+
+# ---- word2vec training ops (loss-decreases property) ----
+
+
+def _skipgram_custom(fn):
+    s0 = rs.uniform(-0.1, 0.1, (20, 8)).astype(np.float32)
+    s1 = rs.uniform(-0.1, 0.1, (20, 8)).astype(np.float32)
+    centers = np.asarray([1, 2, 3], np.int32)
+    contexts = np.asarray([4, 5, 6], np.int32)
+    negs = np.asarray([[7, 8], [9, 10], [11, 12]], np.int32)
+    n0, n1, loss0 = fn(s0, s1, centers, contexts, negs, lr=0.5)
+    _, _, loss1 = fn(np.asarray(n0), np.asarray(n1), centers, contexts,
+                     negs, lr=0.5)
+    assert float(loss1) < float(loss0)
+    assert np.asarray(n0).shape == s0.shape
+
+
+def _cbow_custom(fn):
+    s0 = rs.uniform(-0.1, 0.1, (20, 8)).astype(np.float32)
+    s1 = rs.uniform(-0.1, 0.1, (20, 8)).astype(np.float32)
+    ctx = np.asarray([[1, 2, 0], [3, 4, 5]], np.int32)
+    cmask = np.asarray([[1, 1, 0], [1, 1, 1]], np.float32)
+    centers = np.asarray([6, 7], np.int32)
+    negs = np.asarray([[8, 9], [10, 11]], np.int32)
+    n0, n1, loss0 = fn(s0, s1, ctx, cmask, centers, negs, lr=0.5)
+    _, _, loss1 = fn(np.asarray(n0), np.asarray(n1), ctx, cmask, centers,
+                     negs, lr=0.5)
+    assert float(loss1) < float(loss0)
+
+
+CASES += [
+    C("skipgram", custom=_skipgram_custom),
+    C("cbow", custom=_cbow_custom),
+]
+
+# ---- barnes-hut t-SNE helpers ----
+
+
+def _barnes_sym_custom(fn):
+    from scipy.sparse import csr_matrix
+    rp = np.asarray([0, 2, 3, 4], np.int64)
+    cp = np.asarray([1, 2, 0, 1], np.int64)
+    vp = np.asarray([0.5, 0.3, 0.2, 0.4], np.float64)
+    outp, outc, outv = fn(rp, cp, vp, 3)
+    got = csr_matrix((np.asarray(outv), np.asarray(outc),
+                      np.asarray(outp)), shape=(3, 3)).toarray()
+    m = csr_matrix((vp, cp, rp), shape=(3, 3))
+    want = ((m + m.T) * 0.5).toarray()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def _barnes_edge_custom(fn):
+    rp = np.asarray([0, 1, 2], np.int64)
+    cp = np.asarray([1, 0], np.int64)
+    vp = np.asarray([0.6, 0.6], np.float64)
+    y = np.asarray([[0.0, 0.0], [1.0, 1.0]], np.float32)
+    out = np.asarray(fn(rp, cp, vp, y))
+    d = y[0] - y[1]
+    q = 1.0 / (1.0 + np.sum(d * d))
+    np.testing.assert_allclose(out[0], 0.6 * q * d, atol=1e-6)
+    np.testing.assert_allclose(out[1], -0.6 * q * d, atol=1e-6)
+
+
+CASES += [
+    C("barnes_gains", FP(5), F(5), F(5),
+      g=lambda gains, grad, step: np.maximum(
+          np.where(np.sign(grad) == np.sign(step), gains * 0.8,
+                   gains + 0.2), 0.01)),
+    C("barnes_symmetrize", jit=False, custom=_barnes_sym_custom),
+    C("barnes_edge_forces", jit=False, custom=_barnes_edge_custom),
+]
+
+# ---- host-side / passthrough / assert ----
+
+
+def _assert_equal_custom(fn):
+    a = np.asarray([1.0, 2.0], np.float32)
+    np.testing.assert_allclose(np.asarray(fn(a, a.copy())), a)
+    try:
+        fn(a, a + 1.0)
+    except ValueError:
+        return
+    raise AssertionError("assert_equal did not raise on mismatch")
+
+
+def _eig_custom(fn):
+    a = np.asarray(rs.uniform(-1, 1, (4, 4)), np.float32)
+    w, v = fn(a)
+    w, v = np.asarray(w), np.asarray(v)
+    np.testing.assert_allclose(a.astype(complex) @ v, v * w[None, :],
+                               atol=1e-4)
+
+
+def _choose_custom(fn):
+    x = np.asarray([1.0, 5.0, 3.0, 0.5], np.float32)
+    vals, cnt = fn(x, 2.0, mode=2)   # mode 2: '>'
+    np.testing.assert_allclose(np.asarray(vals), [5.0, 3.0])
+    assert int(cnt) == 2
+
+
+def _hashcode_custom(fn):
+    x = F(4, 5)
+    a, b = fn(x), fn(x.copy())
+    assert int(a) == int(b)
+    assert int(fn(x + 1.0)) != int(a)
+
+
+CASES += [
+    C("assert_equal", jit=False, custom=_assert_equal_custom),
+    C("print_variable", np.asarray([1.0], np.float32),
+      g=lambda x, message="": x, jit=False, kw={"message": "v="}),
+    C("eig", jit=False, custom=_eig_custom),
+    C("choose", jit=False, custom=_choose_custom),
+    C("hashcode", jit=False, custom=_hashcode_custom),
+    C("broadcast_dynamic_shape", np.asarray([3, 1], np.int32),
+      np.asarray([1, 4], np.int32),
+      g=lambda a, b: np.asarray([3, 4], np.int32), jit=False),
+    C("broadcast_gradient_args", np.asarray([3, 1], np.int32),
+      np.asarray([3, 4], np.int32),
+      g=lambda a, b: (np.asarray([1], np.int32),
+                      np.asarray([], np.int32)), jit=False),
+]
+
+# ---- onnx/tf layout helpers ----
+CASES += [
+    C("reshape_onnx", F(2, 3, 4), (0, -1),
+      g=lambda x, s: x.reshape(2, 12)),
+    C("flatten2d", F(2, 3, 4), g=lambda x, axis=1: x.reshape(2, 12)),
+    C("slice_onnx", F(4, 6), (1, 0), (3, 5),
+      kw={"axes": (0, 1), "steps": (1, 2)},
+      g=lambda x, st, en, axes=None, steps=None: x[1:3, 0:5:2]),
+    C("tf_strided_slice", F(4, 6), (1, 0), (3, 6), (1, 2),
+      g=lambda x, b, e, s, **kw: x[1:3, 0:6:2]),
+    C("tf_strided_slice", F(4, 6), (1, 1), (3, 3), (1, 1),
+      kw={"shrink_axis_mask": 2},
+      g=lambda x, b, e, s, **kw: x[1:3, 1], tag="shrink"),
+]

@@ -1,0 +1,728 @@
+"""OpValidation specs, part 2: nn activations / conv / pool / rnn /
+attention / norm / updater ops.  Conv and recurrent goldens come from
+torch (CPU) with explicit layout/gate-order mapping — the same
+cross-framework conformance strategy the reference uses against TF goldens
+in `TFGraphTestAllSameDiff` (SURVEY.md §4)."""
+import numpy as np
+import scipy.special as ss
+
+from tests.opval_specs_core import C, F, FP, F01, I32, rs
+
+CASES = []
+
+_x = F(3, 5)
+
+# ---- activations (independent numpy closed forms) ----
+_SELU_L = 1.0507009873554805
+_SELU_A = 1.6732632423543772
+
+
+def _sig(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_softmax(x, axis=-1):
+    e = np.exp(x - np.max(x, axis=axis, keepdims=True))
+    return e / np.sum(e, axis=axis, keepdims=True)
+
+
+CASES += [
+    C("relu", _x, g=lambda a: np.maximum(a, 0), grad=(0,)),
+    C("relu6", F(3, 5, lo=-2, hi=8), g=lambda a: np.clip(a, 0, 6)),
+    C("relu_derivative", _x, g=lambda a: (a > 0).astype(np.float32)),
+    C("leaky_relu", _x, g=lambda a, alpha=0.01:
+      np.where(a > 0, a, alpha * a), kw={"alpha": 0.2}, grad=(0,)),
+    C("elu", _x, g=lambda a: np.where(a > 0, a, np.expm1(a)), grad=(0,)),
+    C("selu", _x, g=lambda a: _SELU_L * np.where(
+        a > 0, a, _SELU_A * np.expm1(a)), tol=1e-4, grad=(0,)),
+    C("celu", _x, g=lambda a, alpha=1.0:
+      np.maximum(a, 0) + np.minimum(0, alpha * np.expm1(a / alpha)),
+      kw={"alpha": 0.7}, tol=1e-4),
+    C("gelu", _x, g=lambda a: 0.5 * a * (1 + np.tanh(
+        np.sqrt(2 / np.pi) * (a + 0.044715 * a ** 3))), tol=2e-3,
+      grad=(0,), gtol=2e-2),
+    C("gelu_tanh", _x, g=lambda a: 0.5 * a * (1 + np.tanh(
+        np.sqrt(2 / np.pi) * (a + 0.044715 * a ** 3))), tol=1e-4),
+    C("sigmoid", _x, g=_sig, grad=(0,)),
+    C("log_sigmoid", _x, g=lambda a: np.log(_sig(a)), grad=(0,)),
+    C("softplus", _x, g=lambda a: np.log1p(np.exp(a)), grad=(0,)),
+    C("softsign", _x, g=lambda a: a / (1 + np.abs(a)), grad=(0,)),
+    C("swish", _x, g=lambda a: a * _sig(a), grad=(0,)),
+    C("mish", _x, g=lambda a: a * np.tanh(np.log1p(np.exp(a))),
+      grad=(0,), tol=1e-4),
+    C("hard_sigmoid", F(3, 5, lo=-4, hi=4),
+      g=lambda a: np.clip(a + 3, 0, 6) / 6, tol=1e-4),
+    C("hard_swish", F(3, 5, lo=-4, hi=4),
+      g=lambda a: a * np.clip(a + 3, 0, 6) / 6, tol=1e-4),
+    C("hard_tanh", F(3, 5, lo=-3, hi=3), g=lambda a: np.clip(a, -1, 1)),
+    C("rational_tanh", _x, g=lambda a: 1.7159 * np.tanh(2 * a / 3),
+      tol=1e-4),
+    C("rectified_tanh", _x, g=lambda a: np.maximum(0, np.tanh(a))),
+    C("thresholded_relu", _x, g=lambda a, theta=1.0:
+      np.where(a > theta, a, 0.0), kw={"theta": 0.5}),
+    C("prelu", _x, np.float32(0.25),
+      g=lambda x, al: np.where(x >= 0, x, 0.25 * x)),
+    C("glu", F(3, 6), g=lambda a, axis=-1:
+      a[..., :3] * _sig(a[..., 3:]), tol=1e-5),
+    C("softmax", _x, g=lambda a, axis=-1: _np_softmax(a, axis),
+      grad=(0,), tol=1e-4),
+    C("log_softmax", _x, g=lambda a, axis=-1:
+      np.log(_np_softmax(a, axis)), grad=(0,), tol=1e-4),
+]
+
+# ---- norms ----
+_ln_x = F(4, 6)
+_gain, _bias = FP(6), F(6)
+CASES += [
+    C("layer_norm", _ln_x, _gain, _bias,
+      g=lambda x, g, b, eps=1e-5, axis=-1:
+      (x - x.mean(-1, keepdims=True))
+      / np.sqrt(x.var(-1, keepdims=True) + eps) * g + b,
+      tol=1e-4, grad=(0, 1, 2), gtol=2e-2),
+    C("batch_norm", _ln_x, F(6), FP(6, lo=0.5, hi=2.0), FP(6), F(6),
+      g=lambda x, m, v, gamma, beta, eps=1e-5:
+      (x - m) / np.sqrt(v + eps) * gamma + beta, tol=1e-4),
+    C("standardize", _ln_x, g=lambda a, axis=-1, eps=1e-8:
+      (a - a.mean(-1, keepdims=True)) / (a.std(-1, keepdims=True) + eps),
+      tol=1e-4),
+    C("l2_normalize", _ln_x, g=lambda a, axis=-1, eps=0:
+      a / np.linalg.norm(a, axis=-1, keepdims=True), tol=1e-4,
+      grad=(0,)),
+    C("fused_batch_norm", F(2, 3, 3, 4), FP(4), F(4),
+      g=lambda x, s, o, eps=1e-3: (
+          (x - x.mean((0, 1, 2))) / np.sqrt(x.var((0, 1, 2)) + eps)
+          * s + o,
+          x.mean((0, 1, 2)),
+          x.var((0, 1, 2)) * (18 / 17)), tol=1e-4),
+]
+
+
+# ---- torch golden helpers ----
+def _nhwc_conv_golden(x, w, b=None, stride=(1, 1), padding="SAME",
+                      dilation=(1, 1)):
+    import torch
+    import torch.nn.functional as TF
+    pad = 1 if padding == "SAME" else 0   # configs below keep this exact
+    y = TF.conv2d(torch.from_numpy(x.transpose(0, 3, 1, 2)).double(),
+                  torch.from_numpy(w.transpose(3, 2, 0, 1)).double(),
+                  None if b is None else torch.from_numpy(b).double(),
+                  stride=stride, padding=pad, dilation=dilation)
+    return y.numpy().transpose(0, 2, 3, 1)
+
+
+def _depthwise_golden(x, w, stride=(1, 1), padding="SAME",
+                      dilation=(1, 1)):
+    import torch
+    import torch.nn.functional as TF
+    pad = 1 if padding == "SAME" else 0
+    c = x.shape[-1]
+    y = TF.conv2d(torch.from_numpy(x.transpose(0, 3, 1, 2)).double(),
+                  torch.from_numpy(w.transpose(3, 2, 0, 1)).double(),
+                  None, stride=stride, padding=pad, dilation=dilation,
+                  groups=c)
+    return y.numpy().transpose(0, 2, 3, 1)
+
+
+def _conv1d_golden(x, w, stride=1, padding="SAME", dilation=1):
+    import torch
+    import torch.nn.functional as TF
+    pad = 1 if padding == "SAME" else 0
+    y = TF.conv1d(torch.from_numpy(x.transpose(0, 2, 1)).double(),
+                  torch.from_numpy(w.transpose(2, 1, 0)).double(),
+                  None, stride=stride, padding=pad, dilation=dilation)
+    return y.numpy().transpose(0, 2, 1)
+
+
+def _conv3d_golden(x, w, b=None, stride=(1, 1, 1), padding="SAME",
+                   dilation=(1, 1, 1)):
+    import torch
+    import torch.nn.functional as TF
+    pad = 1 if padding == "SAME" else 0
+    y = TF.conv3d(torch.from_numpy(x.transpose(0, 4, 1, 2, 3)).double(),
+                  torch.from_numpy(w.transpose(4, 3, 0, 1, 2)).double(),
+                  None if b is None else torch.from_numpy(b).double(),
+                  stride=stride, padding=pad, dilation=dilation)
+    return y.numpy().transpose(0, 2, 3, 4, 1)
+
+
+def _deconv2d_valid_golden(x, w, b=None, stride=(2, 2), padding="VALID"):
+    """Independent scatter-accumulate transposed conv, VALID padding."""
+    B, H, W, Ci = x.shape
+    kh, kw, ci, co = w.shape
+    sh, sw = stride
+    out = np.zeros((B, (H - 1) * sh + kh, (W - 1) * sw + kw, co))
+    for i in range(H):
+        for j in range(W):
+            patch = np.einsum("bc,hwco->bhwo", x[:, i, j], w)
+            out[:, i * sh:i * sh + kh, j * sw:j * sw + kw] += patch
+    return out if b is None else out + b
+
+
+def _deconv3d_valid_golden(x, w, stride=(2, 2, 2), padding="VALID"):
+    B, D, H, W, Ci = x.shape
+    kd, kh, kw, ci, co = w.shape
+    sd, sh, sw = stride
+    out = np.zeros((B, (D - 1) * sd + kd, (H - 1) * sh + kh,
+                    (W - 1) * sw + kw, co))
+    for d in range(D):
+        for i in range(H):
+            for j in range(W):
+                patch = np.einsum("bc,dhwco->bdhwo", x[:, d, i, j], w)
+                out[:, d * sd:d * sd + kd, i * sh:i * sh + kh,
+                    j * sw:j * sw + kw] += patch
+    return out
+
+
+def _pool2d_golden(mode):
+    def g(x, kernel=(2, 2), stride=(2, 2), padding="VALID"):
+        import torch
+        import torch.nn.functional as TF
+        t = torch.from_numpy(x.transpose(0, 3, 1, 2)).double()
+        y = (TF.max_pool2d(t, kernel, stride) if mode == "max"
+             else TF.avg_pool2d(t, kernel, stride))
+        return y.numpy().transpose(0, 2, 3, 1)
+    return g
+
+
+_img = F(2, 6, 6, 3)
+_w33 = F(3, 3, 3, 4, lo=-0.5, hi=0.5)
+CASES += [
+    C("conv2d", _img, _w33, F(4), g=_nhwc_conv_golden, tol=1e-4,
+      grad=(0, 1), gtol=2e-2),
+    C("conv2d", _img, _w33, kw={"stride": (2, 2), "padding": "VALID"},
+      g=_nhwc_conv_golden, tol=1e-4, tag="valid-s2"),
+    C("depthwise_conv2d", _img, F(3, 3, 1, 6, lo=-0.5, hi=0.5),
+      g=_depthwise_golden, tol=1e-4),
+    C("conv1d", F(2, 8, 3), F(3, 3, 5, lo=-0.5, hi=0.5),
+      g=_conv1d_golden, tol=1e-4),
+    C("conv3d", F(1, 4, 4, 4, 2), F(3, 3, 3, 2, 3, lo=-0.5, hi=0.5),
+      F(3), g=_conv3d_golden, tol=1e-4),
+    C("deconv2d", F(2, 3, 3, 2), F(2, 2, 2, 3, lo=-0.5, hi=0.5),
+      kw={"stride": (2, 2), "padding": "VALID"},
+      g=lambda x, w, b=None, stride=(2, 2), padding="VALID":
+      _deconv2d_valid_golden(x, w, b, stride), tol=1e-4),
+    C("deconv3d", F(1, 2, 2, 2, 2), F(2, 2, 2, 2, 3, lo=-0.5, hi=0.5),
+      kw={"stride": (2, 2, 2), "padding": "VALID"},
+      g=lambda x, w, stride=(2, 2, 2), padding="VALID":
+      _deconv3d_valid_golden(x, w, stride), tol=1e-4),
+    C("max_pooling2d", _img, g=_pool2d_golden("max")),
+    C("avg_pooling2d", _img, g=_pool2d_golden("avg"), tol=1e-5),
+    C("max_pooling1d", F(2, 8, 3), g=lambda x, kernel=2, stride=2,
+      padding="VALID": x.reshape(2, 4, 2, 3).max(2)),
+    C("avg_pooling1d", F(2, 8, 3), g=lambda x, kernel=2, stride=2,
+      padding="VALID": x.reshape(2, 4, 2, 3).mean(2), tol=1e-5),
+    C("max_pooling3d", F(1, 4, 4, 4, 2), g=lambda x, kernel=(2, 2, 2),
+      stride=(2, 2, 2), padding="VALID":
+      x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max((2, 4, 6))),
+    C("avg_pooling3d", F(1, 4, 4, 4, 2), g=lambda x, kernel=(2, 2, 2),
+      stride=(2, 2, 2), padding="VALID":
+      x.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean((2, 4, 6)), tol=1e-5),
+    C("pnorm_pool2d", FP(2, 4, 4, 3), kw={"p": 3},
+      g=lambda x, kernel=(2, 2), stride=(2, 2), p=2, padding="VALID":
+      (x.reshape(2, 2, 2, 2, 2, 3) ** p).sum((2, 4)) ** (1 / p),
+      tol=1e-4),
+    C("global_avg_pool_nchw", F(2, 3, 4, 4),
+      g=lambda x: x.mean((2, 3), keepdims=True), tol=1e-5),
+    C("pointwise_conv2d", _img, F(1, 1, 3, 5, lo=-0.5, hi=0.5),
+      g=lambda x, w: np.einsum("bhwi,io->bhwo", x,
+                               w.reshape(3, 5)), tol=1e-4),
+    C("separable_conv2d", _img, F(3, 3, 3, 2, lo=-0.5, hi=0.5),
+      F(1, 1, 6, 4, lo=-0.5, hi=0.5),
+      g=lambda x, wd, wp, stride=(1, 1), padding="SAME":
+      np.einsum("bhwi,io->bhwo",
+                _depthwise_golden(
+                    x, wd.reshape(3, 3, 1, 6), stride, padding),
+                wp.reshape(6, 4)), tol=1e-4),
+    C("upsampling2d", F(2, 3, 3, 2), g=lambda x, scale=2:
+      np.repeat(np.repeat(x, scale, 1), scale, 2)),
+    C("upsampling3d", F(1, 2, 2, 2, 2), g=lambda x, size=2:
+      np.repeat(np.repeat(np.repeat(x, size, 1), size, 2), size, 3)),
+    C("lrn", F(2, 4, 4, 8), kw={"k": 1.0, "n": 3, "alpha": 1e-2,
+                                "beta": 0.75},
+      g=lambda x, k=2.0, n=5, alpha=1e-4, beta=0.75: __import__(
+          "torch.nn.functional", fromlist=["local_response_norm"])
+      .local_response_norm(
+          __import__("torch").from_numpy(
+              x.transpose(0, 3, 1, 2)).double(), n, alpha * n, beta, k)
+      .numpy().transpose(0, 2, 3, 1), tol=1e-4),
+]
+
+
+# NCHW / ONNX-layout convs
+def _nchw_conv_golden(x, w, b=None, stride=(1, 1), pads=(1, 1, 1, 1),
+                      dilation=(1, 1), groups=1):
+    import torch
+    import torch.nn.functional as TF
+    y = TF.conv2d(torch.from_numpy(x).double(),
+                  torch.from_numpy(w).double(),
+                  None if b is None else torch.from_numpy(b).double(),
+                  stride=stride, padding=(pads[0], pads[1]),
+                  dilation=dilation, groups=groups)
+    return y.numpy()
+
+
+CASES += [
+    C("conv2d_nchw", F(2, 3, 5, 5), F(4, 3, 3, 3, lo=-0.5, hi=0.5),
+      F(4), kw={"pads": (1, 1, 1, 1)}, g=_nchw_conv_golden, tol=1e-4),
+    C("max_pool2d_nchw", F(2, 3, 6, 6),
+      g=lambda x, kernel=(2, 2), stride=(2, 2), pads=(0, 0, 0, 0):
+      x.reshape(2, 3, 3, 2, 3, 2).max((3, 5))),
+    C("avg_pool2d_nchw", F(2, 3, 6, 6),
+      kw={"pads": (1, 1, 1, 1), "count_include_pad": False},
+      g=lambda x, kernel=(2, 2), stride=(2, 2), pads=(0, 0, 0, 0),
+      count_include_pad=False: __import__(
+          "torch.nn.functional", fromlist=["avg_pool2d"]).avg_pool2d(
+          __import__("torch").from_numpy(x).double(), kernel, stride,
+          padding=1, count_include_pad=False).numpy(), tol=1e-4),
+    C("batch_norm_nchw", F(2, 4, 3, 3), FP(4), F(4), F(4),
+      FP(4, lo=0.5, hi=2.0),
+      g=lambda x, s, b, m, v, eps=1e-5: __import__(
+          "torch.nn.functional", fromlist=["batch_norm"]).batch_norm(
+          __import__("torch").from_numpy(x).double(),
+          __import__("torch").from_numpy(m).double(),
+          __import__("torch").from_numpy(v).double(),
+          __import__("torch").from_numpy(s).double(),
+          __import__("torch").from_numpy(b).double(),
+          False, 0.0, eps).numpy(), tol=1e-4),
+]
+
+# ---- im2col / patches ----
+_p_in = F(1, 4, 4, 2)
+
+
+def _patches_golden(x, ksizes, strides=(1, 1), rates=(1, 1),
+                    padding="VALID"):
+    from numpy.lib.stride_tricks import sliding_window_view
+    kh, kw = ksizes
+    v = sliding_window_view(x, (kh, kw), axis=(1, 2))   # B,OH,OW,C,kh,kw
+    v = v[:, ::strides[0], ::strides[1]]
+    return v.transpose(0, 1, 2, 4, 5, 3).reshape(
+        v.shape[0], v.shape[1], v.shape[2], -1)
+
+
+CASES += [
+    C("extract_image_patches", _p_in, (3, 3), g=_patches_golden),
+    C("im2col", _p_in, 3, 3, g=lambda x, kh, kw, sh=1, sw=1, ph=0, pw=0,
+      dh=1, dw=1: _patches_golden(x, (kh, kw), (sh, sw)).reshape(
+          1, 2, 2, 3, 3, 2)),
+    C("col2im", custom=None, jit=False,
+      check=None, g=None),
+]
+CASES = [c for c in CASES if c.op != "col2im"]
+
+
+def _col2im_custom(fn):
+    from numpy.lib.stride_tricks import sliding_window_view
+    x = F(1, 4, 4, 2)
+    from deeplearning4j_tpu.autodiff.ops import OP_TABLE
+    cols = OP_TABLE["im2col"](x, 2, 2, 2, 2)   # non-overlapping 2x2
+    out = np.asarray(fn(cols, 4, 4, 2, 2, 2, 2))
+    np.testing.assert_allclose(out, x, atol=1e-6)
+
+
+CASES.append(C("col2im", custom=_col2im_custom))
+
+# ---- attention ----
+_q, _k, _v = F(2, 4, 6), F(2, 4, 6), F(2, 4, 6)
+
+
+def _dpa_golden(q, k, v, mask=None, scaled=True):
+    s = q @ np.swapaxes(k, -1, -2)
+    if scaled:
+        s = s / np.sqrt(q.shape[-1])
+    if mask is not None:
+        s = np.where(mask[..., None, :] > 0, s, -1e9)
+    return _np_softmax(s, -1) @ v
+
+
+_amask = (rs.rand(2, 4) > 0.3).astype(np.float32)
+CASES += [
+    C("dot_product_attention", _q, _k, _v, g=_dpa_golden, tol=1e-4,
+      grad=(0, 1, 2), gtol=2e-2),
+    C("dot_product_attention", _q, _k, _v, _amask, g=_dpa_golden,
+      tol=1e-4, tag="masked"),
+]
+
+
+def _mhdpa_golden(q, k, v, wq, wk, wv, wo, mask=None, scaled=True):
+    qh = np.einsum("btf,hdf->bhtd", q, wq)
+    kh = np.einsum("btf,hdf->bhtd", k, wk)
+    vh = np.einsum("btf,hdf->bhtd", v, wv)
+    s = np.einsum("bhtd,bhsd->bhts", qh, kh)
+    if scaled:
+        s = s / np.sqrt(qh.shape[-1])
+    if mask is not None:
+        s = np.where(mask[:, None, None, :] > 0, s, -1e9)
+    ctx = np.einsum("bhts,bhsd->bhtd", _np_softmax(s, -1), vh)
+    return np.einsum("bhtd,ohd->bto", ctx, wo)
+
+
+CASES += [
+    C("multi_head_dot_product_attention", F(2, 4, 6), F(2, 4, 6),
+      F(2, 4, 6), F(2, 3, 6, lo=-0.5, hi=0.5),
+      F(2, 3, 6, lo=-0.5, hi=0.5), F(2, 3, 6, lo=-0.5, hi=0.5),
+      F(6, 2, 3, lo=-0.5, hi=0.5), g=_mhdpa_golden, tol=1e-4),
+]
+
+
+# ---- recurrent (torch goldens with explicit gate-order mapping) ----
+def _lstm_cell_golden(x, h, c, w_ih, w_hh, b=None):
+    import torch
+    cell = torch.nn.LSTMCell(x.shape[-1], h.shape[-1]).double()
+    with torch.no_grad():
+        cell.weight_ih.copy_(torch.from_numpy(w_ih.T))
+        cell.weight_hh.copy_(torch.from_numpy(w_hh.T))
+        cell.bias_ih.copy_(torch.from_numpy(
+            b if b is not None else np.zeros(4 * h.shape[-1])))
+        cell.bias_hh.zero_()
+    hn, cn = cell(torch.from_numpy(x).double(),
+                  (torch.from_numpy(h).double(),
+                   torch.from_numpy(c).double()))
+    return hn.detach().numpy(), cn.detach().numpy()
+
+
+def _gru_cell_golden(x, h, w_ih, w_hh, b_ih=None, b_hh=None):
+    import torch
+    H = h.shape[-1]
+    cell = torch.nn.GRUCell(x.shape[-1], H).double()
+    with torch.no_grad():
+        cell.weight_ih.copy_(torch.from_numpy(w_ih.T))
+        cell.weight_hh.copy_(torch.from_numpy(w_hh.T))
+        cell.bias_ih.copy_(torch.from_numpy(
+            b_ih if b_ih is not None else np.zeros(3 * H)))
+        cell.bias_hh.copy_(torch.from_numpy(
+            b_hh if b_hh is not None else np.zeros(3 * H)))
+    hn = cell(torch.from_numpy(x).double(), torch.from_numpy(h).double())
+    return hn.detach().numpy()
+
+
+def _torch_lstm_seq(x, w_ih_t, w_hh_t, b_t):
+    """Run torch.nn.LSTM with torch-order [i,f,g,o] weight rows."""
+    import torch
+    B, T, Fdim = x.shape
+    H = w_hh_t.shape[1]
+    m = torch.nn.LSTM(Fdim, H, batch_first=True).double()
+    with torch.no_grad():
+        m.weight_ih_l0.copy_(torch.from_numpy(w_ih_t))
+        m.weight_hh_l0.copy_(torch.from_numpy(w_hh_t))
+        m.bias_ih_l0.copy_(torch.from_numpy(b_t))
+        m.bias_hh_l0.zero_()
+    out, (hn, cn) = m(torch.from_numpy(x).double())
+    return (out.detach().numpy(), hn.detach().numpy()[0],
+            cn.detach().numpy()[0])
+
+
+def _lstm_layer_golden(x, w, rw, b):
+    """ours IFOG columns -> torch [i,f,g,o] rows."""
+    H = rw.shape[0]
+
+    def remap(m):   # columns i,f,o,g -> rows i,f,g,o
+        return np.concatenate([m[:, :H], m[:, H:2 * H], m[:, 3 * H:],
+                               m[:, 2 * H:3 * H]], axis=1).T
+    bt = np.concatenate([b[:H], b[H:2 * H], b[3 * H:], b[2 * H:3 * H]])
+    return _torch_lstm_seq(x, remap(w), remap(rw), bt)[0]
+
+
+def _lstm_layer_full_golden(x, w_ih, w_hh, b=None, h0=None, c0=None):
+    """ours IFCO columns == torch [i,f,g,o] rows directly."""
+    bt = b if b is not None else np.zeros(4 * w_hh.shape[0])
+    return _torch_lstm_seq(x, w_ih.T, w_hh.T, bt)
+
+
+_B, _T, _F, _H = 2, 5, 3, 4
+_lx = F(_B, _T, _F)
+CASES += [
+    C("lstm_cell", F(_B, _F), F(_B, _H), F(_B, _H),
+      F(_F, 4 * _H, lo=-0.5, hi=0.5), F(_H, 4 * _H, lo=-0.5, hi=0.5),
+      F(4 * _H, lo=-0.5, hi=0.5), g=_lstm_cell_golden, tol=1e-4),
+    C("gru_cell", F(_B, _F), F(_B, _H),
+      F(_F, 3 * _H, lo=-0.5, hi=0.5), F(_H, 3 * _H, lo=-0.5, hi=0.5),
+      F(3 * _H, lo=-0.5, hi=0.5), F(3 * _H, lo=-0.5, hi=0.5),
+      g=_gru_cell_golden, tol=1e-4),
+    C("lstm_layer", _lx, F(_F, 4 * _H, lo=-0.5, hi=0.5),
+      F(_H, 4 * _H, lo=-0.5, hi=0.5), F(4 * _H, lo=-0.5, hi=0.5),
+      g=_lstm_layer_golden, tol=1e-4),
+    C("lstm_layer_full", _lx, F(_F, 4 * _H, lo=-0.5, hi=0.5),
+      F(_H, 4 * _H, lo=-0.5, hi=0.5), F(4 * _H, lo=-0.5, hi=0.5),
+      g=_lstm_layer_full_golden, tol=1e-4),
+]
+
+
+def _gru_layer_golden(x, h0, w_ih, w_hh, b_ih=None, b_hh=None):
+    import torch
+    B, T, Fdim = x.shape
+    H = w_hh.shape[0]
+    m = torch.nn.GRU(Fdim, H, batch_first=True).double()
+    with torch.no_grad():
+        m.weight_ih_l0.copy_(torch.from_numpy(w_ih.T))
+        m.weight_hh_l0.copy_(torch.from_numpy(w_hh.T))
+        m.bias_ih_l0.copy_(torch.from_numpy(
+            b_ih if b_ih is not None else np.zeros(3 * H)))
+        m.bias_hh_l0.copy_(torch.from_numpy(
+            b_hh if b_hh is not None else np.zeros(3 * H)))
+    out, _ = m(torch.from_numpy(x).double(),
+               torch.from_numpy(h0[None]).double())
+    return out.detach().numpy()
+
+
+def _rnn_golden(x, w, rw, b=None, h0=None, seq_lengths=None):
+    """Independent numpy recurrence for dynamic_rnn."""
+    B, T, Fdim = x.shape
+    H = rw.shape[0]
+    h = np.zeros((B, H)) if h0 is None else h0.copy()
+    bias = 0 if b is None else b
+    outs = np.zeros((B, T, H))
+    for t in range(T):
+        h_new = np.tanh(x[:, t] @ w + h @ rw + bias)
+        if seq_lengths is not None:
+            live = (t < seq_lengths)[:, None]
+            h_new = np.where(live, h_new, h)
+            outs[:, t] = np.where(live, h_new, 0.0)
+        else:
+            outs[:, t] = h_new
+        h = h_new
+    return outs, h
+
+
+def _sru_golden(x, c0, w, b):
+    B, T, Fdim = x.shape
+    H = c0.shape[-1]
+    c = c0.copy().astype(np.float64)
+    hs = np.zeros((B, T, H))
+    for t in range(T):
+        z = x[:, t] @ w
+        xt, f_in, r_in = z[:, :H], z[:, H:2 * H], z[:, 2 * H:]
+        f = _sig(f_in + b[:H])
+        r = _sig(r_in + b[H:])
+        c = f * c + (1 - f) * xt
+        hs[:, t] = r * np.tanh(c) + (1 - r) * x[:, t]
+    return hs
+
+
+_rnn_w = F(_F, _H, lo=-0.5, hi=0.5)
+_rnn_rw = F(_H, _H, lo=-0.5, hi=0.5)
+_rnn_b = F(_H, lo=-0.5, hi=0.5)
+_seq_l = np.asarray([3, 5], np.int32)
+CASES += [
+    C("gru_layer", _lx, np.zeros((_B, _H), np.float32),
+      F(_F, 3 * _H, lo=-0.5, hi=0.5), F(_H, 3 * _H, lo=-0.5, hi=0.5),
+      F(3 * _H, lo=-0.5, hi=0.5), F(3 * _H, lo=-0.5, hi=0.5),
+      g=_gru_layer_golden, tol=1e-4),
+    C("dynamic_rnn", _lx, _rnn_w, _rnn_rw, _rnn_b,
+      kw={"seq_lengths": np.asarray([3, 5], np.int32)},
+      g=lambda x, w, rw, b=None, h0=None, seq_lengths=None:
+      _rnn_golden(x, w, rw, b, h0, seq_lengths), tol=1e-4),
+    C("static_rnn", _lx, _rnn_w, _rnn_rw, _rnn_b,
+      g=lambda x, w, rw, b=None, h0=None:
+      _rnn_golden(x, w, rw, b, h0), tol=1e-4),
+    C("dynamic_bidirectional_rnn", _lx, _rnn_w, _rnn_rw, _rnn_b,
+      F(_F, _H, lo=-0.5, hi=0.5), F(_H, _H, lo=-0.5, hi=0.5),
+      F(_H, lo=-0.5, hi=0.5),
+      g=lambda x, wf, rwf, bf, wb, rwb, bb, seq_lengths=None: (
+          _rnn_golden(x, wf, rwf, bf)[0],
+          _rnn_golden(x[:, ::-1], wb, rwb, bb)[0][:, ::-1],
+          _rnn_golden(x, wf, rwf, bf)[1],
+          _rnn_golden(x[:, ::-1], wb, rwb, bb)[1]), tol=1e-4),
+    C("static_bidirectional_rnn", _lx, _rnn_w, _rnn_rw, _rnn_b,
+      F(_F, _H, lo=-0.5, hi=0.5), F(_H, _H, lo=-0.5, hi=0.5),
+      F(_H, lo=-0.5, hi=0.5),
+      g=lambda x, wf, rwf, bf, wb, rwb, bb: (
+          _rnn_golden(x, wf, rwf, bf)[0],
+          _rnn_golden(x[:, ::-1], wb, rwb, bb)[0][:, ::-1],
+          _rnn_golden(x, wf, rwf, bf)[1],
+          _rnn_golden(x[:, ::-1], wb, rwb, bb)[1]), tol=1e-4),
+    C("sru_cell", F(_B, _H), F(_B, _H),
+      F(_H, 3 * _H, lo=-0.5, hi=0.5), F(2 * _H, lo=-0.5, hi=0.5),
+      g=lambda x, c, w, b: (
+          _sru_golden(x[:, None], c, w, b)[:, 0],
+          _sig((x @ w)[:, _H:2 * _H] + b[:_H]) * c
+          + (1 - _sig((x @ w)[:, _H:2 * _H] + b[:_H])) * (x @ w)[:, :_H]),
+      tol=1e-4),
+    C("sru_layer", F(_B, _T, _H), np.zeros((_B, _H), np.float32),
+      F(_H, 3 * _H, lo=-0.5, hi=0.5), F(2 * _H, lo=-0.5, hi=0.5),
+      g=lambda x, c0, w, b: _sru_golden(x, c0, w, b), tol=1e-4),
+]
+
+
+def _lstm_block_check(out):
+    """7 leaves (i, c, f, o, z, h, y): h matches torch, y == h."""
+    i, c, f, o, z, h, y = out
+    np.testing.assert_allclose(y, h, atol=1e-6)
+    w_ih, w_hh, b = _BLOCK_W
+    want, _, _ = _torch_lstm_seq(_BLOCK_X.astype(np.float64), w_ih.T,
+                                 w_hh.T, b)
+    np.testing.assert_allclose(h, want, atol=1e-4)
+
+
+_BLOCK_X = F(_B, _T, _F)
+_BLOCK_W = (F(_F, 4 * _H, lo=-0.5, hi=0.5),
+            F(_H, 4 * _H, lo=-0.5, hi=0.5), F(4 * _H, lo=-0.5, hi=0.5))
+CASES += [
+    C("lstm_block", _BLOCK_X, *_BLOCK_W, check=_lstm_block_check),
+    C("lstm_block_cell", F(_B, _F), np.zeros((_B, _H), np.float32),
+      np.zeros((_B, _H), np.float32), F(_F, 4 * _H, lo=-0.5, hi=0.5),
+      F(_H, 4 * _H, lo=-0.5, hi=0.5), F(4 * _H, lo=-0.5, hi=0.5),
+      check=lambda out: (
+          np.testing.assert_allclose(out[5], out[6], atol=1e-6),
+          np.testing.assert_allclose(
+              out[1], out[2] * 0.0 + out[0] * out[4], atol=1e-5))),
+]
+
+# ---- ctc (torch golden) ----
+_ctc_B, _ctc_T, _ctc_C, _ctc_S = 2, 6, 5, 3
+_raw = rs.randn(_ctc_B, _ctc_T, _ctc_C).astype(np.float32)
+_ctc_lp = np.log(_np_softmax(_raw, -1)).astype(np.float32)
+_ctc_lab = rs.randint(1, _ctc_C, (_ctc_B, _ctc_S)).astype(np.int32)
+_ctc_il = np.asarray([6, 5], np.int32)
+_ctc_ll = np.asarray([3, 2], np.int32)
+
+
+def _ctc_golden(lp, labels, il, ll, blank=0):
+    import torch
+    loss = torch.nn.functional.ctc_loss(
+        torch.from_numpy(lp.transpose(1, 0, 2)).double(),
+        torch.from_numpy(labels.astype(np.int64)),
+        torch.from_numpy(il.astype(np.int64)),
+        torch.from_numpy(ll.astype(np.int64)),
+        blank=blank, reduction="none", zero_infinity=False)
+    return loss.numpy()
+
+
+def _ctc_greedy_golden(lp, il, blank=0):
+    B, T, Cn = lp.shape
+    out = np.full((B, T), -1, np.int64)
+    for b in range(B):
+        best = lp[b, :il[b]].argmax(-1)
+        prev, pos = -1, 0
+        for t, s in enumerate(best):
+            if s != blank and s != prev:
+                out[b, pos] = s
+                pos += 1
+            prev = s
+    return out
+
+
+CASES += [
+    C("ctc_loss", _ctc_lp, _ctc_lab, _ctc_il, _ctc_ll,
+      g=_ctc_golden, tol=1e-3, grad=(0,), gtol=2e-2),
+    C("ctc_greedy_decode", _ctc_lp, _ctc_il, g=_ctc_greedy_golden),
+    C("ctc_beam_decode", jit=False, custom=lambda fn: (
+        np.testing.assert_array_equal(
+            fn(_ctc_lp, _ctc_il, beam_width=1)[0],
+            [x for x in _ctc_greedy_golden(_ctc_lp, _ctc_il)[0]
+             if x >= 0]))),
+]
+
+# ---- embeddings / dropout ----
+CASES += [
+    C("embedding_lookup", F(7, 4), I32(5, hi=7),
+      g=lambda t, i: t[i], grad=(0,)),
+    C("dropout", _x, g=lambda x, rng=None, p=0.5: x, tag="infer"),
+]
+
+
+def _dropout_check(out):
+    y = out[0]
+    x = _DROP_X
+    kept = y != 0
+    np.testing.assert_allclose(y[kept], (x / 0.8)[kept], atol=1e-5)
+    assert 0.5 < kept.mean() < 0.97
+
+
+_DROP_X = FP(40, 25)
+CASES += [
+    C("dropout", _DROP_X, kw={"p": 0.8}, check=_dropout_check,
+      tag="train", jit=False, custom=None),
+]
+# rng arg: feed a real key through custom (PRNGKey is a jnp array —
+# build it lazily inside the custom to avoid import-time backend init)
+
+
+def _dropout_train_custom(fn):
+    import jax
+    y = np.asarray(fn(_DROP_X, jax.random.PRNGKey(3), p=0.8))
+    kept = y != 0
+    np.testing.assert_allclose(y[kept], (_DROP_X / 0.8)[kept], rtol=1e-5)
+    assert 0.55 < kept.mean() < 0.97
+
+
+def _dropout_inv_custom(fn):
+    import jax
+    y = np.asarray(fn(_DROP_X, jax.random.PRNGKey(3), p=0.3))
+    kept = y != 0
+    np.testing.assert_allclose(y[kept], (_DROP_X / 0.7)[kept], rtol=1e-5)
+    assert 0.4 < kept.mean() < 0.95
+
+
+def _alpha_dropout_custom(fn):
+    import jax
+    y = np.asarray(fn(_DROP_X, jax.random.PRNGKey(3), p=0.1))
+    a = ((1.0 - 0.1) * (1.0 + 0.1 * (-1.7580993408473766) ** 2)) ** -0.5
+    kept = np.isclose(y, a * _DROP_X + (-a * 0.1 * (-1.7580993408473766)))
+    assert 0.75 < kept.mean() <= 1.0
+
+
+CASES = [c for c in CASES if not (c.op == "dropout" and c.tag == "train")]
+CASES += [
+    C("dropout", custom=_dropout_train_custom, tag="train"),
+    C("dropout_inverted", custom=_dropout_inv_custom),
+    C("alpha_dropout", custom=_alpha_dropout_custom),
+]
+
+# ---- updater ops (independent numpy closed forms) ----
+_g, _m0, _v0 = F(5), FP(5, lo=0.0, hi=0.3), FP(5, lo=0.0, hi=0.3)
+CASES += [
+    C("sgd_updater", _g, g=lambda g, lr=0.01: g * lr, kw={"lr": 0.05}),
+    C("nesterovs_updater", _g, _m0,
+      g=lambda g, v, lr=0.1, momentum=0.9: (
+          momentum * v - (1 + momentum) * (momentum * v - lr * g),
+          momentum * v - lr * g)),
+    C("adam_updater", _g, _m0, _v0, np.float32(3.0),
+      g=lambda g, m, v, t, lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8: (
+          lr * (beta1 * m + (1 - beta1) * g) / (1 - beta1 ** (t + 1))
+          / (np.sqrt((beta2 * v + (1 - beta2) * g * g)
+                     / (1 - beta2 ** (t + 1))) + eps),
+          beta1 * m + (1 - beta1) * g,
+          beta2 * v + (1 - beta2) * g * g), tol=1e-4),
+    C("rms_prop_updater", _g, _v0,
+      g=lambda g, s, lr=1e-3, decay=0.95, eps=1e-8: (
+          lr * g / np.sqrt(decay * s + (1 - decay) * g * g + eps),
+          decay * s + (1 - decay) * g * g), tol=1e-4),
+    C("ada_grad_updater", _g, _v0,
+      g=lambda g, h, lr=1e-2, eps=1e-6: (
+          lr * g / (np.sqrt(h + g * g) + eps), h + g * g), tol=1e-4),
+    C("ada_delta_updater", _g, _m0, _v0,
+      g=lambda g, msg, msdx, rho=0.95, eps=1e-6: (
+          np.sqrt(msdx + eps)
+          / np.sqrt(rho * msg + (1 - rho) * g * g + eps) * g,
+          rho * msg + (1 - rho) * g * g,
+          rho * msdx + (1 - rho) * (
+              np.sqrt(msdx + eps)
+              / np.sqrt(rho * msg + (1 - rho) * g * g + eps) * g) ** 2),
+      tol=1e-4),
+    C("ada_max_updater", _g, _m0, _v0, np.float32(2.0),
+      g=lambda g, m, u, t, lr=2e-3, beta1=0.9, beta2=0.999, eps=1e-8: (
+          (lr / (1 - beta1 ** (t + 1))) * (beta1 * m + (1 - beta1) * g)
+          / (np.maximum(beta2 * u, np.abs(g)) + eps),
+          beta1 * m + (1 - beta1) * g,
+          np.maximum(beta2 * u, np.abs(g))), tol=1e-4),
+    C("nadam_updater", _g, _m0, _v0, np.float32(2.0),
+      g=lambda g, m, v, t, lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8: (
+          lr * (beta1 * ((beta1 * m + (1 - beta1) * g)
+                         / (1 - beta1 ** (t + 1)))
+                + (1 - beta1) * g / (1 - beta1 ** (t + 1)))
+          / (np.sqrt((beta2 * v + (1 - beta2) * g * g)
+                     / (1 - beta2 ** (t + 1))) + eps),
+          beta1 * m + (1 - beta1) * g,
+          beta2 * v + (1 - beta2) * g * g), tol=1e-4),
+    C("ams_grad_updater", _g, _m0, _v0, FP(5, lo=0.0, hi=0.3),
+      np.float32(2.0),
+      g=lambda g, m, v, vhat, t, lr=1e-3, beta1=0.9, beta2=0.999,
+      eps=1e-8: (
+          lr * (beta1 * m + (1 - beta1) * g)
+          / (np.sqrt(np.maximum(vhat, beta2 * v + (1 - beta2) * g * g))
+             + eps),
+          beta1 * m + (1 - beta1) * g,
+          beta2 * v + (1 - beta2) * g * g,
+          np.maximum(vhat, beta2 * v + (1 - beta2) * g * g)), tol=1e-4),
+]

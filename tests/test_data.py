@@ -600,3 +600,97 @@ def test_imdb_test_split_vocab_comes_from_train(tmp_path):
                             shuffle=False)
     assert te.vocab == tr.vocab                   # ids agree across splits
     assert "brandnewword" not in te.vocab         # test-only word -> unk
+
+
+# ---------------------------------------------------------------------------
+# Round-4 reader tail (reference datavec-api jackson/svmlight/regex readers
+# + TransformProcessRecordReader) and multi-process ETL
+# ---------------------------------------------------------------------------
+
+def test_jackson_line_record_reader():
+    from deeplearning4j_tpu.data import JacksonLineRecordReader
+    text = ('{"a": 1, "b": {"c": "x"}}\n'
+            '{"a": 2}\n'
+            '{"a": 3, "b": {"c": "z"}}\n')
+    rr = JacksonLineRecordReader(["a", "b/c"], text=text,
+                                 defaults=[0, "MISSING"])
+    recs = list(rr)
+    assert recs == [[1, "x"], [2, "MISSING"], [3, "z"]]
+
+
+def test_svmlight_record_reader(tmp_path):
+    from deeplearning4j_tpu.data import (LibSvmRecordReader,
+                                         SVMLightRecordReader)
+    p = tmp_path / "data.svm"
+    p.write_text("1 1:0.5 3:2.0 # comment\n"
+                 "-1 qid:7 2:1.5\n"
+                 "\n"
+                 "2,3 1:1.0\n")
+    recs = list(SVMLightRecordReader(3, path=str(p)))
+    assert recs[0] == [0.5, 0.0, 2.0, 1.0]
+    assert recs[1] == [0.0, 1.5, 0.0, -1.0]
+    assert recs[2] == [1.0, 0.0, 0.0, "2,3"]      # multilabel stays raw
+    assert LibSvmRecordReader is SVMLightRecordReader
+    # zero-based + no label
+    recs0 = list(SVMLightRecordReader(2, text="1 0:9.0\n", zero_based=True,
+                                      append_label=False))
+    assert recs0 == [[9.0, 0.0]]
+    with pytest.raises(ValueError):
+        list(SVMLightRecordReader(2, text="1 5:1.0\n"))
+
+
+def test_regex_record_readers(tmp_path):
+    from deeplearning4j_tpu.data import (RegexLineRecordReader,
+                                         RegexSequenceRecordReader)
+    rr = RegexLineRecordReader(
+        r"(\d+-\d+-\d+) (\w+) (.*)",
+        text="2049-01-01 INFO all good\n2049-01-02 WARN hmm\n")
+    assert list(rr) == [["2049-01-01", "INFO", "all good"],
+                       ["2049-01-02", "WARN", "hmm"]]
+    with pytest.raises(ValueError):
+        list(RegexLineRecordReader(r"(\d+)", text="nope\n"))
+    p1 = tmp_path / "a.log"
+    p1.write_text("1 x\n2 y\n")
+    p2 = tmp_path / "b.log"
+    p2.write_text("3 z\n")
+    seqs = list(RegexSequenceRecordReader(r"(\d+) (\w+)",
+                                          [str(p1), str(p2)]))
+    assert seqs == [[["1", "x"], ["2", "y"]], [["3", "z"]]]
+
+
+def test_transform_process_record_reader():
+    from deeplearning4j_tpu.data import (CollectionRecordReader, Schema,
+                                         TransformProcess,
+                                         TransformProcessRecordReader)
+    schema = (Schema.Builder().add_column_string("s")
+              .add_column_double("v").build())
+    tp = (TransformProcess.Builder(schema)
+          .string_to_double("s")
+          .math_op_double("v", "Multiply", 10.0)
+          .build())
+    rr = TransformProcessRecordReader(
+        CollectionRecordReader([["1.5", 2.0], ["2.5", 3.0]]), tp)
+    assert list(rr) == [[1.5, 20.0], [2.5, 30.0]]
+
+
+def test_local_transform_executor_multiprocess():
+    """2 real worker processes produce exactly the inline result, order
+    preserved (reference LocalTransformExecutor / SparkTransformExecutor
+    role)."""
+    from deeplearning4j_tpu.data import Schema, TransformProcess
+    from deeplearning4j_tpu.data.local_execution import (
+        LocalTransformExecutor)
+    schema = (Schema.Builder().add_column_string("s")
+              .add_column_double("v").build())
+    tp = (TransformProcess.Builder(schema)
+          .string_to_double("s")
+          .math_op_double("v", "Multiply", 3.0)
+          .build())
+    records = [[str(i), float(i)] for i in range(11)]
+    inline = tp.execute([list(r) for r in records])
+    out = LocalTransformExecutor(num_workers=2).execute(records, tp)
+    assert out == inline
+    assert out[5] == [5.0, 15.0]
+    # inline fallback path
+    out0 = LocalTransformExecutor(num_workers=0).execute(records, tp)
+    assert out0 == inline

@@ -674,3 +674,24 @@ def test_tf_cond_constant_branch_import_matches_tf():
         np.testing.assert_allclose(
             np.asarray(sd.output({"x": xv}, out_name)[out_name]),
             f(tf.constant(xv)).numpy(), rtol=1e-6)
+
+
+def test_keras_conv2d_transpose_exact(tmp_path):
+    """Regression: Conv2DTranspose must match Keras EXACTLY at the layer
+    output (gradient-form kernel orientation).  The extended-converters
+    test alone cannot catch a spatial kernel flip: its deconv (k=s=2)
+    feeds an AveragePooling2D(2), and averaging each non-overlapping tile
+    is invariant to flipping within the tile."""
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((5, 5, 3)),
+        tf.keras.layers.Conv2DTranspose(4, 3, strides=2, padding="same"),
+        tf.keras.layers.Conv2DTranspose(2, 2, strides=1, padding="valid")])
+    rs = np.random.RandomState(3)
+    for v in km.weights:
+        v.assign(rs.randn(*v.shape).astype(np.float32) * 0.3)
+    p = _save(km, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    x = rs.rand(2, 5, 5, 3).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               km.predict(x, verbose=0),
+                               rtol=1e-4, atol=1e-5)

@@ -1,0 +1,36 @@
+"""Registry-wide per-op validation sweep (reference OpValidation:
+`nd4j-api/.../org/nd4j/autodiff/validation/OpValidation.java` + the
+opvalidation test classes under `platform-tests/` — forward goldens,
+shape-function agreement, finite-difference gradients, and a coverage
+gate that FAILS on any registered op with neither a case nor an
+allowlist entry)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.validation import (coverage_report,
+                                                    validate_case)
+from tests import opval_specs_core, opval_specs_misc, opval_specs_nn
+
+ALL_CASES = (opval_specs_core.CASES + opval_specs_nn.CASES
+             + opval_specs_misc.CASES)
+
+# Ops with no validation case, each with a reason (kept deliberately
+# tiny; a stale entry — op gains a case later — fails the gate too).
+ALLOWLIST = {}
+
+
+@pytest.mark.parametrize("case", ALL_CASES, ids=lambda c: c.id)
+def test_op(case):
+    validate_case(case)
+
+
+def test_registry_coverage():
+    missing, stale, unknown, pct = coverage_report(ALL_CASES, ALLOWLIST)
+    assert not unknown, f"cases/allowlist name unregistered ops: {unknown}"
+    assert not stale, f"allowlist entries now have cases: {stale}"
+    assert not missing, (
+        f"{len(missing)} registered ops have no validation case and no "
+        f"allowlist entry: {missing}")
+    assert pct >= 0.90, (
+        f"only {pct:.1%} of the registry is value-checked (goldens or "
+        "property checks); need >= 90%")
