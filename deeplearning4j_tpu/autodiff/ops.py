@@ -1216,7 +1216,16 @@ def _matrix_set_diag(a, d):
 register_op("lu", jax.scipy.linalg.lu)
 register_op("pinv", jnp.linalg.pinv)
 register_op("expm", jax.scipy.linalg.expm)
-register_op("einsum", lambda eq, *xs: jnp.einsum(eq, *xs))
+def _einsum(*args, equation=None):
+    """Equation as first positional (numpy style) OR as the `equation`
+    kwarg (graph engines can't pass strings positionally — sd.op turns
+    positional non-variables into constants)."""
+    if equation is None:
+        equation, args = args[0], args[1:]
+    return jnp.einsum(equation, *args)
+
+
+register_op("einsum", _einsum)
 register_op("norm_fro", lambda a: jnp.linalg.norm(a))
 
 

@@ -97,9 +97,47 @@ R("Pow", lambda sd, n, ins: sd.op("pow", ins[0], ins[1], name=n.name))
 
 @R("Reshape")
 def _reshape(sd, n, ins):
-    shape = ins[1].get_arr()
+    # a Shape-driven integer subgraph resolves at import time via
+    # _static_value (the reference's import likewise only supports
+    # statically-resolvable reshape targets)
+    shape = _static_value(ins[1], f"Reshape '{n.name}'")
     return sd.op("reshape", ins[0],
                  shape=[int(s) for s in np.asarray(shape)], name=n.name)
+
+
+@R("Shape")
+def _tf_shape(sd, n, ins):
+    """Static input shapes (the frozen-graph norm) make Shape a
+    compile-time constant; dynamic shapes have no XLA story anyway.
+    Leaf nodes (placeholder/const/variable) carry their shape directly;
+    op outputs (the flatten pattern `tf.reshape(y, [tf.shape(y)[0], -1])`)
+    are inferred by ABSTRACT evaluation of the already-built subgraph."""
+    node = sd._nodes[ins[0].name]
+    while node.kind == "op" and node.op == "identity":
+        node = sd._nodes[node.inputs[0]]
+    if node.shape is not None:
+        return sd.constant(n.name, np.asarray(node.shape, np.int32))
+    import jax
+    phs = {name: nd for name, nd in sd._nodes.items()
+           if nd.kind == "placeholder"}
+    unshaped = [name for name, nd in phs.items() if nd.shape is None]
+    if unshaped:
+        raise UnmappedTFOpException(
+            f"Shape '{n.name}': placeholders {unshaped} have no static "
+            "shape — only statically-shaped graphs import")
+    specs = {name: jax.ShapeDtypeStruct(tuple(nd.shape),
+                                        np.dtype(nd.dtype))
+             for name, nd in phs.items()}
+    target = ins[0].name
+    try:
+        abstract = jax.eval_shape(
+            lambda feeds: sd._eval_graph(feeds, dict(sd.variables_),
+                                         [target])[target], specs)
+    except Exception as e:
+        raise UnmappedTFOpException(
+            f"Shape '{n.name}': abstract shape inference over "
+            f"'{target}' failed") from e
+    return sd.constant(n.name, np.asarray(abstract.shape, np.int32))
 
 
 @R("Transpose")
@@ -386,6 +424,172 @@ def _reduce_min(sd, n, ins):
                  keepdims=bool(n.attr["keep_dims"].b), name=n.name)
 
 
+# ---- round-4 conformance-corpus mappings (TFGraphTestAllSameDiff-style
+# per-op coverage surfaced these as unmapped; each is a thin lowering to
+# the registry op of the same semantics) ----
+
+R("FloorMod", lambda sd, n, ins: sd.op("mod", ins[0], ins[1],
+                                       name=n.name))
+R("Softsign", lambda sd, n, ins: sd.op("softsign", ins[0], name=n.name))
+R("Softplus", lambda sd, n, ins: sd.op("softplus", ins[0], name=n.name))
+R("Atan", lambda sd, n, ins: sd.op("atan", ins[0], name=n.name))
+R("Asin", lambda sd, n, ins: sd.op("asin", ins[0], name=n.name))
+R("Acos", lambda sd, n, ins: sd.op("acos", ins[0], name=n.name))
+R("Sinh", lambda sd, n, ins: sd.op("sinh", ins[0], name=n.name))
+R("Cosh", lambda sd, n, ins: sd.op("cosh", ins[0], name=n.name))
+R("Atan2", lambda sd, n, ins: sd.op("atan2", ins[0], ins[1],
+                                    name=n.name))
+R("Rint", lambda sd, n, ins: sd.op("rint", ins[0], name=n.name))
+R("Round", lambda sd, n, ins: sd.op("rint", ins[0], name=n.name))
+R("Log1p", lambda sd, n, ins: sd.op("log1p", ins[0], name=n.name))
+R("Expm1", lambda sd, n, ins: sd.op("expm1", ins[0], name=n.name))
+R("Sign", lambda sd, n, ins: sd.op("sign", ins[0], name=n.name))
+R("Floor", lambda sd, n, ins: sd.op("floor", ins[0], name=n.name))
+R("Ceil", lambda sd, n, ins: sd.op("ceil", ins[0], name=n.name))
+R("LogSoftmax", lambda sd, n, ins: sd.op("log_softmax", ins[0],
+                                         name=n.name))
+R("LogicalOr", lambda sd, n, ins: sd.op("logical_or", ins[0], ins[1],
+                                        name=n.name))
+R("LogicalAnd", lambda sd, n, ins: sd.op("logical_and", ins[0], ins[1],
+                                         name=n.name))
+R("LogicalNot", lambda sd, n, ins: sd.op("logical_not", ins[0],
+                                         name=n.name))
+R("GatherNd", lambda sd, n, ins: sd.op("gather_nd", ins[0], ins[1],
+                                       name=n.name))
+R("Selu", lambda sd, n, ins: sd.op("selu", ins[0], name=n.name))
+
+
+def _tf_argminmax(op):
+    def h(sd, n, ins):
+        from tensorflow.python.framework import dtypes
+        axis = int(np.asarray(ins[1].get_arr()))
+        v = sd.op(op, ins[0], axis=axis, name=n.name + "__i32")
+        # honor output_type (TF defaults to int64)
+        out_t = n.attr["output_type"].type
+        dt = (np.dtype(dtypes.as_dtype(out_t).as_numpy_dtype).name
+              if out_t else "int64")
+        return sd.op("cast", v, dtype=dt, name=n.name)
+    return h
+
+
+R("ArgMax", _tf_argminmax("argmax"))
+R("ArgMin", _tf_argminmax("argmin"))
+
+
+@R("Prod")
+def _tf_prod(sd, n, ins):
+    axes = [int(a) for a in np.atleast_1d(np.asarray(ins[1].get_arr()))]
+    return sd.op("prod", ins[0], axis=axes,
+                 keepdims=bool(n.attr["keep_dims"].b), name=n.name)
+
+
+@R("Cumsum")
+def _tf_cumsum(sd, n, ins):
+    axis = int(np.asarray(ins[1].get_arr()))
+    return sd.op("cumsum_ext", ins[0], axis=axis,
+                 exclusive=bool(n.attr["exclusive"].b),
+                 reverse=bool(n.attr["reverse"].b), name=n.name)
+
+
+@R("TopKV2")
+def _tf_topk(sd, n, ins):
+    k = int(np.asarray(ins[1].get_arr()))
+    # explicit inner name: _fresh() generates '<op>:<counter>' which could
+    # collide with the '<node>:<i>' output names when the TF node shares
+    # the registry op's name
+    v = sd.op("top_k", ins[0], k=k, name=f"{n.name}__packed")
+    return tuple(sd.op("tuple_get", v, index=i,
+                       name=n.name if i == 0 else f"{n.name}:{i}")
+                 for i in range(2))
+
+
+@R("Unpack")
+def _tf_unpack(sd, n, ins):
+    num = int(n.attr["num"].i)
+    axis = int(n.attr["axis"].i)
+    v = sd.op("unstack", ins[0], axis=axis, name=f"{n.name}__packed")
+    return tuple(sd.op("tuple_get", v, index=i,
+                       name=n.name if i == 0 else f"{n.name}:{i}")
+                 for i in range(num))
+
+
+@R("ReverseV2")
+def _tf_reverse(sd, n, ins):
+    axes = [int(a) for a in np.atleast_1d(np.asarray(ins[1].get_arr()))]
+    return sd.op("reverse", ins[0], axes=axes, name=n.name)
+
+
+def _static_value(var, what):
+    """Const value of an edge, falling back to import-time evaluation of
+    a placeholder-free subgraph (Shape-derived integer math)."""
+    try:
+        return np.asarray(var.get_arr())
+    except ValueError:
+        try:
+            return np.asarray(var.eval({}))
+        except Exception as e:
+            raise UnmappedTFOpException(
+                f"{what}: input '{var.name}' is not statically "
+                "resolvable at import time") from e
+
+
+@R("Range")
+def _tf_range(sd, n, ins):
+    from tensorflow.python.framework import dtypes
+    start = _static_value(ins[0], f"Range '{n.name}'").item()
+    limit = _static_value(ins[1], f"Range '{n.name}'").item()
+    delta = _static_value(ins[2], f"Range '{n.name}'").item()
+    dt = np.dtype(dtypes.as_dtype(n.attr["Tidx"].type).as_numpy_dtype) \
+        if n.attr["Tidx"].type else np.dtype("float32")
+    return sd.constant(n.name, np.arange(start, limit, delta, dtype=dt))
+
+
+@R("MirrorPad")
+def _tf_mirror_pad(sd, n, ins):
+    paddings = np.asarray(ins[1].get_arr()).tolist()
+    mode = n.attr["mode"].s.decode() or "REFLECT"
+    return sd.op("mirror_pad", ins[0], paddings=paddings, mode=mode,
+                 name=n.name)
+
+
+@R("Einsum")
+def _tf_einsum(sd, n, ins):
+    eq = n.attr["equation"].s.decode()
+    return sd.op("einsum", *ins, equation=eq, name=n.name)
+
+
+def _check_resize_attrs(n, what):
+    """jax.image.resize samples at half-pixel centers (the TF2
+    tf.image.resize convention).  TF1-legacy graphs carry
+    align_corners=True or half_pixel_centers=False — both sample
+    DIFFERENT source pixels, so importing them silently mismatches the
+    source model; reject with a diagnostic instead."""
+    if n.attr["align_corners"].b:
+        raise UnmappedTFOpException(
+            f"{what} '{n.name}': align_corners=True (TF1 legacy sampling) "
+            "is not supported — re-export with TF2 tf.image.resize")
+    if "half_pixel_centers" in n.attr and not n.attr[
+            "half_pixel_centers"].b:
+        raise UnmappedTFOpException(
+            f"{what} '{n.name}': half_pixel_centers=False (TF1 legacy "
+            "sampling) is not supported — re-export with TF2 "
+            "tf.image.resize")
+
+
+@R("ResizeBilinear")
+def _tf_resize_bilinear(sd, n, ins):
+    size = [int(s) for s in np.asarray(ins[1].get_arr())]
+    _check_resize_attrs(n, "ResizeBilinear")
+    return sd.op("resize_bilinear", ins[0], size=size, name=n.name)
+
+
+@R("ResizeNearestNeighbor")
+def _tf_resize_nearest(sd, n, ins):
+    size = [int(s) for s in np.asarray(ins[1].get_arr())]
+    _check_resize_attrs(n, "ResizeNearestNeighbor")
+    return sd.op("resize_nearest", ins[0], size=size, name=n.name)
+
+
 def _fdef_edge_base(inp: str) -> str:
     """FunctionDef edges are `arg`, `node:out_name:idx`, or `node:idx` —
     the producing node is always the first component."""
@@ -451,12 +655,107 @@ def _eval_node(sd, node, produced, lookup, library):
         out = sd.cond(ins[0], _make_branch_fn(then_f, library),
                       _make_branch_fn(else_f, library),
                       *ins[1:], name=node.name)
+    elif node.op in ("Case", "StatelessCase"):
+        # N-way tf.case / tf.switch_case: branch_index input selects one
+        # of the `branches` functions; TF's contract routes out-of-range
+        # indices to the LAST branch.  Lowered as a chain of nested 2-way
+        # conds — each level tests `idx == i`, the innermost level is the
+        # default — with the index threaded through as a leading operand
+        # so inner scopes can test it.
+        branch_fns = [_make_branch_fn(library[f.name], library)
+                      for f in node.attr["branches"].list.func]
+        idx, operands = ins[0], list(ins[1:])
+        if len(branch_fns) == 1:
+            raise UnmappedTFOpException(
+                f"Case '{node.name}' with a single branch — expected the "
+                "grappler to fold this; re-freeze the graph")
+
+        def _level(i):
+            if i == len(branch_fns) - 1:
+                def default(scope, idx_v, *args, _f=branch_fns[i]):
+                    return _f(scope, *args)
+                return default
+
+            def level(scope, idx_v, *args, _i=i):
+                pred = scope.op(
+                    "eq", idx_v,
+                    scope.constant(f"__case_idx_{_i}", np.int32(_i)))
+
+                def taken(s2, _j, *a, _f=branch_fns[_i]):
+                    return _f(s2, *a)
+
+                return scope.cond(pred, taken, _level(_i + 1), idx_v,
+                                  *args)
+            return level
+
+        pred0 = sd.op("eq", idx,
+                      sd.constant(f"{node.name}__idx0", np.int32(0)))
+
+        def _taken0(scope, _j, *args, _f=branch_fns[0]):
+            return _f(scope, *args)
+
+        out = sd.cond(pred0, _taken0, _level(1), idx, *operands,
+                      name=node.name)
     else:
         out = TFImportRegistry.get(node.op)(sd, node, ins)
     outs = out if isinstance(out, tuple) else (out,)
     produced[node.name] = outs[0]
     for i, v in enumerate(outs):
         produced[f"{node.name}:{i}"] = v
+
+
+def _frame_cond_merge(scope, node, by_name, loop_switch_names, llookup,
+                      cache):
+    """where-select for a tf.cond Merge lowered INSIDE a while frame
+    (both branches are computable in the pure deframed body, mirroring
+    the frameless cond collapse)."""
+    ins = [i for i in node.input if not i.startswith("^")]
+    base = node.name
+    if len(ins) == 1:
+        v = llookup(ins[0])
+        cache[base] = v
+        cache[f"{base}:0"] = v
+        return
+    if len(ins) != 2:
+        raise UnmappedTFOpException(
+            f"Merge '{base}': {len(ins)}-way cond inside a while frame "
+            "is unsupported (only 2-way tf.cond nests in loops)")
+
+    def controlling(edge):
+        seen = set()
+        stack = [(edge.lstrip("^"), 0)]
+        while stack:
+            e, depth = stack.pop()
+            b, _, idx = e.partition(":")
+            nd = by_name.get(b)
+            if nd is None or (b, depth) in seen:
+                continue
+            seen.add((b, depth))
+            if nd.op == "Switch":
+                if b in loop_switch_names:
+                    continue        # loop-var gate, not this cond's
+                if depth == 0:
+                    return b, idx == "1"
+                stack.append((nd.input[0].lstrip("^"), depth - 1))
+                continue
+            d2 = depth + 1 if nd.op == "Merge" else depth
+            stack.extend((i.lstrip("^"), d2) for i in nd.input
+                         if not i.startswith("^"))
+        raise UnmappedTFOpException(
+            f"Merge input '{edge}' has no controlling Switch in frame")
+
+    try:
+        sw, first_true = controlling(ins[0])
+    except UnmappedTFOpException:
+        sw, other_true = controlling(ins[1])
+        first_true = not other_true
+    pred = llookup(by_name[sw].input[1])
+    tv = llookup(ins[0] if first_true else ins[1])
+    fv = llookup(ins[1] if first_true else ins[0])
+    v = scope.op("where", pred, tv, fv)
+    cache[base] = v
+    cache[f"{base}:0"] = v
+    cache[f"{base}:1"] = scope.op("where", pred, np.int32(1), np.int32(0))
 
 
 def _import_v1_while_frame(sd, frame_nodes, produced, lookup, library,
@@ -470,7 +769,21 @@ def _import_v1_while_frame(sd, frame_nodes, produced, lookup, library,
     Supports single (non-nested) frames — the shape real frozen TF1
     graphs carry."""
     by_name = {n.name: n for n in frame_nodes}
-    merges = [n for n in frame_nodes if n.op == "Merge"]
+    all_merges = [n for n in frame_nodes if n.op == "Merge"]
+    # Loop-STATE merges join an Enter with a NextIteration; any other
+    # Merge inside the frame belongs to a tf.cond lowered INSIDE the loop
+    # body (functional while_loop bodies containing tf.cond freeze to
+    # exactly this shape) and is handled as a where-select in lazy_eval.
+    merges = []
+    cond_merge_names = set()
+    for m in all_merges:
+        kinds = {by_name[_fdef_edge_base(i)].op for i in m.input
+                 if not i.startswith("^")
+                 and _fdef_edge_base(i) in by_name}
+        if kinds & {"Enter", "NextIteration"}:
+            merges.append(m)
+        else:
+            cond_merge_names.add(m.name)
     loopconds = [n for n in frame_nodes if n.op == "LoopCond"]
     if len(loopconds) != 1:
         raise UnmappedTFOpException(
@@ -488,11 +801,12 @@ def _import_v1_while_frame(sd, frame_nodes, produced, lookup, library,
                 merge_enter[m.name] = enters[b]
             else:
                 merge_next[m.name] = b            # NextIteration node name
+    loop_merge_names = {m.name for m in merges}
     switches = {}                                  # merge name -> Switch node
     for n in frame_nodes:
         if n.op == "Switch":
             b = _fdef_edge_base(n.input[0])
-            if b in [m.name for m in merges]:
+            if b in loop_merge_names:
                 switches[b] = n
     # invariant enters = those not feeding a merge
     merged_enter_names = {e.name for e in merge_enter.values()}
@@ -529,14 +843,28 @@ def _import_v1_while_frame(sd, frame_nodes, produced, lookup, library,
             raise UnmappedTFOpException(
                 f"while frame: edge '{edge}' leaves the frame (closure over "
                 "outer graph values is unsupported — freeze them as Const)")
+        def llookup(inp):
+            return lazy_eval(scope, args, argmap, inp, cache)
+
+        if node.op == "Switch" and base not in {
+                s.name for s in switches.values()}:
+            # body-internal tf.cond Switch: both branches are computed in
+            # the pure deframed body; the Switch passes its data through
+            v = llookup(node.input[0])
+            cache[base] = v
+            cache[f"{base}:0"] = v
+            cache[f"{base}:1"] = v
+            return cache[edge]
+        if node.op == "Merge" and base in cond_merge_names:
+            _frame_cond_merge(scope, node, by_name,
+                              {s.name for s in switches.values()},
+                              llookup, cache)
+            return cache[edge]
         if node.op in ("Merge", "Switch", "Enter", "NextIteration", "Exit",
                        "LoopCond"):
             raise UnmappedTFOpException(
                 f"while frame: unexpected {node.op} at '{edge}'")
         local = {}
-
-        def llookup(inp):
-            return lazy_eval(scope, args, argmap, inp, cache)
 
         _eval_node(scope, node, local, llookup, library)
         cache.update(local)
@@ -686,9 +1014,12 @@ def import_graph_def(graph_def, input_names: List[str] = None) -> SameDiff:
             if node is None or (base, depth) in seen:
                 continue
             seen.add((base, depth))
-            if node.op == "Switch":
+            if node.op in ("Switch", "_SwitchN"):
                 if depth == 0:
-                    return base, idx == "1"
+                    # returns (name, taken output port, op kind): for
+                    # Switch port 1 is the true branch; for _SwitchN the
+                    # port IS the branch index (tf.switch_case lowering)
+                    return base, int(idx or 0), node.op
                 stack.append((node.input[0].lstrip("^"), depth - 1))
                 continue
             d2 = depth + 1 if node.op == "Merge" else depth
@@ -703,11 +1034,13 @@ def import_graph_def(graph_def, input_names: List[str] = None) -> SameDiff:
         computable), Merge selects by the Switch predicate.  The
         reference interprets these per-frame in AbstractSession; here
         they collapse into one `where` select."""
-        if node.op == "Switch":
+        if node.op in ("Switch", "_SwitchN"):
             data = lookup(node.input[0])
+            n_ports = (2 if node.op == "Switch"
+                       else int(node.attr["num_outs"].i))
             produced[node.name] = data
-            produced[f"{node.name}:0"] = data
-            produced[f"{node.name}:1"] = data
+            for i in range(n_ports):
+                produced[f"{node.name}:{i}"] = data
             return
         ins = [i for i in node.input if not i.startswith("^")]
         if len(ins) == 1:                # grappler-pruned: pass-through
@@ -715,31 +1048,79 @@ def import_graph_def(graph_def, input_names: List[str] = None) -> SameDiff:
             produced[node.name] = out
             produced[f"{node.name}:0"] = out
             return
-        if len(ins) != 2:
+        # Which gate feeds each input?  A constant branch is gated only by
+        # CONTROL edges (no data path to the Switch) — its walk fails and
+        # its port is inferred from the others.
+        controls = []
+        for e in ins:
+            try:
+                controls.append(controlling_switch(e))
+            except UnmappedTFOpException:
+                controls.append(None)
+        known = [c for c in controls if c is not None]
+        if not known:
             raise UnmappedTFOpException(
-                f"Merge '{node.name}' has {len(ins)} data inputs — only "
-                "2-way conds are supported (N-way tf.case lowering is "
-                "unmapped)")
-        # A constant branch is gated only by CONTROL edges (no data path
-        # to the Switch) — fall back to the other input's walk with the
-        # branch sense flipped.
-        try:
-            sw_name, first_is_true = controlling_switch(ins[0])
-        except UnmappedTFOpException:
-            sw_name, other_is_true = controlling_switch(ins[1])
-            first_is_true = not other_is_true
-        pred = lookup(node_by_name[sw_name].input[1])
-        tv = lookup(ins[0] if first_is_true else ins[1])
-        fv = lookup(ins[1] if first_is_true else ins[0])
-        out = sd.op("where", pred, tv, fv, name=node.name)
+                f"Merge '{node.name}': no input has a controlling Switch")
+        n_way = (len(ins) > 2
+                 or any(c[2] == "_SwitchN" for c in known))
+        if not n_way:
+            if controls[0] is not None:
+                sw_name, port, _ = controls[0]
+                first_is_true = port == 1
+            else:
+                sw_name, port, _ = controls[1]
+                first_is_true = not (port == 1)
+            pred = lookup(node_by_name[sw_name].input[1])
+            tv = lookup(ins[0] if first_is_true else ins[1])
+            fv = lookup(ins[1] if first_is_true else ins[0])
+            out = sd.op("where", pred, tv, fv, name=node.name)
+            produced[node.name] = out
+            produced[f"{node.name}:0"] = out
+            # Merge's second output is the taken-branch index
+            produced[f"{node.name}:1"] = sd.op(
+                "where", pred,
+                sd.constant(f"{node.name}__one", np.int32(1)),
+                sd.constant(f"{node.name}__zero", np.int32(0)),
+                name=f"{node.name}__value_index")
+            return
+        # N-way tf.case / tf.switch_case (v1 lowering: one _SwitchN feeds
+        # this Merge, input k through port k; TF routes out-of-range
+        # indices to the LAST branch, so it is the chain's default).
+        sw_name = known[0][0]
+        sw_node = node_by_name[sw_name]
+        if sw_node.op != "_SwitchN":
+            raise UnmappedTFOpException(
+                f"Merge '{node.name}': {len(ins)} data inputs but the "
+                f"controlling gate '{sw_name}' is a 2-way Switch")
+        idx_var = lookup(sw_node.input[1])
+        ports = {}
+        missing = []
+        for e, c in zip(ins, controls):
+            if c is None:
+                missing.append(e)
+            else:
+                ports[c[1]] = e
+        free = set(range(len(ins))) - set(ports)
+        if len(missing) > 1 or len(free) != len(missing):
+            raise UnmappedTFOpException(
+                f"Merge '{node.name}': cannot assign branch ports "
+                f"(ungated inputs {missing}, free ports {sorted(free)})")
+        if missing:
+            ports[free.pop()] = missing[0]
+        n = len(ins)
+        out = lookup(ports[n - 1])
+        taken = sd.constant(f"{node.name}__p{n - 1}", np.int32(n - 1))
+        for k in range(n - 2, -1, -1):
+            pk = sd.op("eq", idx_var,
+                       sd.constant(f"{node.name}__k{k}", np.int32(k)))
+            out = sd.op("where", pk, lookup(ports[k]), out,
+                        name=node.name if k == 0 else None)
+            taken = sd.op("where", pk,
+                          sd.constant(f"{node.name}__t{k}", np.int32(k)),
+                          taken)
         produced[node.name] = out
         produced[f"{node.name}:0"] = out
-        # Merge's second output is the taken-branch index
-        produced[f"{node.name}:1"] = sd.op(
-            "where", pred,
-            sd.constant(f"{node.name}__one", np.int32(1)),
-            sd.constant(f"{node.name}__zero", np.int32(0)),
-            name=f"{node.name}__value_index")
+        produced[f"{node.name}:1"] = taken
 
     ready = [k for k, d in items.items() if not d]
     dependents = {}
@@ -753,7 +1134,7 @@ def import_graph_def(graph_def, input_names: List[str] = None) -> SameDiff:
         n_done += 1
         if kind == "node":
             node = node_by_name[name]
-            if node.op in ("Switch", "Merge"):
+            if node.op in ("Switch", "_SwitchN", "Merge"):
                 eval_frameless_cond_node(node)
             else:
                 _eval_node(sd, node, produced, lookup, library)

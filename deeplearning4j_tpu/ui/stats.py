@@ -31,6 +31,9 @@ class InMemoryStatsStorage:
         self.param_norms: Dict[str, List[tuple]] = {}
         self.update_norms: Dict[str, List[tuple]] = {}
         self.ratios: Dict[str, List[tuple]] = {}     # update:param ratio
+        #: kind ('param'|'update') -> layer -> [(iter, lo, hi, counts)]
+        self.histograms: Dict[str, Dict[str, List[tuple]]] = {}
+        self.system: List[tuple] = []                # (iter, metrics dict)
         self.meta: Dict[str, object] = {}
 
     def put_score(self, iteration: int, score: float):
@@ -43,11 +46,24 @@ class InMemoryStatsStorage:
         ratio = u_norm / p_norm if p_norm > 0 else float("nan")
         self.ratios.setdefault(layer, []).append((iteration, ratio))
 
+    def put_histogram(self, iteration: int, kind: str, layer: str,
+                      lo: float, hi: float, counts: List[int]):
+        """Reference StatsListener histogram series (params / updates)."""
+        self.histograms.setdefault(kind, {}).setdefault(layer, []).append(
+            (iteration, lo, hi, list(counts)))
+
+    def put_system(self, iteration: int, metrics: Dict[str, float]):
+        """Reference system/memory stats (JVM+off-heap there; host RSS,
+        host free, XLA device memory here)."""
+        self.system.append((iteration, dict(metrics)))
+
     def to_json(self) -> str:
         return json.dumps({"score": self.score,
                            "param_norms": self.param_norms,
                            "update_norms": self.update_norms,
-                           "ratios": self.ratios, "meta": self.meta})
+                           "ratios": self.ratios,
+                           "histograms": self.histograms,
+                           "system": self.system, "meta": self.meta})
 
 
 class FileStatsStorage(InMemoryStatsStorage):
@@ -70,6 +86,19 @@ class FileStatsStorage(InMemoryStatsStorage):
                                   "p": p_norm, "u": u_norm}) + "\n")
         self._f.flush()
 
+    def put_histogram(self, iteration, kind, layer, lo, hi, counts):
+        super().put_histogram(iteration, kind, layer, lo, hi, counts)
+        self._f.write(json.dumps({"t": "hist", "i": iteration, "k": kind,
+                                  "l": layer, "lo": lo, "hi": hi,
+                                  "c": list(counts)}) + "\n")
+        self._f.flush()
+
+    def put_system(self, iteration, metrics):
+        super().put_system(iteration, metrics)
+        self._f.write(json.dumps({"t": "sys", "i": iteration,
+                                  "m": metrics}) + "\n")
+        self._f.flush()
+
     def close(self):
         self._f.close()
 
@@ -84,6 +113,11 @@ class FileStatsStorage(InMemoryStatsStorage):
                     continue     # torn tail from a concurrent writer
                 if d["t"] == "score":
                     st.put_score(d["i"], d["v"])
+                elif d["t"] == "hist":
+                    st.put_histogram(d["i"], d["k"], d["l"], d["lo"],
+                                     d["hi"], d["c"])
+                elif d["t"] == "sys":
+                    st.put_system(d["i"], d["m"])
                 else:
                     st.put_layer(d["i"], d["l"], d["p"], d["u"])
         return st
@@ -93,13 +127,74 @@ class StatsListener(TrainingListener):
     """Collects score + per-layer param/update L2 norms every `frequency`
     iterations.  Update norms come from param deltas between collections
     (captures the applied update incl. lr — what the reference's ratio
-    chart actually plots)."""
+    chart actually plots).
+
+    With `histograms=True` also collects per-layer parameter and update
+    value histograms (reference StatsListener's histogram charts;
+    gradients post-step live in donated buffers, so the applied update is
+    the collected surface, as with the norms).  With
+    `system_metrics=True` collects host RSS / host free memory / XLA
+    device memory per collection (reference system-info charts)."""
 
     def __init__(self, storage: InMemoryStatsStorage,
-                 frequency: int = 10):
+                 frequency: int = 10, histograms: bool = False,
+                 hist_bins: int = 40, system_metrics: bool = False):
         self.storage = storage
         self.frequency = max(1, frequency)
+        self.histograms = histograms
+        self.hist_bins = hist_bins
+        self.system_metrics = system_metrics
         self._prev_params = None
+
+    @staticmethod
+    def _flat(sub) -> Optional[np.ndarray]:
+        leaves = jax.tree_util.tree_leaves(sub)
+        if not leaves:
+            return None
+        return np.concatenate(
+            [np.asarray(l, np.float32).reshape(-1) for l in leaves])
+
+    def _collect_hist(self, iteration: int, kind: str, tree):
+        for layer, sub in tree.items():
+            v = self._flat(sub)
+            if v is None or not v.size:
+                continue
+            lo, hi = float(v.min()), float(v.max())
+            if lo == hi:
+                hi = lo + 1e-12
+            counts, _ = np.histogram(v, bins=self.hist_bins,
+                                     range=(lo, hi))
+            self.storage.put_histogram(iteration, kind, layer, lo, hi,
+                                       counts.tolist())
+
+    @staticmethod
+    def _system_snapshot() -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        try:
+            import resource
+            out["host_rss_mb"] = resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss / 1024.0
+        except Exception:           # pragma: no cover - posix-only
+            pass
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemAvailable:"):
+                        out["host_available_mb"] = (
+                            float(line.split()[1]) / 1024.0)
+                        break
+        except OSError:             # pragma: no cover - linux-only
+            pass
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            if stats:
+                out["device_in_use_mb"] = (
+                    stats.get("bytes_in_use", 0) / 1e6)
+                if "bytes_limit" in stats:
+                    out["device_limit_mb"] = stats["bytes_limit"] / 1e6
+        except Exception:           # CPU backends may expose no stats
+            pass
+        return out
 
     @staticmethod
     def _norms(tree) -> Dict[str, float]:
@@ -119,6 +214,10 @@ class StatsListener(TrainingListener):
         self.storage.put_score(iteration, model.score())
         params = model.params_
         p_norms = self._norms(params)
+        if self.histograms:
+            self._collect_hist(iteration, "param", params)
+        if self.system_metrics:
+            self.storage.put_system(iteration, self._system_snapshot())
         if self._prev_params is not None:
             diff = jax.tree_util.tree_map(
                 lambda a, b: a.astype(jnp.float32) - b.astype(jnp.float32),
@@ -127,6 +226,8 @@ class StatsListener(TrainingListener):
             for layer, pn in p_norms.items():
                 self.storage.put_layer(iteration, layer, pn,
                                        u_norms.get(layer, 0.0))
+            if self.histograms:
+                self._collect_hist(iteration, "update", diff)
         # deep-copy on device: the compiled step DONATES param buffers, so
         # holding a bare reference would be use-after-donation next step
         self._prev_params = jax.tree_util.tree_map(lambda a: a.copy(),
@@ -160,6 +261,23 @@ def _svg_polyline(series: List[tuple], width=640, height=180,
             f'points="{pts}"/></svg>')
 
 
+def _svg_bars(counts: List[int], width=640, height=120,
+              color="#2a6fdb") -> str:
+    if not counts:
+        return "<svg></svg>"
+    peak = max(max(counts), 1)
+    bw = width / len(counts)
+    bars = "".join(
+        f'<rect x="{i * bw:.1f}" '
+        f'y="{height - c / peak * height:.1f}" '
+        f'width="{max(bw - 1, 1):.1f}" '
+        f'height="{c / peak * height:.1f}" fill="{color}"/>'
+        for i, c in enumerate(counts))
+    return (f'<svg width="{width}" height="{height}" '
+            f'style="background:#fafafa;border:1px solid #ddd">'
+            f'{bars}</svg>')
+
+
 def render_html(storage: InMemoryStatsStorage, path: Optional[str] = None
                 ) -> str:
     """Static dashboard: score curve + update:param ratio per layer (log10;
@@ -186,6 +304,26 @@ def render_html(storage: InMemoryStatsStorage, path: Optional[str] = None
         parts.append(f"<h4>{layer}</h4>")
         parts.append(_svg_polyline(series, height=80,
                                    color=colors[i % len(colors)]))
+    # histograms: latest per layer/kind (reference StatsListener histogram
+    # charts for parameters and updates)
+    for kind in sorted(storage.histograms):
+        parts.append(f"<h2>{kind.capitalize()} histograms (latest)</h2>")
+        for i, (layer, series) in enumerate(
+                sorted(storage.histograms[kind].items())):
+            it, lo, hi, counts = series[-1]
+            parts.append(f"<h4>{layer} — iter {it} "
+                         f"[{lo:.3g}, {hi:.3g}]</h4>")
+            parts.append(_svg_bars(counts,
+                                   color=colors[i % len(colors)]))
+    if storage.system:
+        parts.append("<h2>System metrics</h2>")
+        keys = sorted({k for _, m in storage.system for k in m})
+        for i, key in enumerate(keys):
+            series = [(it, m[key]) for it, m in storage.system
+                      if key in m]
+            parts.append(f"<h4>{key}</h4>")
+            parts.append(_svg_polyline(series, height=80,
+                                       color=colors[i % len(colors)]))
     parts.append("</body></html>")
     html = "\n".join(parts)
     if path:

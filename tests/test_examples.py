@@ -1,0 +1,31 @@
+"""Smoke-run every example script (reference: dl4j-examples are built in
+CI; VERDICT r3 #7 — `keras_import_and_serving.py` exercises the longest
+dependency chain in the repo and must not rot silently).
+
+Each example self-bootstraps onto CPU and is documented to finish in
+under a minute; a nonzero exit fails with the script's tail."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), "..", "examples")
+SCRIPTS = sorted(f for f in os.listdir(EXAMPLES_DIR) if f.endswith(".py"))
+
+
+def test_every_example_is_covered():
+    assert len(SCRIPTS) >= 10, SCRIPTS
+
+
+@pytest.mark.parametrize("script", SCRIPTS)
+def test_example_runs(script):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)     # examples choose their own mesh size
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES_DIR, script)],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=os.path.join(EXAMPLES_DIR, ".."))
+    assert proc.returncode == 0, (
+        f"{script} failed (rc={proc.returncode}):\n"
+        f"{(proc.stdout + proc.stderr)[-3000:]}")

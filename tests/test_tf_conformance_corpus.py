@@ -1,0 +1,433 @@
+"""TF-graph conformance corpus.
+
+Reference: `platform-tests/.../TFGraphTestAllSameDiff.java` + the
+`tf_graphs/` golden corpus (SURVEY.md §4) — there the goldens are stored
+protobufs; here TF is installed, so every graph is AUTHORED in this file,
+frozen to a GraphDef, imported through `modelimport.import_graph_def`,
+and executed against TF itself.  Coverage targets per-op singletons, the
+quirky surfaces (StridedSlice masks, FusedBatchNorm variants, conv1d's
+expand/squeeze lowering, MirrorPad modes), and control-flow nests
+(functional While/If, N-way Case, while+cond nesting).
+"""
+import numpy as np
+import pytest
+
+import tensorflow as tf
+from tensorflow.python.framework.convert_to_constants import (
+    convert_variables_to_constants_v2)
+
+from deeplearning4j_tpu.modelimport import import_graph_def
+
+rs = np.random.RandomState(42)
+
+
+def F(*shape, lo=-2.0, hi=2.0):
+    return rs.uniform(lo, hi, shape).astype(np.float32)
+
+
+def spec(*shape, dtype=tf.float32, name="x"):
+    return tf.TensorSpec(shape, dtype, name=name)
+
+
+CORPUS = []
+
+
+def case(name, specs, inputs, tol=1e-5):
+    def deco(fn):
+        CORPUS.append((name, fn, tuple(specs), tuple(inputs), tol))
+        return fn
+    return deco
+
+
+# ---- elementwise / broadcast ----
+
+@case("unary-chain", [spec(3, 4)], [F(3, 4, lo=0.1, hi=2.0)])
+def _g(x):
+    return tf.sqrt(tf.exp(tf.math.log(x) * 0.5) + tf.math.rsqrt(x))
+
+
+@case("binary-broadcast", [spec(3, 1, name="x"), spec(1, 4, name="y")],
+      [F(3, 1), F(1, 4)])
+def _g(x, y):
+    return (x + y) * (x - y) / (tf.abs(y) + 1.0)
+
+
+@case("int-arith", [spec(5, dtype=tf.int32)],
+      [rs.randint(1, 20, 5).astype(np.int32)])
+def _g(x):
+    return x // 3 + tf.math.floormod(x, 4) - tf.math.minimum(x, 7)
+
+
+@case("pow-sqdiff-clip", [spec(3, 4)], [F(3, 4, lo=0.2, hi=2.0)])
+def _g(x):
+    return tf.clip_by_value(
+        tf.pow(x, 2.0) + tf.math.squared_difference(x, 1.0), 0.1, 5.0)
+
+
+@case("activations", [spec(4, 6)], [F(4, 6)])
+def _g(x):
+    return (tf.nn.relu(x) + tf.nn.relu6(x * 3.0) + tf.nn.elu(x)
+            + tf.nn.selu(x) + tf.nn.softplus(x) + tf.nn.softsign(x)
+            + tf.nn.leaky_relu(x, alpha=0.3) + tf.sigmoid(x)
+            + tf.tanh(x) + tf.math.erf(x))
+
+
+@case("softmax-family", [spec(4, 7)], [F(4, 7)])
+def _g(x):
+    return tf.nn.softmax(x) + tf.exp(tf.nn.log_softmax(x, axis=-1))
+
+
+# ---- linalg ----
+
+@case("matmul-biasadd", [spec(4, 5)], [F(4, 5)])
+def _g(x):
+    w = tf.constant(rs.randn(5, 3).astype(np.float32))
+    b = tf.constant(rs.randn(3).astype(np.float32))
+    return tf.nn.bias_add(tf.matmul(x, w), b)
+
+
+@case("batch-matmul-adj", [spec(2, 3, 4)], [F(2, 3, 4)])
+def _g(x):
+    y = tf.constant(rs.randn(2, 3, 4).astype(np.float32))
+    return tf.linalg.matmul(x, y, adjoint_b=True)
+
+
+@case("einsum", [spec(3, 4)], [F(3, 4)])
+def _g(x):
+    w = tf.constant(rs.randn(4, 5).astype(np.float32))
+    return tf.einsum("ij,jk->ik", x, w)
+
+
+@case("l2-normalize-pattern", [spec(4, 6)], [F(4, 6)])
+def _g(x):
+    # rsqrt(sum(square)) — the hand-rolled layer-norm/l2norm surface
+    return x * tf.math.rsqrt(
+        tf.reduce_sum(tf.square(x), axis=-1, keepdims=True) + 1e-6)
+
+
+# ---- reductions / scans ----
+
+@case("reduce-variants", [spec(3, 4, 5)], [F(3, 4, 5)])
+def _g(x):
+    return (tf.reduce_sum(x, axis=-1)
+            + tf.reduce_mean(x, axis=[0, 2], keepdims=False)[None, :],
+            tf.reduce_max(x, axis=1) * 0.1
+            + tf.reduce_min(x, axis=1) * 0.1)
+
+
+@case("reduce-prod-keepdims", [spec(3, 4)], [F(3, 4, lo=0.5, hi=1.5)])
+def _g(x):
+    return tf.reduce_prod(x, axis=1, keepdims=True) * x
+
+
+@case("argmax-cast", [spec(4, 6)], [F(4, 6)])
+def _g(x):
+    return (tf.cast(tf.argmax(x, axis=-1), tf.float32)
+            - tf.cast(tf.argmin(x, axis=0), tf.float32)[None, :3]
+            [:, 0:1] * 0.0)
+
+
+@case("cumsum-exclusive-reverse", [spec(3, 6)], [F(3, 6)])
+def _g(x):
+    return (tf.cumsum(x, axis=1, exclusive=True)
+            + tf.cumsum(x, axis=1, reverse=True))
+
+
+@case("top-k-values", [spec(3, 8)], [F(3, 8)])
+def _g(x):
+    vals, idx = tf.math.top_k(x, k=3)
+    return vals + tf.cast(idx, tf.float32) * 0.01
+
+
+# ---- shape / slicing quirks ----
+
+@case("strided-slice-masks", [spec(4, 5, 6)], [F(4, 5, 6)])
+def _g(x):
+    a = x[1:3, :, ::2]              # begin/end + stride
+    b = x[:, 2, :]                  # shrink_axis
+    c = x[..., 1]                   # ellipsis + shrink
+    d = x[:, tf.newaxis, 0, :]      # new_axis + shrink
+    return (tf.reduce_sum(a) + tf.reduce_sum(b) + tf.reduce_sum(c)
+            + tf.reduce_sum(d) + a[0, 0, 0])
+
+
+@case("neg-stride-slice", [spec(4, 6)], [F(4, 6)])
+def _g(x):
+    return x[::-1, ::-2]
+
+
+@case("pad-modes", [spec(3, 4)], [F(3, 4)])
+def _g(x):
+    p = [[1, 1], [2, 0]]
+    return (tf.pad(x, p) + tf.pad(x, p, mode="REFLECT")
+            + tf.pad(x, p, mode="SYMMETRIC"))
+
+
+@case("tile-expand-squeeze", [spec(3, 4)], [F(3, 4)])
+def _g(x):
+    return tf.squeeze(tf.tile(tf.expand_dims(x, 1), [1, 2, 1]),
+                      axis=None) [:, 0, :]
+
+
+@case("transpose-reshape", [spec(2, 3, 4)], [F(2, 3, 4)])
+def _g(x):
+    return tf.reshape(tf.transpose(x, [2, 0, 1]), [4, -1])
+
+
+@case("concat-split-stack", [spec(4, 6)], [F(4, 6)])
+def _g(x):
+    a, b, c = tf.split(x, 3, axis=1)
+    s = tf.stack([a, b, c], axis=0)
+    u = tf.unstack(s, axis=0)
+    return tf.concat(u, axis=1) + x
+
+
+@case("gather-axis", [spec(5, 4)], [F(5, 4)])
+def _g(x):
+    idx = tf.constant([3, 0, 1])
+    return tf.gather(x, idx, axis=0), tf.gather(x, [1, 2], axis=1)
+
+
+@case("gather-nd", [spec(4, 5)], [F(4, 5)])
+def _g(x):
+    return tf.gather_nd(x, tf.constant([[0, 1], [3, 2], [2, 4]]))
+
+
+@case("one-hot-depth", [spec(6, dtype=tf.int32)],
+      [rs.randint(0, 5, 6).astype(np.int32)])
+def _g(x):
+    return tf.one_hot(x, 5, on_value=2.0, off_value=-1.0)
+
+
+@case("cast-chain", [spec(3, 4)], [F(3, 4, lo=-3, hi=3)])
+def _g(x):
+    return tf.cast(tf.cast(tf.cast(x, tf.int32), tf.bool), tf.float32)
+
+
+@case("where-select", [spec(3, 4)], [F(3, 4)])
+def _g(x):
+    return tf.where(x > 0.0, x * 2.0, x - 1.0)
+
+
+@case("shape-driven-reshape", [spec(3, 8)], [F(3, 8)])
+def _g(x):
+    s = tf.shape(x)
+    return tf.reshape(x, [s[0] * 2, s[1] // 2])
+
+
+@case("fill-zeros-ones", [spec(3, 4)], [F(3, 4)])
+def _g(x):
+    return (x + tf.zeros_like(x) + tf.ones_like(x)
+            + tf.fill([3, 4], 0.5) + tf.range(4.0)[None, :])
+
+
+@case("reverse-axis", [spec(3, 4)], [F(3, 4)])
+def _g(x):
+    return tf.reverse(x, axis=[1]) + tf.reverse(x, axis=[0, 1])
+
+
+# ---- cnn surfaces ----
+
+@case("conv2d-same-valid", [spec(1, 8, 8, 3)], [F(1, 8, 8, 3)])
+def _g(x):
+    w1 = tf.constant(rs.randn(3, 3, 3, 4).astype(np.float32) * 0.2)
+    w2 = tf.constant(rs.randn(2, 2, 4, 5).astype(np.float32) * 0.2)
+    y = tf.nn.conv2d(x, w1, strides=1, padding="SAME")
+    return tf.nn.conv2d(y, w2, strides=2, padding="VALID")
+
+
+@case("depthwise-conv", [spec(1, 6, 6, 3)], [F(1, 6, 6, 3)])
+def _g(x):
+    w = tf.constant(rs.randn(3, 3, 3, 2).astype(np.float32) * 0.3)
+    return tf.nn.depthwise_conv2d(x, w, strides=[1, 1, 1, 1],
+                                  padding="SAME")
+
+
+@case("conv1d-lowering", [spec(2, 10, 3)], [F(2, 10, 3)])
+def _g(x):
+    # tf.nn.conv1d freezes into ExpandDims -> Conv2D -> Squeeze
+    w = tf.constant(rs.randn(3, 3, 5).astype(np.float32) * 0.3)
+    return tf.nn.conv1d(x, w, stride=1, padding="SAME")
+
+
+@case("pools", [spec(1, 8, 8, 2)], [F(1, 8, 8, 2)])
+def _g(x):
+    return (tf.nn.max_pool2d(x, 2, 2, "VALID")
+            + tf.nn.avg_pool2d(x, 2, 2, "VALID"))
+
+
+@case("fused-bn-v3-inference", [spec(2, 5, 5, 4)], [F(2, 5, 5, 4)])
+def _g(x):
+    scale = tf.constant(rs.rand(4).astype(np.float32) + 0.5)
+    offset = tf.constant(rs.randn(4).astype(np.float32))
+    mean = tf.constant(rs.randn(4).astype(np.float32))
+    var = tf.constant(rs.rand(4).astype(np.float32) + 0.5)
+    res = tf.raw_ops.FusedBatchNormV3(
+        x=x, scale=scale, offset=offset, mean=mean, variance=var,
+        is_training=False)
+    return tf.nn.relu(res[0])
+
+
+@case("resnet-block", [spec(1, 6, 6, 4)], [F(1, 6, 6, 4)])
+def _g(x):
+    w1 = tf.constant(rs.randn(3, 3, 4, 4).astype(np.float32) * 0.2)
+    w2 = tf.constant(rs.randn(3, 3, 4, 4).astype(np.float32) * 0.2)
+    y = tf.nn.relu(tf.nn.conv2d(x, w1, 1, "SAME"))
+    return tf.nn.relu(x + tf.nn.conv2d(y, w2, 1, "SAME"))
+
+
+@case("resize-bilinear", [spec(1, 4, 4, 2)], [F(1, 4, 4, 2)])
+def _g(x):
+    return tf.image.resize(x, [8, 8], method="bilinear")
+
+
+# ---- control flow ----
+
+@case("functional-while", [spec(3)], [F(3)])
+def _g(x):
+    i = tf.constant(0)
+
+    def cond(i, acc):
+        return i < 4
+
+    def body(i, acc):
+        return i + 1, acc * 1.5 + 0.1
+
+    _, out = tf.while_loop(cond, body, [i, x])
+    return out
+
+
+@case("functional-cond", [spec(4)], [F(4)])
+def _g(x):
+    return tf.cond(tf.reduce_sum(x) > 0.0,
+                   lambda: x * 3.0, lambda: x - 5.0)
+
+
+@case("case-3way", [spec(3), spec(dtype=tf.int32, name="i")],
+      [F(3), np.int32(1)])
+def _g(x, i):
+    return tf.switch_case(i, branch_fns=[
+        lambda: x * 10.0, lambda: x - 100.0, lambda: x * 0.0 + 7.0])
+
+
+@case("case-3way-b0", [spec(3), spec(dtype=tf.int32, name="i")],
+      [F(3), np.int32(0)])
+def _g(x, i):
+    return tf.switch_case(i, branch_fns=[
+        lambda: x * 10.0, lambda: x - 100.0, lambda: x * 0.0 + 7.0])
+
+
+@case("case-default-out-of-range",
+      [spec(3), spec(dtype=tf.int32, name="i")],
+      [F(3), np.int32(9)])
+def _g(x, i):
+    return tf.switch_case(i, branch_fns=[
+        lambda: x * 10.0, lambda: x - 100.0, lambda: x + 1.0])
+
+
+@case("while-cond-nest", [spec(3)], [F(3)])
+def _g(x):
+    def cond(i, acc):
+        return i < 3
+
+    def body(i, acc):
+        acc = tf.cond(tf.reduce_sum(acc) > 0.0,
+                      lambda: acc * 0.5, lambda: acc + 1.0)
+        return i + 1, acc
+
+    _, out = tf.while_loop(cond, body, [tf.constant(0), x])
+    return out
+
+
+# ---- misc quirks ----
+
+@case("minimum-maximum-chain", [spec(3, 4)], [F(3, 4)])
+def _g(x):
+    return tf.maximum(tf.minimum(x, 0.5), -0.5) + tf.abs(x)
+
+
+@case("log1p-expm1-sinh", [spec(3, 4)], [F(3, 4, lo=-0.9, hi=0.9)])
+def _g(x):
+    return tf.math.log1p(tf.abs(x)) + tf.math.expm1(x) + tf.sinh(x) \
+        + tf.cosh(x) + tf.atan(x)
+
+
+@case("floor-ceil-round-sign", [spec(3, 4)], [F(3, 4, lo=-3, hi=3)])
+def _g(x):
+    return (tf.floor(x) + tf.math.ceil(x) + tf.round(x) + tf.sign(x)
+            + tf.math.rint(x))
+
+
+@case("equal-logical", [spec(4, dtype=tf.int32), spec(4, dtype=tf.int32,
+                                                      name="y")],
+      [rs.randint(0, 3, 4).astype(np.int32),
+       rs.randint(0, 3, 4).astype(np.int32)])
+def _g(x, y):
+    eq = tf.equal(x, y)
+    gt = tf.greater(x, y)
+    return tf.cast(tf.logical_or(eq, tf.logical_and(gt, gt)), tf.int32)
+
+
+@case("squeeze-dims-attr", [spec(3, 1, 4, 1)], [F(3, 1, 4, 1)])
+def _g(x):
+    return tf.squeeze(x, axis=[1, 3])
+
+
+@case("mean-all-axes", [spec(2, 3, 4)], [F(2, 3, 4)])
+def _g(x):
+    return tf.reduce_mean(x) + tf.reduce_sum(x) * 0.001
+
+
+@case("flatten-shape-of-conv", [spec(2, 6, 6, 3)], [F(2, 6, 6, 3)])
+def _g(x):
+    # the ubiquitous flatten: Shape of an OP output feeding Reshape
+    w = tf.constant(rs.randn(3, 3, 3, 4).astype(np.float32) * 0.2)
+    y = tf.nn.conv2d(x, w, strides=2, padding="VALID")
+    return tf.reshape(y, [tf.shape(y)[0], -1])
+
+
+@pytest.mark.parametrize("name,fn,specs,inputs,tol", CORPUS,
+                         ids=[c[0] for c in CORPUS])
+def test_tf_graph_conformance(name, fn, specs, inputs, tol):
+    tfn = tf.function(fn)
+    frozen = convert_variables_to_constants_v2(
+        tfn.get_concrete_function(*specs))
+    gd = frozen.graph.as_graph_def()
+    sd = import_graph_def(gd)
+    feeds = {s.name: a for s, a in zip(specs, inputs)}
+    # golden from the FROZEN function: cases that bake random constants
+    # at trace time must be compared against that same trace
+    wants = frozen(*[tf.constant(a) for a in inputs])
+    if not isinstance(wants, (list, tuple)):
+        wants = [wants]
+    outs = [t.name.split(":")[0] for t in frozen.outputs]
+    for out_name, want in zip(outs, wants):
+        got = np.asarray(sd.output(feeds, out_name)[out_name])
+        np.testing.assert_allclose(got, np.asarray(want), rtol=tol,
+                                   atol=tol, err_msg=f"{name}:{out_name}")
+
+
+def test_corpus_size():
+    """The corpus must stay at TFGraphTestAllSameDiff scale."""
+    assert len(CORPUS) >= 40, len(CORPUS)
+
+
+def test_tf1_legacy_resize_rejected():
+    """TF1 sampling (half_pixel_centers=False / align_corners=True)
+    samples different source pixels than jax.image.resize — importing it
+    silently mismatches the source model, so the importer must REFUSE
+    with a diagnostic rather than produce wrong values."""
+    from deeplearning4j_tpu.modelimport.tf_import import (
+        UnmappedTFOpException)
+
+    @tf.function
+    def f(x):
+        return tf.raw_ops.ResizeBilinear(
+            images=x, size=[8, 8], align_corners=False,
+            half_pixel_centers=False)
+
+    frozen = convert_variables_to_constants_v2(
+        f.get_concrete_function(tf.TensorSpec((1, 4, 4, 2), tf.float32,
+                                              name="x")))
+    with pytest.raises(UnmappedTFOpException, match="half_pixel_centers"):
+        import_graph_def(frozen.graph.as_graph_def())

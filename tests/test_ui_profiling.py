@@ -142,3 +142,44 @@ def test_ui_server_attach_file_follows_other_process(tmp_path):
         assert "Score vs iteration" in html2
     finally:
         server.stop()
+
+
+def test_stats_listener_histograms_and_system_metrics(tmp_path):
+    """Round-4 StatsListener parity tail: per-layer param/update
+    histograms + host/device memory metrics (reference StatsListener
+    histogram + system-info chart sets), persisted through
+    FileStatsStorage and rendered into the report."""
+    path = str(tmp_path / "stats.jsonl")
+    st = FileStatsStorage(path)
+    net = _net().set_listeners(StatsListener(
+        st, frequency=1, histograms=True, hist_bins=16,
+        system_metrics=True))
+    x, y = _data()
+    for _ in range(4):
+        net.fit(x, y)
+    # histograms for both kinds, every layer, right bin count
+    assert set(st.histograms) == {"param", "update"}
+    for kind in ("param", "update"):
+        assert "layer_0" in st.histograms[kind]
+        it, lo, hi, counts = st.histograms[kind]["layer_0"][-1]
+        assert len(counts) == 16 and lo < hi
+        n_params = sum(np.asarray(p).size
+                       for p in __import__("jax").tree_util.tree_leaves(
+                           net.params_["layer_0"]))
+        assert sum(counts) == n_params
+    # system metrics include host RSS and available memory on this host
+    assert st.system
+    _, metrics = st.system[-1]
+    assert metrics["host_rss_mb"] > 10.0
+    assert metrics["host_available_mb"] > 10.0
+    # persisted lines reload into an equal storage
+    st.close()
+    loaded = FileStatsStorage.load(path)
+    for kind in ("param", "update"):
+        assert (loaded.histograms[kind]["layer_0"][-1][3]
+                == st.histograms[kind]["layer_0"][-1][3])
+    assert loaded.system[-1][1] == st.system[-1][1]
+    # the report renders histogram bars + system charts
+    html = render_html(loaded)
+    assert "histograms" in html and "System metrics" in html
+    assert "<rect" in html

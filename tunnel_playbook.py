@@ -1,0 +1,291 @@
+"""On-chip validation playbook — run THE MOMENT the axon tunnel is up.
+
+VERDICT r3 #1/#3: every perf claim since round 1 is hardware-unverified,
+and the round-3 Pallas kernels (masked flash attention fwd/bwd, fused
+LayerNorm) have never been Mosaic-compiled on a real TPU.  This script
+runs the whole validation ladder in one go and writes
+`bench_artifacts/TUNNEL_VALIDATION.json` incrementally (each stage's
+result lands as soon as it finishes, so a tunnel drop mid-run keeps
+earlier results).
+
+Stages:
+  1. resnet50 headline (bench.py config) + lenet/lstm/bert throughputs
+  2. Mosaic compile + correctness of ALL Pallas kernels vs XLA reference
+     (flash fwd, flash bwd, masked variants, causal, fused LN fwd/bwd)
+  3. flash-vs-XLA A/B at seq {1024, 2048, 4096} (where dispatch engages)
+  4. fused-LN vs XLA A/B at BERT shapes
+  5. conv-backward layout probes: donate/layout variants of the ResNet
+     train step (the 2.3 ms/step retiling-copy lever)
+
+Run: `python tunnel_playbook.py [--quick]`  (expects the axon TPU).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "bench_artifacts", "TUNNEL_VALIDATION.json")
+RESULTS = {"started": time.strftime("%Y-%m-%d %H:%M:%S"), "stages": {}}
+
+
+def record(stage, payload):
+    RESULTS["stages"][stage] = payload
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        json.dump(RESULTS, f, indent=2)
+    print(f"[playbook] {stage}: {json.dumps(payload)[:300]}", flush=True)
+
+
+def guard(stage):
+    def deco(fn):
+        def run(*a, **k):
+            try:
+                record(stage, fn(*a, **k))
+            except Exception as e:
+                record(stage, {"error": f"{type(e).__name__}: {e}"[:500]})
+        return run
+    return deco
+
+
+def timeit(f, sync, warm=3, n=10):
+    for _ in range(warm):
+        f()
+    sync()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        f()
+    sync()
+    return (time.perf_counter() - t0) / n
+
+
+@guard("1_headline")
+def stage_headline(quick):
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.train.updaters import Nesterovs
+    from deeplearning4j_tpu.zoo import ResNet50
+    batch = 64
+    net = ResNet50(n_classes=1000, input_shape=(224, 224, 3),
+                   updater=Nesterovs(0.1, 0.9),
+                   compute_dtype="bfloat16").init_model()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(batch, 224, 224, 3).astype(np.float32))
+    y = jnp.asarray(np.eye(1000, dtype=np.float32)[
+        rng.randint(0, 1000, batch)])
+    dt = timeit(lambda: net.fit(x, y), lambda: float(net.score()),
+                n=5 if quick else 20)
+    return {"resnet50_samples_per_sec": round(batch / dt, 1),
+            "ms_per_step": round(dt * 1e3, 2)}
+
+
+@guard("2_mosaic_compile")
+def stage_mosaic(quick):
+    """First-ever real-TPU compile of every Pallas kernel, checked
+    against the XLA reference path."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.attention_kernels import (
+        flash_attention_tpu, flash_attention_bwd_tpu)
+    from deeplearning4j_tpu.ops.norm_kernels import layer_norm_tpu
+
+    out = {}
+    rs = np.random.RandomState(0)
+    B, H, T, D = 2, 4, 2048, 64
+    q = jnp.asarray(rs.randn(B, H, T, D).astype(np.float32) * 0.1)
+    k = jnp.asarray(rs.randn(B, H, T, D).astype(np.float32) * 0.1)
+    v = jnp.asarray(rs.randn(B, H, T, D).astype(np.float32) * 0.1)
+    mask = jnp.asarray((rs.rand(B, T) > 0.1).astype(np.float32))
+
+    def xla_attn(q, k, v, mask=None, causal=False):
+        s = jnp.einsum("bhtd,bhsd->bhts", q, k) / np.sqrt(D)
+        if mask is not None:
+            s = jnp.where(mask[:, None, None, :] > 0, s, -1e30)
+        if causal:
+            tri = jnp.tril(jnp.ones((T, T), bool))
+            s = jnp.where(tri[None, None], s, -1e30)
+        return jnp.einsum("bhts,bhsd->bhtd", jax.nn.softmax(s, -1), v)
+
+    for name, kw in [("plain", {}), ("causal", {"causal": True}),
+                     ("masked", {"mask": mask})]:
+        got = np.asarray(flash_attention_tpu(q, k, v, **kw)[0]
+                         if isinstance(flash_attention_tpu(q, k, v, **kw),
+                                       tuple)
+                         else flash_attention_tpu(q, k, v, **kw))
+        want = np.asarray(xla_attn(q, k, v, **kw))
+        err = float(np.max(np.abs(got - want)))
+        out[f"flash_fwd_{name}_max_err"] = err
+        assert err < 2e-2, (name, err)
+
+    # bwd: compare grads of a scalar loss via the dispatcher-level op
+    from deeplearning4j_tpu.ops.attention_kernels import fused_attention
+
+    def loss_fused(q, k, v):
+        return jnp.sum(fused_attention(q, k, v, mask=mask) ** 2)
+
+    def loss_xla(q, k, v):
+        return jnp.sum(xla_attn(q, k, v, mask=mask) ** 2)
+
+    g1 = jax.grad(loss_fused)(q, k, v)
+    g2 = jax.grad(loss_xla)(q, k, v)
+    out["flash_bwd_masked_max_err"] = float(
+        jnp.max(jnp.abs(g1 - g2)))
+
+    # fused LN fwd+bwd
+    x = jnp.asarray(rs.randn(4096, 768).astype(np.float32))
+    gain = jnp.asarray(rs.rand(768).astype(np.float32) + 0.5)
+    bias = jnp.asarray(rs.randn(768).astype(np.float32))
+
+    def ln_ref(x, g, b):
+        m = jnp.mean(x, -1, keepdims=True)
+        var = jnp.var(x, -1, keepdims=True)
+        return (x - m) / jnp.sqrt(var + 1e-5) * g + b
+
+    got = np.asarray(layer_norm_tpu(x, gain, bias, 1e-5)[0])
+    want = np.asarray(ln_ref(x, gain, bias))
+    out["fused_ln_fwd_max_err"] = float(np.max(np.abs(got - want)))
+
+    from deeplearning4j_tpu.ops.norm_kernels import fused_layer_norm
+
+    def l1(x, g, b):
+        return jnp.sum(fused_layer_norm(x, g, b, 1e-5) ** 2)
+
+    def l2(x, g, b):
+        return jnp.sum(ln_ref(x, g, b) ** 2)
+
+    ga, gb = jax.grad(l1, (0, 1))(x, gain, bias), \
+        jax.grad(l2, (0, 1))(x, gain, bias)
+    out["fused_ln_bwd_max_err"] = float(max(
+        jnp.max(jnp.abs(a - b)) for a, b in zip(ga, gb)))
+    return out
+
+
+@guard("3_flash_ab")
+def stage_flash_ab(quick):
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.attention_kernels import flash_attention_tpu
+    rs = np.random.RandomState(0)
+    out = {}
+    for T in ([1024, 2048] if quick else [1024, 2048, 4096]):
+        B, H, D = 4, 12, 64
+        q = jnp.asarray(rs.randn(B, H, T, D).astype(np.float32) * 0.1)
+        k = jnp.asarray(rs.randn(B, H, T, D).astype(np.float32) * 0.1)
+        v = jnp.asarray(rs.randn(B, H, T, D).astype(np.float32) * 0.1)
+
+        flash = jax.jit(lambda q, k, v: flash_attention_tpu(q, k, v))
+
+        def xla(q, k, v):
+            s = jnp.einsum("bhtd,bhsd->bhts", q, k) / np.sqrt(D)
+            return jnp.einsum("bhts,bhsd->bhtd",
+                              jax.nn.softmax(s, -1), v)
+
+        xla_j = jax.jit(xla)
+        r = flash(q, k, v)
+        first = r[0] if isinstance(r, tuple) else r
+        jax.block_until_ready(first)
+        jax.block_until_ready(xla_j(q, k, v))
+
+        def run_flash():
+            rr = flash(q, k, v)
+            return rr[0] if isinstance(rr, tuple) else rr
+
+        tf_ = timeit(run_flash, lambda: jax.block_until_ready(
+            run_flash()), n=10)
+        tx = timeit(lambda: xla_j(q, k, v), lambda: jax.block_until_ready(
+            xla_j(q, k, v)), n=10)
+        out[f"seq{T}"] = {"flash_ms": round(tf_ * 1e3, 3),
+                          "xla_ms": round(tx * 1e3, 3),
+                          "speedup": round(tx / tf_, 3)}
+    return out
+
+
+@guard("4_ln_ab")
+def stage_ln_ab(quick):
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.norm_kernels import layer_norm_tpu
+    rs = np.random.RandomState(0)
+    out = {}
+    for rows in [8192, 65536]:
+        x = jnp.asarray(rs.randn(rows, 768).astype(np.float32))
+        g = jnp.asarray(rs.rand(768).astype(np.float32) + 0.5)
+        b = jnp.asarray(rs.randn(768).astype(np.float32))
+        fused = jax.jit(lambda x, g, b: layer_norm_tpu(x, g, b,
+                                                       1e-5)[0])
+
+        def xla(x, g, b):
+            m = jnp.mean(x, -1, keepdims=True)
+            v = jnp.var(x, -1, keepdims=True)
+            return (x - m) / jnp.sqrt(v + 1e-5) * g + b
+
+        xj = jax.jit(xla)
+        jax.block_until_ready(fused(x, g, b))
+        jax.block_until_ready(xj(x, g, b))
+        tf_ = timeit(lambda: fused(x, g, b),
+                     lambda: jax.block_until_ready(fused(x, g, b)))
+        tx = timeit(lambda: xj(x, g, b),
+                    lambda: jax.block_until_ready(xj(x, g, b)))
+        out[f"rows{rows}"] = {"fused_ms": round(tf_ * 1e3, 3),
+                              "xla_ms": round(tx * 1e3, 3),
+                              "speedup": round(tx / tf_, 3)}
+    return out
+
+
+@guard("5_conv_layout")
+def stage_conv_layout(quick):
+    """The PERF_ANALYSIS lever: measure the ResNet step with explicit
+    donation + input layouts to see how much of the 2.3 ms/step of copy
+    time layout control removes."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.train.updaters import Nesterovs
+    from deeplearning4j_tpu.zoo import ResNet50
+    batch = 64
+    net = ResNet50(n_classes=1000, input_shape=(224, 224, 3),
+                   updater=Nesterovs(0.1, 0.9),
+                   compute_dtype="bfloat16").init_model()
+    rng = np.random.RandomState(0)
+    x32 = rng.rand(batch, 224, 224, 3).astype(np.float32)
+    y32 = np.eye(1000, dtype=np.float32)[rng.randint(0, 1000, batch)]
+    out = {}
+    # (a) baseline: host f32 features each step (what bench.py times)
+    x = jnp.asarray(x32)
+    y = jnp.asarray(y32)
+    dt = timeit(lambda: net.fit(x, y), lambda: float(net.score()), n=10)
+    out["baseline_ms"] = round(dt * 1e3, 2)
+    # (b) bf16 features fed directly (halves the input HBM traffic and
+    # removes the f32->bf16 convert at the step head)
+    xb = jnp.asarray(x32, jnp.bfloat16)
+    try:
+        dtb = timeit(lambda: net.fit(xb, y), lambda: float(net.score()),
+                     n=10)
+        out["bf16_inputs_ms"] = round(dtb * 1e3, 2)
+    except Exception as e:
+        out["bf16_inputs_error"] = str(e)[:200]
+    return out
+
+
+def main():
+    quick = "--quick" in sys.argv
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from __graft_entry__ import _probe_backend_device_count
+    n = _probe_backend_device_count()
+    if n < 1:
+        print("[playbook] backend unreachable — aborting", flush=True)
+        record("0_probe", {"devices": 0})
+        sys.exit(1)
+    import jax
+    record("0_probe", {"devices": n,
+                       "platform": jax.default_backend()})
+    stage_headline(quick)
+    stage_mosaic(quick)
+    stage_flash_ab(quick)
+    stage_ln_ab(quick)
+    stage_conv_layout(quick)
+    print("[playbook] DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()
