@@ -399,6 +399,33 @@ def _batch_norm_nchw(x, scale, b, mean, var, eps=1e-5):
     return (x - mean.reshape(shp)) * inv + b.reshape(shp)
 
 
+@register_op("deconv2d_nchw")
+def _deconv2d_nchw(x, w, b=None, stride=(1, 1), pads=(0, 0, 0, 0),
+                   dilation=(1, 1), output_padding=(0, 0), groups=1):
+    """ONNX ConvTranspose: x [B,Ci,H,W], w [Ci, Co/groups, kh, kw]
+    (IOHW — torch's conv_transpose2d layout), gradient-form semantics.
+    ONNX pads (t, l, b, r) REMOVE border rows from the full gradient-form
+    output; lax.conv_transpose pads the lhs-dilated input, so the mapping
+    is (k-1)*dilation - pad per edge, plus output_padding on the
+    trailing edges.  Kernel spatially flipped for lax (see deconv2d)."""
+    if groups != 1:
+        raise NotImplementedError(
+            "deconv2d_nchw: grouped ConvTranspose is not supported — "
+            "export with group=1")
+    kh, kw = w.shape[2], w.shape[3]
+    eh = (kh - 1) * dilation[0]
+    ew = (kw - 1) * dilation[1]
+    pad = ((eh - pads[0], eh - pads[2] + output_padding[0]),
+           (ew - pads[1], ew - pads[3] + output_padding[1]))
+    y = lax.conv_transpose(
+        x, jnp.flip(w, (2, 3)), strides=tuple(stride), padding=pad,
+        rhs_dilation=tuple(dilation),
+        dimension_numbers=("NCHW", "IOHW", "NCHW"))
+    if b is not None:
+        y = y + b.reshape(1, -1, 1, 1)
+    return y
+
+
 @register_op("split_axis")
 def _split_axis(x, sizes, axis=0):
     points = []

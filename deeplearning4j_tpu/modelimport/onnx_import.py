@@ -216,6 +216,31 @@ def _conv(sd, n, ins):
                  groups=_ai(n, "group", 1), name=n.output[0])
 
 
+@R("ConvTranspose")
+def _conv_transpose(sd, n, ins):
+    """ONNX ConvTranspose (gradient-form; torch Conv2dTranspose export).
+    Weight layout is IOHW — the transpose of Conv's OIHW."""
+    if _astr(n, "auto_pad", "NOTSET") not in ("", "NOTSET"):
+        raise UnmappedOnnxOpException(
+            "ConvTranspose auto_pad unsupported — export with explicit "
+            "pads")
+    if _aints(n, "output_shape", None) is not None:
+        raise UnmappedOnnxOpException(
+            "ConvTranspose output_shape attr unsupported — export with "
+            "pads/output_padding instead")
+    if _ai(n, "group", 1) != 1:
+        raise UnmappedOnnxOpException(
+            "ConvTranspose group != 1 unsupported — export with group=1")
+    args = ins if len(ins) > 2 and ins[2] is not None else ins[:2]
+    return sd.op("deconv2d_nchw", *args,
+                 stride=tuple(_aints(n, "strides", [1, 1])),
+                 pads=tuple(_aints(n, "pads", [0, 0, 0, 0])),
+                 dilation=tuple(_aints(n, "dilations", [1, 1])),
+                 output_padding=tuple(_aints(n, "output_padding",
+                                             [0, 0])),
+                 groups=_ai(n, "group", 1), name=n.output[0])
+
+
 @R("MaxPool")
 def _maxpool(sd, n, ins):
     if _ai(n, "ceil_mode", 0):
@@ -352,8 +377,12 @@ def _split(sd, n, ins):
 @R("Pad")
 def _pad(sd, n, ins):
     mode = _astr(n, "mode", "constant")
-    if mode != "constant":
+    if mode not in ("constant", "reflect", "edge"):
         raise UnmappedOnnxOpException(f"Pad mode={mode} unsupported")
+    if len(ins) > 3 and ins[3] is not None:
+        raise UnmappedOnnxOpException(
+            "Pad with the opset-18 `axes` input is unsupported — export "
+            "full-rank pads")
     if len(ins) > 1 and ins[1] is not None:    # opset>=11: pads as input
         pads = _const_ints(ins[1])
         value = float(np.asarray(ins[2].get_arr())) \
@@ -363,7 +392,10 @@ def _pad(sd, n, ins):
         value = _af(n, "value", 0.0)
     rank = len(pads) // 2
     paddings = [[pads[i], pads[i + rank]] for i in range(rank)]
-    return sd.op("pad", ins[0], paddings=paddings, value=value,
+    if mode == "constant":
+        return sd.op("pad", ins[0], paddings=paddings, value=value,
+                     name=n.output[0])
+    return sd.op("pad_mode", ins[0], paddings=paddings, mode=mode,
                  name=n.output[0])
 
 

@@ -261,9 +261,31 @@ def _nchw_conv_golden(x, w, b=None, stride=(1, 1), pads=(1, 1, 1, 1),
     return y.numpy()
 
 
+def _nchw_deconv_golden(x, w, b=None, stride=(1, 1), pads=(0, 0, 0, 0),
+                        dilation=(1, 1), output_padding=(0, 0),
+                        groups=1):
+    import torch
+    import torch.nn.functional as TF
+    # torch pads symmetrically; a future asymmetric case must fail loudly
+    assert pads[0] == pads[2] and pads[1] == pads[3], pads
+    y = TF.conv_transpose2d(
+        torch.from_numpy(x).double(), torch.from_numpy(w).double(),
+        None if b is None else torch.from_numpy(b).double(),
+        stride=stride, padding=(pads[0], pads[1]),
+        output_padding=output_padding, dilation=dilation, groups=groups)
+    return y.numpy()
+
+
 CASES += [
     C("conv2d_nchw", F(2, 3, 5, 5), F(4, 3, 3, 3, lo=-0.5, hi=0.5),
       F(4), kw={"pads": (1, 1, 1, 1)}, g=_nchw_conv_golden, tol=1e-4),
+    C("deconv2d_nchw", F(2, 3, 4, 4), F(3, 4, 3, 3, lo=-0.5, hi=0.5),
+      F(4), kw={"stride": (2, 2), "pads": (1, 1, 1, 1),
+                "output_padding": (1, 1)},
+      g=_nchw_deconv_golden, tol=1e-4),
+    C("deconv2d_nchw", F(1, 2, 4, 4), F(2, 3, 2, 2, lo=-0.5, hi=0.5),
+      kw={"dilation": (2, 2)}, g=_nchw_deconv_golden, tol=1e-4,
+      tag="dilated"),
     C("max_pool2d_nchw", F(2, 3, 6, 6),
       g=lambda x, kernel=(2, 2), stride=(2, 2), pads=(0, 0, 0, 0):
       x.reshape(2, 3, 3, 2, 3, 2).max((3, 5))),

@@ -1,0 +1,243 @@
+"""ONNX per-op conformance corpus.
+
+Counterpart of tests/test_tf_conformance_corpus.py for the ONNX surface
+(reference: samediff-import-onnx's op-mapper tests).  No `onnx` package
+exists in the image, so each case AUTHORS its graph with the in-repo
+`onnx_proto` codec and conformance-checks the import against torch's own
+op (the exporter whose graphs this importer targets)."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TTF
+
+from deeplearning4j_tpu.modelimport.onnx_import import import_onnx_model
+from tests.test_onnx_import import _N, _model, _vi
+from deeplearning4j_tpu.modelimport.onnx_proto import (attr_f, attr_i,
+                                                       attr_ints, attr_s)
+
+rs = np.random.RandomState(7)
+
+
+def F(*s, lo=-2.0, hi=2.0):
+    return rs.uniform(lo, hi, s).astype(np.float32)
+
+
+CORPUS = []
+
+
+def case(name, nodes, inputs, inits, golden, tol=1e-5):
+    CORPUS.append((name, nodes, inputs, inits, golden, tol))
+
+
+def _t(a):
+    return torch.from_numpy(np.asarray(a))
+
+
+# ---- conv family ----
+_x_img = F(2, 3, 6, 6)
+_w_conv = F(4, 3, 3, 3, lo=-0.4, hi=0.4)
+case("conv-pads-dil",
+     [_N("Conv", ["x", "w"], ["y"], attr_ints("pads", [2, 2, 2, 2]),
+         attr_ints("dilations", [2, 2]), attr_ints("strides", [1, 1]),
+         attr_ints("kernel_shape", [3, 3]))],
+     {"x": _x_img}, {"w": _w_conv},
+     lambda x: TTF.conv2d(_t(x), _t(_w_conv), padding=2,
+                          dilation=2).numpy())
+
+_w_dec = F(3, 4, 3, 3, lo=-0.4, hi=0.4)
+_b_dec = F(4)
+case("convtranspose",
+     [_N("ConvTranspose", ["x", "w", "b"], ["y"],
+         attr_ints("strides", [2, 2]), attr_ints("pads", [1, 1, 1, 1]),
+         attr_ints("output_padding", [1, 1]),
+         attr_ints("kernel_shape", [3, 3]))],
+     {"x": _x_img}, {"w": _w_dec, "b": _b_dec},
+     lambda x: TTF.conv_transpose2d(_t(x), _t(_w_dec), _t(_b_dec),
+                                    stride=2, padding=1,
+                                    output_padding=1).numpy())
+
+case("maxpool-pads",
+     [_N("MaxPool", ["x"], ["y"], attr_ints("kernel_shape", [3, 3]),
+         attr_ints("strides", [2, 2]), attr_ints("pads", [1, 1, 1, 1]))],
+     {"x": _x_img}, {},
+     lambda x: TTF.max_pool2d(_t(x), 3, 2, padding=1).numpy())
+
+case("avgpool-include-pad",
+     [_N("AveragePool", ["x"], ["y"], attr_ints("kernel_shape", [2, 2]),
+         attr_ints("strides", [2, 2]), attr_ints("pads", [1, 1, 1, 1]),
+         attr_i("count_include_pad", 1))],
+     {"x": _x_img}, {},
+     lambda x: TTF.avg_pool2d(_t(x), 2, 2, padding=1,
+                              count_include_pad=True).numpy())
+
+_bn_s, _bn_b = F(3, lo=0.5, hi=1.5), F(3)
+_bn_m, _bn_v = F(3), F(3, lo=0.5, hi=1.5)
+case("batchnorm-inference",
+     [_N("BatchNormalization", ["x", "s", "b", "m", "v"], ["y"],
+         attr_f("epsilon", 1e-4))],
+     {"x": _x_img}, {"s": _bn_s, "b": _bn_b, "m": _bn_m, "v": _bn_v},
+     lambda x: TTF.batch_norm(_t(x), _t(_bn_m), _t(_bn_v), _t(_bn_s),
+                              _t(_bn_b), False, 0.0, 1e-4).numpy(),
+     tol=1e-4)
+
+# ---- linalg ----
+_gw = F(5, 4, lo=-0.5, hi=0.5)
+_gc = F(5)
+case("gemm-transB-alpha",
+     [_N("Gemm", ["x", "w", "c"], ["y"], attr_f("alpha", 0.5),
+         attr_f("beta", 2.0), attr_i("transB", 1))],
+     {"x": F(3, 4)}, {"w": _gw, "c": _gc},
+     lambda x: (0.5 * (_t(x) @ _t(_gw).T) + 2.0 * _t(_gc)).numpy())
+
+_mmw = F(2, 4, 5, lo=-0.5, hi=0.5)
+case("matmul-batched",
+     [_N("MatMul", ["x", "w"], ["y"])],
+     {"x": F(2, 3, 4)}, {"w": _mmw},
+     lambda x: (_t(x) @ _t(_mmw)).numpy())
+
+# ---- norm ----
+_ln_g, _ln_b = F(6, lo=0.5, hi=1.5), F(6)
+case("layernorm",
+     [_N("LayerNormalization", ["x", "g", "b"], ["y"],
+         attr_f("epsilon", 1e-5), attr_i("axis", -1))],
+     {"x": F(4, 6)}, {"g": _ln_g, "b": _ln_b},
+     lambda x: TTF.layer_norm(_t(x), (6,), _t(_ln_g), _t(_ln_b),
+                              1e-5).numpy(), tol=1e-4)
+
+# ---- shape / slicing ----
+case("slice-neg-step",
+     [_N("Slice", ["x", "starts", "ends", "axes", "steps"], ["y"])],
+     {"x": F(4, 6)},
+     {"starts": np.asarray([3, 5], np.int64),
+      "ends": np.asarray([0, 0], np.int64),
+      "axes": np.asarray([0, 1], np.int64),
+      "steps": np.asarray([-1, -2], np.int64)},
+     lambda x: np.ascontiguousarray(x[3:0:-1, 5:0:-2]))
+
+case("pad-reflect",
+     [_N("Pad", ["x", "pads"], ["y"], attr_s("mode", "reflect"))],
+     {"x": F(3, 4)},
+     {"pads": np.asarray([1, 1, 1, 1], np.int64)},
+     lambda x: TTF.pad(_t(x)[None, None], (1, 1, 1, 1),
+                       mode="reflect")[0, 0].numpy())
+
+case("split-uneven",
+     [_N("Split", ["x", "sizes"], ["a", "b"], attr_i("axis", 1)),
+      _N("Concat", ["b", "a"], ["y"], attr_i("axis", 1))],
+     {"x": F(3, 7)},
+     {"sizes": np.asarray([3, 4], np.int64)},
+     lambda x: np.concatenate([x[:, 3:], x[:, :3]], 1))
+
+case("squeeze-unsqueeze",
+     [_N("Unsqueeze", ["x", "ax1"], ["u"]),
+      _N("Squeeze", ["u", "ax2"], ["y"])],
+     {"x": F(3, 4)},
+     {"ax1": np.asarray([1], np.int64), "ax2": np.asarray([1], np.int64)},
+     lambda x: x)
+
+case("transpose-reshape",
+     [_N("Transpose", ["x"], ["t"], attr_ints("perm", [2, 0, 1])),
+      _N("Reshape", ["t", "shp"], ["y"])],
+     {"x": F(2, 3, 4)},
+     {"shp": np.asarray([4, -1], np.int64)},
+     lambda x: x.transpose(2, 0, 1).reshape(4, -1))
+
+case("flatten-axis2",
+     [_N("Flatten", ["x"], ["y"], attr_i("axis", 2))],
+     {"x": F(2, 3, 4, 5)}, {},
+     lambda x: x.reshape(6, 20))
+
+case("gather-axis1",
+     [_N("Gather", ["x", "idx"], ["y"], attr_i("axis", 1))],
+     {"x": F(3, 5)},
+     {"idx": np.asarray([4, 0, 2], np.int64)},
+     lambda x: x[:, [4, 0, 2]])
+
+# ---- elementwise / logic ----
+case("arith-chain",
+     [_N("Add", ["x", "x"], ["a"]),
+      _N("Mul", ["a", "x"], ["m"]),
+      _N("Sub", ["m", "x"], ["s"]),
+      _N("Div", ["s", "d"], ["y"])],
+     {"x": F(3, 4, lo=0.5, hi=2.0)},
+     {"d": np.full((3, 4), 2.0, np.float32)},
+     lambda x: ((x + x) * x - x) / 2.0)
+
+case("activations",
+     [_N("Relu", ["x"], ["r"]),
+      _N("Elu", ["r"], ["e"], attr_f("alpha", 1.0)),
+      _N("LeakyRelu", ["x"], ["l"], attr_f("alpha", 0.2)),
+      _N("Add", ["e", "l"], ["a1"]),
+      _N("Softplus", ["x"], ["sp"]),
+      _N("Add", ["a1", "sp"], ["y"])],
+     {"x": F(4, 5)}, {},
+     lambda x: (TTF.elu(TTF.relu(_t(x)))
+                + TTF.leaky_relu(_t(x), 0.2)
+                + TTF.softplus(_t(x))).numpy())
+
+case("clip-minmax",
+     [_N("Clip", ["x", "lo", "hi"], ["y"])],
+     {"x": F(3, 4, lo=-3, hi=3)},
+     {"lo": np.float32(-1.0), "hi": np.float32(1.5)},
+     lambda x: np.clip(x, -1.0, 1.5))
+
+case("where-greater",
+     [_N("Greater", ["x", "z"], ["g"]),
+      _N("Where", ["g", "x", "z"], ["y"])],
+     {"x": F(3, 4)},
+     {"z": np.zeros((3, 4), np.float32)},
+     lambda x: np.where(x > 0, x, 0.0))
+
+case("softmax-logsoftmax-axis",
+     [_N("Softmax", ["x"], ["s"], attr_i("axis", 1)),
+      _N("LogSoftmax", ["x"], ["l"], attr_i("axis", 1)),
+      _N("Add", ["s", "l"], ["y"])],
+     {"x": F(3, 5, 2)}, {},
+     lambda x: (TTF.softmax(_t(x), 1)
+                + TTF.log_softmax(_t(x), 1)).numpy(), tol=1e-4)
+
+case("reduce-axes-keepdims",
+     [_N("ReduceMean", ["x", "axes"], ["m"], attr_i("keepdims", 1)),
+      _N("Sub", ["x", "m"], ["y"])],
+     {"x": F(3, 4, 5)},
+     {"axes": np.asarray([1, 2], np.int64)},
+     lambda x: x - x.mean((1, 2), keepdims=True), tol=1e-4)
+
+case("argmax-keepdims0",
+     [_N("ArgMax", ["x"], ["i"], attr_i("axis", 1),
+         attr_i("keepdims", 0)),
+      _N("Cast", ["i"], ["y"], attr_i("to", 1))],   # 1 = FLOAT
+     {"x": F(4, 6)}, {},
+     lambda x: x.argmax(1).astype(np.float32))
+
+case("dropout-inference",
+     [_N("Dropout", ["x"], ["y"], attr_f("ratio", 0.5))],
+     {"x": F(3, 4)}, {},
+     lambda x: x)
+
+case("pow-sqrt-reciprocal",
+     [_N("Pow", ["x", "e"], ["p"]),
+      _N("Sqrt", ["p"], ["sq"]),
+      _N("Reciprocal", ["sq"], ["y"])],
+     {"x": F(3, 4, lo=0.5, hi=2.0)},
+     {"e": np.full((), 2.0, np.float32)},
+     lambda x: 1.0 / np.sqrt(x ** 2), tol=1e-4)
+
+
+@pytest.mark.parametrize(
+    "name,nodes,inputs,inits,golden,tol", CORPUS,
+    ids=[c[0] for c in CORPUS])
+def test_onnx_graph_conformance(name, nodes, inputs, inits, golden, tol):
+    out_name = nodes[-1].output[0]
+    model = _model(nodes,
+                   [_vi(k, v.shape) for k, v in inputs.items()],
+                   [_vi(out_name, ())], inits)
+    sd = import_onnx_model(model)
+    got = np.asarray(sd.output(dict(inputs), out_name)[out_name])
+    want = np.asarray(golden(*inputs.values()))
+    np.testing.assert_allclose(got, want, rtol=tol, atol=tol,
+                               err_msg=name)
+
+
+def test_onnx_corpus_size():
+    assert len(CORPUS) >= 20, len(CORPUS)
