@@ -5,3 +5,4 @@ from deeplearning4j_tpu.nlp.word2vec import Word2Vec  # noqa: F401
 from deeplearning4j_tpu.nlp.bert_iterator import BertIterator  # noqa: F401
 from deeplearning4j_tpu.nlp.glove import Glove  # noqa: F401
 from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors  # noqa: F401
+from deeplearning4j_tpu.nlp.tsne import TSNE  # noqa: F401

@@ -1,0 +1,99 @@
+"""Pallas conv-backward-filter (wgrad) prototype.
+
+VERDICT r3 #3: ResNet-50's conv backward is 45% of step time at ~40% MXU
+(bench_artifacts/PERF_ANALYSIS.md); the prescribed experiment is a Pallas
+wgrad (or dgrad) kernel for the 3x3 stride-1 SAME shapes, A/B'd against
+XLA's lowering ON CHIP — a measured win adopts it, a measured loss gets a
+committed negative-result table (tunnel_playbook.py stage 6).
+
+Formulation: for a 3x3 stride-1 SAME conv,
+
+    dW[i, j, ci, co] = sum_{b, oh, ow} x_pad[b, oh+i, ow+j, ci]
+                                     * dy[b, oh, ow, co]
+
+i.e. NINE [Ci, K] x [K, Co] matmuls over the same K = B*H*W reduction,
+each with a shifted view of x.  XLA lowers this as one big filter-grad
+conv; the kernel instead keeps an x row-stripe resident in VMEM and
+reuses it for all nine taps (the data-reuse XLA's tiling does not get
+credit for at these shapes).
+
+Halo handling: Pallas blocked indexing cannot express overlapping row
+blocks, so the three row shifts are materialized OUTSIDE the kernel as
+three row-aligned views of the padded input (x_pad[:, i:i+H] for
+i in 0,1,2) — each partitions cleanly into row stripes; the two column
+shifts stay inside the stripe because the full padded width is loaded.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _wgrad_kernel(xt_ref, xm_ref, xb_ref, dy_ref, out_ref, *, bh, W, Ci,
+                  Co):
+    step = pl.program_id(0) * pl.num_programs(1) + pl.program_id(1)
+
+    @pl.when(step == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    dy = dy_ref[0].reshape(bh * W, Co).astype(jnp.float32)
+    for i, xs_ref in enumerate((xt_ref, xm_ref, xb_ref)):
+        xs = xs_ref[0]                          # [bh, W+2, Ci]
+        for j in range(3):
+            xij = xs[:, j:j + W, :].reshape(bh * W, Ci).astype(
+                jnp.float32)
+            acc = jax.lax.dot_general(
+                xij, dy, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            out_ref[i * 3 + j] += acc
+
+
+def conv3x3_wgrad_tpu(x, dy, block_rows: int = 0,
+                      interpret: bool = False):
+    """Filter gradient of a 3x3 stride-1 SAME NHWC conv.
+
+    x: [B, H, W, Ci] activations, dy: [B, H, W, Co] output cotangent
+    -> dw [3, 3, Ci, Co] float32.
+    """
+    B, H, W, Ci = x.shape
+    Co = dy.shape[-1]
+    if dy.shape[:3] != (B, H, W):
+        raise ValueError(f"dy {dy.shape} mismatches x {x.shape}")
+    bh = block_rows or max(d for d in (1, 2, 4, 7, 8, 14, 16, 28, 32)
+                           if H % d == 0)
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    # three row-shifted, stripe-partitionable views (see module docstring)
+    xt = xp[:, 0:H]
+    xm = xp[:, 1:H + 1]
+    xb = xp[:, 2:H + 2]
+    grid = (B, H // bh)
+
+    x_spec = pl.BlockSpec((1, bh, W + 2, Ci),
+                          lambda b, i: (b, i, 0, 0))
+    out = pl.pallas_call(
+        functools.partial(_wgrad_kernel, bh=bh, W=W, Ci=Ci, Co=Co),
+        grid=grid,
+        in_specs=[x_spec, x_spec, x_spec,
+                  pl.BlockSpec((1, bh, W, Co), lambda b, i: (b, i, 0, 0))],
+        out_specs=pl.BlockSpec((9, Ci, Co), lambda b, i: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((9, Ci, Co), jnp.float32),
+        interpret=interpret,
+    )(xt, xm, xb, dy)
+    return out.reshape(3, 3, Ci, Co)
+
+
+def conv3x3_wgrad_xla(x, dy):
+    """XLA reference: filter grad via autodiff of the forward conv."""
+    w0 = jnp.zeros((3, 3, x.shape[-1], dy.shape[-1]), jnp.float32)
+
+    def loss(w):
+        y = jax.lax.conv_general_dilated(
+            x.astype(jnp.float32), w, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return jnp.sum(y * dy.astype(jnp.float32))
+
+    return jax.grad(loss)(w0)

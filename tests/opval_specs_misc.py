@@ -46,7 +46,7 @@ CASES += [
     C("rgb_to_hsv", _img, g=_hsv_golden, tol=1e-4),
     C("hsv_to_rgb", _hsv_golden(F01(2, 4, 4, 3)).astype(np.float32),
       g=_hsv_inv_golden, tol=1e-4),
-    C("rgb_to_yiq", _img, g=lambda x: x @ _YIQ_M.T, tol=1e-4),
+    C("rgb_to_yiq", _img, g=lambda x: x @ _YIQ_M.T, tol=1e-4, grad=(0,)),
     C("yiq_to_rgb", (_img @ _YIQ_M.T).astype(np.float32),
       g=lambda x: x @ np.linalg.inv(_YIQ_M).T, tol=1e-4),
     C("rgb_to_yuv", _img, g=lambda x: x @ _YUV_M.T, tol=1e-4),
@@ -59,7 +59,7 @@ CASES += [
       kw={"factor": 1.4}, tol=1e-3),
     C("adjust_contrast", _img, g=lambda x, factor:
       _tf().image.adjust_contrast(x, factor).numpy().astype(np.float64),
-      kw={"factor": 1.8}, tol=1e-4),
+      kw={"factor": 1.8}, tol=1e-4, grad=(0,)),
     C("adjust_contrast_v2", _img, g=lambda x, factor:
       _tf().image.adjust_contrast(x, factor).numpy().astype(np.float64),
       kw={"factor": 0.6}, tol=1e-4),
@@ -124,7 +124,7 @@ CASES += [
     C("resize_lanczos", np.ones((1, 4, 4, 1), np.float32), (6, 6),
       g=lambda x, size: np.ones((1, 6, 6, 1)), tol=1e-4),
     C("resize_area", F01(1, 6, 6, 2), (3, 3), g=lambda x, size:
-      x.reshape(1, 3, 2, 3, 2, 2).mean((2, 4)), tol=1e-5),
+      x.reshape(1, 3, 2, 3, 2, 2).mean((2, 4)), tol=1e-5, grad=(0,)),
 ]
 
 # ---- nms / boxes ----
@@ -320,7 +320,8 @@ def _np_scatter(a, idx, upd, op):
 
 CASES += [
     C("scatter_add", _sc_a, _sc_dup, _sc_upd,
-      g=lambda a, i, u: _np_scatter(a, i, u, "add"), tol=1e-5),
+      g=lambda a, i, u: _np_scatter(a, i, u, "add"), tol=1e-5,
+      grad=(0, 2)),
     C("scatter_sub", _sc_a, _sc_dup, _sc_upd,
       g=lambda a, i, u: _np_scatter(a, i, u, "sub"), tol=1e-5),
     C("scatter_update", _sc_a, _sc_idx, _sc_upd,
@@ -393,7 +394,8 @@ def _np_segment(data, ids, n, op):
 
 CASES += [
     C("segment_sum", _seg_data, _seg_ids, 3,
-      g=lambda d, i, n: _np_segment(d, i, n, "sum"), tol=1e-5),
+      g=lambda d, i, n: _np_segment(d, i, n, "sum"), tol=1e-5,
+      grad=(0,)),
     C("segment_max", _seg_data, _seg_ids, 3,
       g=lambda d, i, n: _np_segment(d, i, n, "max")),
     C("segment_min", _seg_data, _seg_ids, 3,
@@ -450,8 +452,10 @@ CASES += [
       g=lambda i, s, v, default_value=0.0: np.asarray(
           [[0, 5, 0, 0], [0, 0, 0, 0], [0, 0, 7, 0]], np.float64)),
     C("mergemax", F(3, 4), F(3, 4), F(3, 4),
-      g=lambda *xs: np.maximum(np.maximum(xs[0], xs[1]), xs[2])),
-    C("mergeadd", F(3, 4), F(3, 4), F(3, 4), g=lambda *xs: sum(xs)),
+      g=lambda *xs: np.maximum(np.maximum(xs[0], xs[1]), xs[2]),
+      grad=(0,)),
+    C("mergeadd", F(3, 4), F(3, 4), F(3, 4), g=lambda *xs: sum(xs),
+      grad=(0, 1, 2)),
     C("mergeavg", F(3, 4), F(3, 4), F(3, 4),
       g=lambda *xs: sum(xs) / 3, tol=1e-5),
     C("mergemaxindex", F(3, 4), F(3, 4), F(3, 4),

@@ -1,0 +1,45 @@
+"""Pallas conv wgrad prototype — interpret-mode correctness vs the XLA
+autodiff reference (on-chip A/B lives in tunnel_playbook.py stage 6)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.conv_kernels import (conv3x3_wgrad_tpu,
+                                                 conv3x3_wgrad_xla)
+
+rs = np.random.RandomState(0)
+
+
+@pytest.mark.parametrize("B,H,W,Ci,Co", [
+    (2, 8, 8, 8, 16),       # even rows, bh=8
+    (1, 7, 7, 16, 8),       # odd rows, bh=7 (the ResNet 7x7 tail shape)
+    (2, 14, 14, 8, 8),      # bh=14
+])
+def test_wgrad_matches_xla(B, H, W, Ci, Co):
+    x = jnp.asarray(rs.randn(B, H, W, Ci).astype(np.float32) * 0.5)
+    dy = jnp.asarray(rs.randn(B, H, W, Co).astype(np.float32) * 0.5)
+    got = np.asarray(conv3x3_wgrad_tpu(x, dy, interpret=True))
+    want = np.asarray(conv3x3_wgrad_xla(x, dy))
+    assert got.shape == (3, 3, Ci, Co)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_wgrad_bf16_inputs_accumulate_f32():
+    x = jnp.asarray(rs.randn(2, 8, 8, 8).astype(np.float32))
+    dy = jnp.asarray(rs.randn(2, 8, 8, 8).astype(np.float32))
+    got = np.asarray(conv3x3_wgrad_tpu(x.astype(jnp.bfloat16),
+                                       dy.astype(jnp.bfloat16),
+                                       interpret=True))
+    want = np.asarray(conv3x3_wgrad_xla(x, dy))
+    assert got.dtype == np.float32
+    # bf16 INPUT rounding (not accumulation — that is f32) bounds the
+    # agreement: ~0.4% relative on dW values of magnitude ~10
+    np.testing.assert_allclose(got, want, rtol=3e-2, atol=0.12)
+
+
+def test_wgrad_rejects_mismatched_shapes():
+    x = jnp.zeros((1, 8, 8, 4))
+    dy = jnp.zeros((1, 4, 8, 4))
+    with pytest.raises(ValueError, match="mismatches"):
+        conv3x3_wgrad_tpu(x, dy, interpret=True)

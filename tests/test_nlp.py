@@ -253,3 +253,28 @@ def test_word2vec_hs_flag_survives_save_load(tmp_path):
     w2 = Word2Vec.load(p)
     assert w2.use_hs
     assert w2.syn1.shape[0] == len(w2.vocab) - 1   # inner-node matrix
+
+
+def test_tsne_separates_clusters():
+    """Dense TPU-native t-SNE (reference BarnesHutTsne role): three
+    well-separated gaussian blobs in 20-D must map to three separated
+    2-D clusters, and the KL divergence must be small."""
+    from deeplearning4j_tpu.nlp.tsne import TSNE
+    rs = np.random.RandomState(0)
+    centers = rs.randn(3, 20) * 10
+    X = np.concatenate([c + rs.randn(25, 20) for c in centers])
+    emb = TSNE(perplexity=8.0, n_iter=350, seed=1).fit_transform(X)
+    assert emb.shape == (75, 2)
+    labels = np.repeat(np.arange(3), 25)
+    cents = np.stack([emb[labels == k].mean(0) for k in range(3)])
+    intra = np.mean([np.linalg.norm(emb[labels == k] - cents[k], axis=1).mean()
+                     for k in range(3)])
+    inter = np.mean([np.linalg.norm(cents[a] - cents[b])
+                     for a in range(3) for b in range(a + 1, 3)])
+    assert inter > 3.0 * intra, (inter, intra)
+
+
+def test_tsne_perplexity_guard():
+    from deeplearning4j_tpu.nlp.tsne import TSNE
+    with pytest.raises(ValueError, match="perplexity"):
+        TSNE(perplexity=30.0).fit_transform(np.random.randn(10, 4))

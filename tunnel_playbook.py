@@ -267,6 +267,41 @@ def stage_conv_layout(quick):
     return out
 
 
+@guard("6_wgrad_ab")
+def stage_wgrad_ab(quick):
+    """Pallas 3x3 wgrad kernel vs XLA's conv-backward-filter at the
+    ResNet-50 block shapes (VERDICT r3 #3: measured table, win or lose).
+    Includes the kernel's pad+slice pre-pass in its timing — the honest
+    end-to-end cost."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.conv_kernels import (conv3x3_wgrad_tpu,
+                                                     conv3x3_wgrad_xla)
+    rs = np.random.RandomState(0)
+    out = {}
+    shapes = [(64, 56, 56, 64, 64), (64, 28, 28, 128, 128),
+              (64, 14, 14, 256, 256), (64, 7, 7, 512, 512)]
+    for B, H, W, Ci, Co in (shapes[:2] if quick else shapes):
+        x = jnp.asarray(rs.randn(B, H, W, Ci).astype(np.float32) * 0.1
+                        ).astype(jnp.bfloat16)
+        dy = jnp.asarray(rs.randn(B, H, W, Co).astype(np.float32) * 0.1
+                         ).astype(jnp.bfloat16)
+        pallas_fn = jax.jit(conv3x3_wgrad_tpu)
+        xla_fn = jax.jit(conv3x3_wgrad_xla)
+        got = pallas_fn(x, dy)
+        want = xla_fn(x, dy)
+        jax.block_until_ready((got, want))
+        err = float(jnp.max(jnp.abs(got - want)))
+        tp = timeit(lambda: pallas_fn(x, dy),
+                    lambda: jax.block_until_ready(pallas_fn(x, dy)))
+        tx = timeit(lambda: xla_fn(x, dy),
+                    lambda: jax.block_until_ready(xla_fn(x, dy)))
+        out[f"{H}x{W}x{Ci}"] = {
+            "pallas_ms": round(tp * 1e3, 3), "xla_ms": round(tx * 1e3, 3),
+            "speedup": round(tx / tp, 3), "max_err": err}
+    return out
+
+
 def main():
     quick = "--quick" in sys.argv
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -284,6 +319,7 @@ def main():
     stage_flash_ab(quick)
     stage_ln_ab(quick)
     stage_conv_layout(quick)
+    stage_wgrad_ab(quick)
     print("[playbook] DONE", flush=True)
 
 
