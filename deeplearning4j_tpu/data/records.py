@@ -284,13 +284,15 @@ class SVMLightRecordReader(RecordReader):
 
     def __init__(self, num_features: int,
                  path: Optional[str] = None, text: Optional[str] = None,
-                 zero_based: bool = False, append_label: bool = True):
+                 zero_based: bool = False, append_label: bool = True,
+                 multilabel: bool = False):
         if (path is None) == (text is None):
             raise ValueError("Exactly one of path/text required")
         self.path, self.text = path, text
         self.num_features = num_features
         self.zero_based = zero_based
         self.append_label = append_label
+        self.multilabel = multilabel
 
     def __iter__(self):
         f = open(self.path) if self.path else io.StringIO(self.text)
@@ -316,8 +318,19 @@ class SVMLightRecordReader(RecordReader):
                             f"num_features={self.num_features}")
                     feats[idx] = float(v)
                 if self.append_label:
-                    # multilabel "1,3" stays a string; plain labels parse
-                    lab = (label if "," in label else float(label))
+                    # Label typing must be homogeneous across the file:
+                    # multilabel=True -> every label is a list of floats
+                    # (even single ones); multilabel=False -> float only,
+                    # with an explicit error rather than a surprise string
+                    # column the first time a "1,3" row appears.
+                    if self.multilabel:
+                        lab = [float(v) for v in label.split(",")]
+                    elif "," in label:
+                        raise ValueError(
+                            f"SVMLight: multilabel row {label!r} — pass "
+                            "multilabel=True to parse label lists")
+                    else:
+                        lab = float(label)
                     yield feats + [lab]
                 else:
                     yield feats

@@ -142,33 +142,33 @@ def _tf_shape(sd, n, ins):
 
 @R("Transpose")
 def _transpose(sd, n, ins):
-    perm = [int(p) for p in np.asarray(ins[1].get_arr())]
+    perm = [int(p) for p in _static_value(ins[1], f"{n.op} \'{n.name}\'")]
     return sd.op("transpose", ins[0], perm=perm, name=n.name)
 
 
 @R("ConcatV2")
 def _concat(sd, n, ins):
-    axis = int(np.asarray(ins[-1].get_arr()))
+    axis = int(_static_value(ins[-1], f"{n.op} \'{n.name}\'"))
     return sd.op("concat", *ins[:-1], axis=axis, name=n.name)
 
 
 @R("Mean")
 def _mean(sd, n, ins):
-    axes = [int(a) for a in np.atleast_1d(np.asarray(ins[1].get_arr()))]
+    axes = [int(a) for a in np.atleast_1d(_static_value(ins[1], f"{n.op} \'{n.name}\'"))]
     keep = bool(n.attr["keep_dims"].b)
     return sd.op("mean", ins[0], axis=axes, keepdims=keep, name=n.name)
 
 
 @R("Sum")
 def _sum(sd, n, ins):
-    axes = [int(a) for a in np.atleast_1d(np.asarray(ins[1].get_arr()))]
+    axes = [int(a) for a in np.atleast_1d(_static_value(ins[1], f"{n.op} \'{n.name}\'"))]
     keep = bool(n.attr["keep_dims"].b)
     return sd.op("sum", ins[0], axis=axes, keepdims=keep, name=n.name)
 
 
 @R("Max")
 def _max(sd, n, ins):
-    axes = [int(a) for a in np.atleast_1d(np.asarray(ins[1].get_arr()))]
+    axes = [int(a) for a in np.atleast_1d(_static_value(ins[1], f"{n.op} \'{n.name}\'"))]
     keep = bool(n.attr["keep_dims"].b)
     return sd.op("max", ins[0], axis=axes, keepdims=keep, name=n.name)
 
@@ -240,7 +240,7 @@ def _pack(sd, n, ins):
 
 @R("ExpandDims")
 def _expand(sd, n, ins):
-    axis = int(np.asarray(ins[1].get_arr()))
+    axis = int(_static_value(ins[1], f"{n.op} \'{n.name}\'"))
     return sd.op("expand_dims", ins[0], axis=axis, name=n.name)
 
 
@@ -273,7 +273,7 @@ R("BatchMatMulV3", _batch_matmul)
 
 @R("GatherV2")
 def _gather_v2(sd, n, ins):
-    axis = int(np.asarray(ins[2].get_arr()))
+    axis = int(_static_value(ins[2], f"{n.op} \'{n.name}\'"))
     if int(n.attr["batch_dims"].i):
         raise UnmappedTFOpException("GatherV2 batch_dims != 0 unsupported")
     return sd.op("gather", ins[0], ins[1], axis=axis, name=n.name)
@@ -287,9 +287,9 @@ R("Gather", lambda sd, n, ins: sd.op("gather", ins[0], ins[1], axis=0,
 def _tf_strided_slice(sd, n, ins):
     return sd.op(
         "tf_strided_slice", ins[0],
-        begin=[int(v) for v in np.asarray(ins[1].get_arr())],
-        end=[int(v) for v in np.asarray(ins[2].get_arr())],
-        strides=[int(v) for v in np.asarray(ins[3].get_arr())],
+        begin=[int(v) for v in _static_value(ins[1], f"{n.op} \'{n.name}\'")],
+        end=[int(v) for v in _static_value(ins[2], f"{n.op} \'{n.name}\'")],
+        strides=[int(v) for v in _static_value(ins[3], f"{n.op} \'{n.name}\'")],
         begin_mask=int(n.attr["begin_mask"].i),
         end_mask=int(n.attr["end_mask"].i),
         ellipsis_mask=int(n.attr["ellipsis_mask"].i),
@@ -308,7 +308,7 @@ def _squeeze(sd, n, ins):
 @R("Split")
 def _split(sd, n, ins):
     # inputs: (axis, value); attr num_split — equal split
-    axis = int(np.asarray(ins[0].get_arr()))
+    axis = int(_static_value(ins[0], f"{n.op} \'{n.name}\'"))
     num = int(n.attr["num_split"].i)
     v = sd.op("split_equal", ins[1], num=num, axis=axis)
     # secondary outputs take ':i' names — illegal in TF node names, so they
@@ -320,8 +320,8 @@ def _split(sd, n, ins):
 
 @R("SplitV")
 def _split_v(sd, n, ins):
-    sizes = [int(s) for s in np.asarray(ins[1].get_arr())]
-    axis = int(np.asarray(ins[2].get_arr()))
+    sizes = [int(s) for s in _static_value(ins[1], f"{n.op} \'{n.name}\'")]
+    axis = int(_static_value(ins[2], f"{n.op} \'{n.name}\'"))
     v = sd.op("split_axis", ins[0], sizes=sizes, axis=axis)
     return tuple(sd.op("tuple_get", v, index=i,
                        name=n.name if i == 0 else f"{n.name}:{i}")
@@ -348,9 +348,9 @@ R("FusedBatchNormV3", _fused_bn)
 
 @R("OneHot")
 def _one_hot(sd, n, ins):
-    depth = int(np.asarray(ins[1].get_arr()))
-    on = float(np.asarray(ins[2].get_arr()))
-    off = float(np.asarray(ins[3].get_arr()))
+    depth = int(_static_value(ins[1], f"{n.op} \'{n.name}\'"))
+    on = float(_static_value(ins[2], f"{n.op} \'{n.name}\'"))
+    off = float(_static_value(ins[3], f"{n.op} \'{n.name}\'"))
     axis = int(n.attr["axis"].i) if "axis" in n.attr else -1
     if axis != -1:
         raise UnmappedTFOpException("OneHot axis != -1 unsupported")
@@ -362,8 +362,8 @@ def _one_hot(sd, n, ins):
 
 @R("Fill")
 def _fill(sd, n, ins):
-    dims = [int(d) for d in np.asarray(ins[0].get_arr())]
-    value = np.asarray(ins[1].get_arr())
+    dims = [int(d) for d in _static_value(ins[0], f"{n.op} \'{n.name}\'")]
+    value = _static_value(ins[1], f"{n.op} \'{n.name}\'")
     return sd.constant(n.name, np.full(dims, value))
 
 
@@ -403,13 +403,13 @@ R("Gelu", lambda sd, n, ins: sd.op(
 
 @R("Tile")
 def _tile(sd, n, ins):
-    reps = [int(r) for r in np.asarray(ins[1].get_arr())]
+    reps = [int(r) for r in _static_value(ins[1], f"{n.op} \'{n.name}\'")]
     return sd.op("tile", ins[0], reps=reps, name=n.name)
 
 
 def _pad_tf(sd, n, ins):
-    paddings = np.asarray(ins[1].get_arr()).tolist()
-    value = 0.0 if len(ins) < 3 else float(np.asarray(ins[2].get_arr()))
+    paddings = _static_value(ins[1], f"{n.op} \'{n.name}\'").tolist()
+    value = 0.0 if len(ins) < 3 else float(_static_value(ins[2], f"{n.op} \'{n.name}\'"))
     return sd.op("pad", ins[0], paddings=paddings, value=value, name=n.name)
 
 
@@ -419,7 +419,7 @@ R("PadV2", _pad_tf)
 
 @R("Min")
 def _reduce_min(sd, n, ins):
-    axes = [int(a) for a in np.atleast_1d(np.asarray(ins[1].get_arr()))]
+    axes = [int(a) for a in np.atleast_1d(_static_value(ins[1], f"{n.op} \'{n.name}\'"))]
     return sd.op("min", ins[0], axis=axes,
                  keepdims=bool(n.attr["keep_dims"].b), name=n.name)
 
@@ -462,7 +462,7 @@ R("Selu", lambda sd, n, ins: sd.op("selu", ins[0], name=n.name))
 def _tf_argminmax(op):
     def h(sd, n, ins):
         from tensorflow.python.framework import dtypes
-        axis = int(np.asarray(ins[1].get_arr()))
+        axis = int(_static_value(ins[1], f"{n.op} \'{n.name}\'"))
         v = sd.op(op, ins[0], axis=axis, name=n.name + "__i32")
         # honor output_type (TF defaults to int64)
         out_t = n.attr["output_type"].type
@@ -478,14 +478,14 @@ R("ArgMin", _tf_argminmax("argmin"))
 
 @R("Prod")
 def _tf_prod(sd, n, ins):
-    axes = [int(a) for a in np.atleast_1d(np.asarray(ins[1].get_arr()))]
+    axes = [int(a) for a in np.atleast_1d(_static_value(ins[1], f"{n.op} \'{n.name}\'"))]
     return sd.op("prod", ins[0], axis=axes,
                  keepdims=bool(n.attr["keep_dims"].b), name=n.name)
 
 
 @R("Cumsum")
 def _tf_cumsum(sd, n, ins):
-    axis = int(np.asarray(ins[1].get_arr()))
+    axis = int(_static_value(ins[1], f"{n.op} \'{n.name}\'"))
     return sd.op("cumsum_ext", ins[0], axis=axis,
                  exclusive=bool(n.attr["exclusive"].b),
                  reverse=bool(n.attr["reverse"].b), name=n.name)
@@ -493,7 +493,7 @@ def _tf_cumsum(sd, n, ins):
 
 @R("TopKV2")
 def _tf_topk(sd, n, ins):
-    k = int(np.asarray(ins[1].get_arr()))
+    k = int(_static_value(ins[1], f"{n.op} \'{n.name}\'"))
     # explicit inner name: _fresh() generates '<op>:<counter>' which could
     # collide with the '<node>:<i>' output names when the TF node shares
     # the registry op's name
@@ -515,7 +515,7 @@ def _tf_unpack(sd, n, ins):
 
 @R("ReverseV2")
 def _tf_reverse(sd, n, ins):
-    axes = [int(a) for a in np.atleast_1d(np.asarray(ins[1].get_arr()))]
+    axes = [int(a) for a in np.atleast_1d(_static_value(ins[1], f"{n.op} \'{n.name}\'"))]
     return sd.op("reverse", ins[0], axes=axes, name=n.name)
 
 
@@ -546,7 +546,7 @@ def _tf_range(sd, n, ins):
 
 @R("MirrorPad")
 def _tf_mirror_pad(sd, n, ins):
-    paddings = np.asarray(ins[1].get_arr()).tolist()
+    paddings = _static_value(ins[1], f"{n.op} \'{n.name}\'").tolist()
     mode = n.attr["mode"].s.decode() or "REFLECT"
     return sd.op("mirror_pad", ins[0], paddings=paddings, mode=mode,
                  name=n.name)
@@ -578,14 +578,14 @@ def _check_resize_attrs(n, what):
 
 @R("ResizeBilinear")
 def _tf_resize_bilinear(sd, n, ins):
-    size = [int(s) for s in np.asarray(ins[1].get_arr())]
+    size = [int(s) for s in _static_value(ins[1], f"{n.op} \'{n.name}\'")]
     _check_resize_attrs(n, "ResizeBilinear")
     return sd.op("resize_bilinear", ins[0], size=size, name=n.name)
 
 
 @R("ResizeNearestNeighbor")
 def _tf_resize_nearest(sd, n, ins):
-    size = [int(s) for s in np.asarray(ins[1].get_arr())]
+    size = [int(s) for s in _static_value(ins[1], f"{n.op} \'{n.name}\'")]
     _check_resize_attrs(n, "ResizeNearestNeighbor")
     return sd.op("resize_nearest", ins[0], size=size, name=n.name)
 

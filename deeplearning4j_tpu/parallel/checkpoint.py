@@ -91,9 +91,23 @@ def save_sharded(directory: str, tree: Any,
     if jax.process_count() > 1:
         multihost_utils.sync_global_devices(f"ckpt-save:{directory}")
     if rank == 0:
+        # Drop leftovers from a previous save with MORE ranks (elastic
+        # resume into the same directory): without this, a loader that
+        # globbed every index-*/shards-* file would merge stale chunks in
+        # and could overwrite fresh parameters with old ones.
+        n_now = jax.process_count()
+        for name in os.listdir(directory):
+            stale = None
+            if name.startswith("index-") and name.endswith(".json"):
+                stale = int(name[len("index-"):-len(".json")])
+            elif name.startswith("shards-") and name.endswith(".npz"):
+                stale = int(name[len("shards-"):-len(".npz")])
+            if stale is not None and stale >= n_now:
+                os.remove(os.path.join(directory, name))
         manifest = {"format": "deeplearning4j_tpu.sharded.v1",
                     "num_ranks_at_save": jax.process_count(),
                     "leaves": specs,
+                    "treedef": str(treedef),
                     "metadata": metadata or {}}
         tmp = os.path.join(directory, MANIFEST + ".tmp")
         with open(tmp, "w") as f:
@@ -111,7 +125,7 @@ def read_metadata(directory: str) -> Dict[str, Any]:
 class _ChunkStore:
     """Lazy reader over every rank's chunk files at save time."""
 
-    def __init__(self, directory: str):
+    def __init__(self, directory: str, num_ranks: Optional[int] = None):
         self.directory = directory
         self.by_leaf: Dict[int, List[Dict[str, Any]]] = {}
         self._files: Dict[int, Any] = {}
@@ -119,6 +133,8 @@ class _ChunkStore:
             if not (name.startswith("index-") and name.endswith(".json")):
                 continue
             rank = int(name[len("index-"):-len(".json")])
+            if num_ranks is not None and rank >= num_ranks:
+                continue  # stale leftover from a larger previous job
             with open(os.path.join(directory, name)) as f:
                 for entry in json.load(f):
                     entry = dict(entry, rank=rank)
@@ -172,8 +188,16 @@ def load_sharded(directory: str, like: Any) -> Any:
             f"{directory}: no committed checkpoint (manifest.json absent)")
     with open(os.path.join(directory, MANIFEST)) as f:
         manifest = json.load(f)
-    store = _ChunkStore(directory)
+    store = _ChunkStore(directory,
+                        num_ranks=manifest.get("num_ranks_at_save"))
     leaves, treedef = jax.tree_util.tree_flatten(like)
+    saved_treedef = manifest.get("treedef")
+    if saved_treedef is not None and str(treedef) != saved_treedef:
+        raise ValueError(
+            "template tree structure does not match the checkpoint — a "
+            "same-shaped tree in a different structure/order would "
+            "silently permute parameters.\n"
+            f"  saved:    {saved_treedef}\n  template: {treedef}")
     if len(leaves) != len(manifest["leaves"]):
         raise ValueError(
             f"template has {len(leaves)} leaves but checkpoint has "

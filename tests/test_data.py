@@ -626,10 +626,16 @@ def test_svmlight_record_reader(tmp_path):
                  "-1 qid:7 2:1.5\n"
                  "\n"
                  "2,3 1:1.0\n")
-    recs = list(SVMLightRecordReader(3, path=str(p)))
-    assert recs[0] == [0.5, 0.0, 2.0, 1.0]
-    assert recs[1] == [0.0, 1.5, 0.0, -1.0]
-    assert recs[2] == [1.0, 0.0, 0.0, "2,3"]      # multilabel stays raw
+    # multilabel rows require opting in — the label column stays one type
+    with pytest.raises(ValueError, match="multilabel"):
+        list(SVMLightRecordReader(3, path=str(p)))
+    recs = list(SVMLightRecordReader(3, path=str(p), multilabel=True))
+    assert recs[0] == [0.5, 0.0, 2.0, [1.0]]
+    assert recs[1] == [0.0, 1.5, 0.0, [-1.0]]
+    assert recs[2] == [1.0, 0.0, 0.0, [2.0, 3.0]]
+    # without multilabel rows the default parses plain float labels
+    recs1 = list(SVMLightRecordReader(2, text="1 1:0.5\n"))
+    assert recs1 == [[0.5, 0.0, 1.0]]
     assert LibSvmRecordReader is SVMLightRecordReader
     # zero-based + no label
     recs0 = list(SVMLightRecordReader(2, text="1 0:9.0\n", zero_based=True,
