@@ -163,13 +163,12 @@ def validate_case(case: OpTestCase) -> None:
 
 def _check_grad(fn, case: OpTestCase, tensor_idx) -> None:
     import jax
-    from jax.experimental import enable_x64
 
     # The central difference with eps=1e-5 is below float32 noise:
     # without x64 enabled jnp.asarray silently downcasts the f64 inputs
     # and the check produces spurious results.  Enable x64 locally so
     # validate_case is correct even outside the test suite's conftest.
-    with enable_x64():
+    with jax.enable_x64(True):
         _check_grad_x64(fn, case, tensor_idx)
 
 
