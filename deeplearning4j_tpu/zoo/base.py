@@ -56,6 +56,16 @@ class ZooModel:
             return net
         return type(net).load(path)
 
+    def init_pretrained(self, manifest_path: str, cache_dir=None,
+                        fetch_hook=None):
+        """Reference `ZooModel.initPretrained()`: resolve this model's
+        weights through a checksum-verified manifest (fetching into the
+        local cache if needed — see `zoo.manifest.fetch`), then load."""
+        from deeplearning4j_tpu.zoo.manifest import fetch
+        path = fetch(type(self).__name__, manifest_path,
+                     cache_dir=cache_dir, fetch_hook=fetch_hook)
+        return self.pretrained(path)
+
     @staticmethod
     def _load_positional(net, data):
         """Assign `zoo.convert` positional npz keys ("<ordinal>.<param>",

@@ -1,0 +1,132 @@
+"""Pretrained-weight distribution manifest (reference
+`ZooModel.initPretrained()` + `DL4JResources`: hosted checkpoints are
+downloaded to a local cache and checksum-verified before load; a failed
+checksum deletes the file and errors).
+
+This environment has no network egress, so the transport is a pluggable
+*fetch hook*: any callable ``(url, dest_path) -> None``.  The default
+hook uses urllib when the URL scheme is http(s) and plain file copy for
+``file://`` / local paths, which is also what the tests exercise.  The
+manifest itself is a JSON document:
+
+    {"format": "deeplearning4j_tpu.zoo.v1",
+     "models": {"ResNet50": {"file": "resnet50.npz",
+                             "sha256": "...", "bytes": 12345,
+                             "url": "https://host/path/resnet50.npz"}}}
+
+`build_manifest` produces one from a directory of converted artifacts
+(`zoo.convert` output), so a weight host is just "run build_manifest and
+serve the directory".
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+from typing import Callable, Dict, Optional
+
+MANIFEST_NAME = "zoo_manifest.json"
+FORMAT = "deeplearning4j_tpu.zoo.v1"
+
+FetchHook = Callable[[str, str], None]
+
+
+def default_cache_dir() -> str:
+    return os.environ.get(
+        "DL4J_TPU_ZOO_CACHE",
+        os.path.join(os.path.expanduser("~"), ".deeplearning4j_tpu",
+                     "models"))
+
+
+def sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def build_manifest(directory: str, base_url: str = "") -> str:
+    """Scan `directory` for weight artifacts (.npz/.zip) and write a
+    checksum manifest next to them.  Returns the manifest path."""
+    models: Dict[str, Dict] = {}
+    for name in sorted(os.listdir(directory)):
+        if not name.endswith((".npz", ".zip")):
+            continue
+        path = os.path.join(directory, name)
+        model = os.path.splitext(name)[0]
+        models[model] = {
+            "file": name,
+            "sha256": sha256_file(path),
+            "bytes": os.path.getsize(path),
+            "url": (base_url.rstrip("/") + "/" + name) if base_url
+            else name,
+        }
+    out = os.path.join(directory, MANIFEST_NAME)
+    with open(out, "w") as f:
+        json.dump({"format": FORMAT, "models": models}, f, indent=2)
+    return out
+
+
+def load_manifest(path: str) -> Dict[str, Dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("format") != FORMAT:
+        raise ValueError(f"{path}: not a {FORMAT} manifest")
+    return doc["models"]
+
+
+def _default_fetch(url: str, dest: str) -> None:
+    if url.startswith(("http://", "https://")):
+        import urllib.request
+        urllib.request.urlretrieve(url, dest)   # no egress here: hook it
+    else:
+        src = url[len("file://"):] if url.startswith("file://") else url
+        shutil.copyfile(src, dest)
+
+
+def fetch(model: str, manifest_path: str,
+          cache_dir: Optional[str] = None,
+          fetch_hook: Optional[FetchHook] = None,
+          progress: Optional[Callable[[str], None]] = None) -> str:
+    """Return a local, checksum-verified path for `model`'s weights.
+
+    Cache hit (file present AND sha256 matches) returns without calling
+    the hook.  A checksum mismatch after fetch deletes the file and
+    raises — a torn or tampered download must never reach `pretrained()`
+    (reference: `ZooModel.initPretrained` checksum ritual).
+    """
+    entries = load_manifest(manifest_path)
+    if model not in entries:
+        raise KeyError(
+            f"{model!r} not in manifest ({sorted(entries)})")
+    entry = entries[model]
+    cache_dir = cache_dir or default_cache_dir()
+    os.makedirs(cache_dir, exist_ok=True)
+    dest = os.path.join(cache_dir, entry["file"])
+
+    if os.path.exists(dest) and sha256_file(dest) == entry["sha256"]:
+        return dest
+
+    url = entry["url"]
+    if "://" not in url and not os.path.isabs(url):
+        # manifest-relative file (the build_manifest default)
+        url = os.path.join(os.path.dirname(os.path.abspath(manifest_path)),
+                           url)
+    if progress:
+        progress(f"fetching {model} from {url}")
+    tmp = dest + ".part"
+    (fetch_hook or _default_fetch)(url, tmp)
+    got = sha256_file(tmp)
+    if got != entry["sha256"]:
+        os.remove(tmp)
+        raise IOError(
+            f"{model}: checksum mismatch after fetch "
+            f"(want {entry['sha256'][:12]}..., got {got[:12]}...) — "
+            "refusing to cache a corrupt artifact")
+    os.replace(tmp, dest)
+    return dest

@@ -1,0 +1,218 @@
+"""Gradient-coverage specs for the OpValidation sweep.
+
+Reference: `OpValidation.java` validates the analytic gradient of every
+differentiable op (`TestCase.gradientCheck(true)` is the default there);
+non-differentiable ops are explicitly excluded.  This module partitions
+the registry the same way:
+
+- ``AUGMENT``: op -> (tensor-arg indices, FD coordinate sample cap).
+  Each listed op's first spec case gains a finite-difference gradient
+  check on those args (cap 0 = every coordinate; a positive cap samples
+  that many seeded coordinates per arg — the reference's
+  `gradCheckMaxPerParam` — and `OPVAL_FULL=1` removes the cap).
+- ``NONDIFF``: op -> reason it is excluded from gradient checking.
+
+The gate in test_op_validation.py asserts these two sets plus the
+grad-annotated spec cases exactly cover the registry, and that neither
+list is stale.
+"""
+
+# op -> (grad arg indices, sample cap, gtol override or None)
+AUGMENT = {}
+
+
+def _aug(ops, grad=(0,), sample=0, gtol=None):
+    for op in ops:
+        AUGMENT[op] = (tuple(grad), sample, gtol)
+
+
+# ---- reductions / statistics (first-case inputs ~60 elems) ----
+_aug(["max", "min", "std", "norm1", "norm_max", "amax", "amin", "asum",
+      "amean", "moments", "sufficient_statistics"], sample=12)
+_aug(["norm_p", "log_entropy", "shannon_entropy", "median", "nth_element",
+      "cummax", "cummin", "cumsum_ext", "sort", "top_k"])
+_aug(["percentile"], sample=12)
+_aug(["normalize_moments"], grad=(1, 2))
+
+# ---- clipping (kinks are at measure-zero points of the fixed seed) ----
+_aug(["clip", "clip_by_value", "clip_by_norm", "clip_by_avg_norm"])
+_aug(["clip_by_global_norm"], grad=(1, 2))
+
+# ---- selection by predicate ----
+_aug(["where", "select"], grad=(1, 2))
+_aug(["divide_no_nan"], grad=(0, 1))
+
+# ---- shape/data movement (linear maps; catches index arithmetic) ----
+_aug(["transpose", "permute", "reshape", "reshape_onnx", "flatten2d",
+      "expand_dims", "squeeze", "unstack_at", "unstack", "tile", "slice",
+      "slice_onnx", "strided_slice", "tf_strided_slice", "pad",
+      "pad_mode", "mirror_pad", "broadcast_to", "repeat", "flip",
+      "reverse", "roll", "swap_axes", "swap_last2", "moveaxis",
+      "atleast_2d", "ravel", "split_axis", "split_equal",
+      "reverse_sequence", "gather_nd", "take_along_axis"])
+_aug(["concat", "stack", "meshgrid"], grad=(0, 1))
+
+# ---- scatter / segment ----
+_aug(["scatter_sub", "scatter_update", "scatter_max", "scatter_min",
+      "scatter_mul", "scatter_div", "scatter_nd_add", "scatter_nd_sub",
+      "scatter_nd_update", "scatter_nd_max", "scatter_nd_min"],
+     grad=(0, 2))
+_aug(["scatter_nd"], grad=(1,))
+_aug(["sparse_to_dense"], grad=(2,))
+_aug(["segment_max", "segment_min", "segment_prod", "segment_mean",
+      "unsorted_segment_sum", "unsorted_segment_max",
+      "unsorted_segment_min", "unsorted_segment_prod",
+      "unsorted_segment_mean", "unsorted_segment_sqrt_n"])
+_aug(["mergeavg"], grad=(0, 1, 2))
+
+# ---- linear algebra ----
+_aug(["cholesky", "matrix_inverse", "log_matrix_determinant", "slogdet",
+      "logdet", "pinv", "expm", "matrix_band_part", "diag", "diag_part",
+      "tril", "triu", "matrix_diag", "matrix_diag_part", "lu"],
+     gtol=2e-2)
+_aug(["qr", "svd", "eig_sym"], gtol=5e-2)
+_aug(["triangular_solve", "cholesky_solve", "lu_solve", "lstsq"],
+     grad=(0, 1), gtol=2e-2)
+_aug(["matrix_set_diag", "kron"], grad=(0, 1))
+
+# ---- distances / losses ----
+_aug(["manhattan_distance", "cosine_distance_loss", "jaccard_distance",
+      "weighted_cross_entropy_with_logits", "absolute_difference",
+      "huber_loss", "log_loss", "poisson_loss", "log_poisson_loss",
+      "mean_pairwise_squared_error"], grad=(0, 1))
+_aug(["hinge_loss", "knn_mindistance"])
+
+# ---- special functions (grads defined wrt the x argument only) ----
+_aug(["betainc"], grad=(2,))
+_aug(["igamma", "igammac", "polygamma"], grad=(1,))
+_aug(["lbeta", "zeta"])
+
+# ---- activations (inputs seeded away from the measure-zero kinks) ----
+_aug(["relu6", "celu", "gelu_tanh", "hard_sigmoid", "hard_swish",
+      "hard_tanh", "rational_tanh", "rectified_tanh", "thresholded_relu",
+      "prelu", "glu", "standardize"])
+
+# ---- normalization ----
+_aug(["batch_norm", "batch_norm_nchw"], grad=(0, 1, 2, 3, 4), sample=8)
+_aug(["fused_batch_norm"], grad=(0, 1, 2), sample=8)
+_aug(["lrn"], sample=8)
+
+# ---- convolution family (sampled: first-case inputs are realistic) ----
+_aug(["conv1d", "deconv2d", "deconv3d", "depthwise_conv2d",
+      "pointwise_conv2d", "dilation2d"], grad=(0, 1), sample=10)
+_aug(["conv3d", "separable_conv2d", "conv2d_nchw", "deconv2d_nchw"],
+     grad=(0, 1, 2), sample=10)
+_aug(["max_pooling1d", "max_pooling2d", "max_pooling3d", "avg_pooling1d",
+      "avg_pooling2d", "avg_pooling3d", "pnorm_pool2d",
+      "global_avg_pool_nchw", "max_pool2d_nchw", "avg_pool2d_nchw",
+      "max_pool_with_argmax", "upsampling2d", "upsampling3d",
+      "extract_image_patches", "im2col"], sample=10)
+
+# ---- attention / recurrent (weights + inputs, sampled) ----
+_aug(["multi_head_dot_product_attention"], grad=(0, 3, 6), sample=8)
+_aug(["lstm_cell", "lstm_block_cell"], grad=(0, 3, 4, 5), sample=8)
+_aug(["gru_cell", "gru_layer"], grad=(0, 2, 3, 4, 5), sample=8)
+_aug(["lstm_layer", "lstm_layer_full", "lstm_block", "dynamic_rnn",
+      "static_rnn"], grad=(0, 1, 2, 3), sample=8)
+_aug(["dynamic_bidirectional_rnn", "static_bidirectional_rnn"],
+     grad=(0, 1, 2, 4, 5), sample=6)
+_aug(["sru_cell", "sru_layer"], grad=(0, 2, 3), sample=8)
+
+# ---- image ops (linear or piecewise-linear resamplers) ----
+_aug(["rgb_to_grs", "rgb_to_yuv", "yuv_to_rgb", "yiq_to_rgb",
+      "adjust_contrast_v2", "per_image_standardization",
+      "image_central_crop", "image_flip_left_right", "image_flip_up_down",
+      "image_rot90", "space_to_depth", "depth_to_space", "space_to_batch",
+      "batch_to_space", "space_to_batch_nd", "batch_to_space_nd",
+      "crop_and_resize", "resize_bilinear", "resize_bicubic",
+      "resize_lanczos", "image_resize"], sample=8)
+
+
+# ---------------------------------------------------------------------------
+# Non-differentiable ops, each with the reason (reference OpValidation's
+# explicit exclusion list role).
+# ---------------------------------------------------------------------------
+NONDIFF = {}
+
+
+def _nd(ops, reason):
+    for op in ops:
+        NONDIFF[op] = reason
+
+
+_nd(["sign", "floor", "ceil", "round", "rint", "trunc", "zero_fraction",
+     "relu_derivative"],
+    "piecewise-constant output: gradient is zero a.e., FD checks nothing")
+_nd(["mod", "fmod", "remainder", "reverse_mod", "truncate_div",
+     "floor_div"],
+    "discontinuous at quotient boundaries; central FD straddles jumps")
+_nd(["less", "less_equal", "greater", "greater_equal", "equal",
+     "not_equal", "eq", "neq", "gt", "gte", "lt", "lte", "logical_and",
+     "logical_or", "logical_not", "isclose", "equals_with_eps", "isnan",
+     "isinf", "is_finite", "is_finite_all", "is_non_decreasing",
+     "is_strictly_increasing", "is_numeric_tensor", "reduce_any",
+     "reduce_all", "in_top_k", "is_max", "isin", "cell_contains"],
+    "boolean-valued output")
+_nd(["argmax", "argmin", "argsort", "bincount", "histogram",
+     "histogram_fixed_width", "count_nonzero", "count_zero",
+     "confusion_matrix", "matrix_rank", "nonzero", "searchsorted",
+     "bucketize", "invert_permutation", "unravel_index", "shape_of",
+     "size_of", "rank_of", "size_at", "one_hot", "sequence_mask",
+     "hamming_distance", "bits_hamming_distance", "population_count",
+     "mergemaxindex", "hashcode", "broadcast_dynamic_shape",
+     "broadcast_gradient_args"],
+    "integer-valued output / integer index inputs")
+_nd(["unique", "unique_with_counts", "setdiff1d"],
+    "data-dependent output shape (host-side op)")
+_nd(["bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+     "toggle_bits", "shift_left", "shift_right", "cyclic_shift_left",
+     "cyclic_shift_right", "bitcast", "compare_and_bitpack"],
+    "bit-level integer op")
+_nd(["cast"], "dtype conversion; identity-gradient covered by autodiff")
+_nd(["zeros_like", "ones_like", "fill_like", "eye_like", "eye",
+     "linspace", "arange", "full", "tri"],
+    "constant generator: output independent of input values")
+_nd(["random_uniform", "random_normal", "random_bernoulli",
+     "random_exponential", "random_gamma", "random_poisson",
+     "random_lognormal", "random_binomial", "truncated_normal",
+     "random_randint", "random_shuffle", "multinomial", "random_choice",
+     "random_crop", "rng_fold", "rng_fold_opt", "dropout",
+     "dropout_inverted", "alpha_dropout"],
+    "stochastic sampling op")
+_nd(["create_list", "write_list", "read_list", "size_list", "stack_list",
+     "unstack_list", "gather_list", "scatter_list", "split_list",
+     "pick_list", "tear", "tuple_get", "assign", "compare_and_set",
+     "choose", "print_variable", "assert_equal"],
+    "stateful/list/control helper, not a differentiable tensor function")
+_nd(["stop_gradient"],
+    "gradient is intentionally NOT the mathematical derivative")
+_nd(["fake_quant_with_min_max_args", "fake_quant_with_min_max_vars"],
+    "straight-through estimator: analytic grad deliberately differs "
+    "from FD of the quantized forward")
+_nd(["encode_threshold", "decode_threshold", "encode_bitmap",
+     "decode_bitmap"],
+    "gradient-compression codec (int bitstreams)")
+_nd(["fft", "ifft", "fft2", "ifft2", "rfft", "irfft", "eig"],
+    "complex-valued input/output outside the real-valued FD harness")
+_nd(["sgd_updater", "nesterovs_updater", "adam_updater",
+     "rms_prop_updater", "ada_grad_updater", "ada_delta_updater",
+     "ada_max_updater", "nadam_updater", "ams_grad_updater"],
+    "optimizer state-update rule; the reference does not graph-"
+    "differentiate updaters either")
+_nd(["skipgram", "cbow", "barnes_gains", "barnes_symmetrize",
+     "barnes_edge_forces"],
+    "embedding-training / t-SNE helper with integer index inputs")
+_nd(["ctc_greedy_decode", "ctc_beam_decode", "non_max_suppression",
+     "non_max_suppression_overlaps", "draw_bounding_boxes"],
+    "discrete decoding / box-selection algorithm")
+_nd(["rgb_to_hsv", "hsv_to_rgb", "adjust_hue", "adjust_saturation"],
+    "hue-channel selection is piecewise with FD-hostile sector "
+    "boundaries (max/argmax over channels)")
+_nd(["resize_nearest"], "nearest-neighbour resampling is piecewise-"
+    "constant in the input coordinates it drops")
+_nd(["dynamic_partition", "dynamic_stitch"],
+    "list-typed inputs/outputs outside the positional-arg FD harness; "
+    "linearity covered by the partition/stitch round-trip custom case")
+_nd(["col2im"],
+    "tuple-input custom-validated op; it is the adjoint of im2col, "
+    "which is gradient-checked")

@@ -1,0 +1,91 @@
+"""OpValidation specs, part 5: bfloat16 cases for MXU-facing ops.
+
+Reference: the opvalidation corpus runs reduced-precision (half) cases
+for the cuDNN-backed ops; the TPU-native equivalent is bfloat16 — the
+dtype every matmul/conv actually runs in on the MXU.  Each case feeds
+bf16 inputs and compares against an f32 golden computed from the SAME
+bf16-rounded values, so the tolerance only has to absorb bf16
+accumulation error, not input rounding.  No FD grads here (eps=1e-5 is
+far below bf16 resolution); analytic-vs-FD is covered by the f32 cases.
+"""
+import numpy as np
+import ml_dtypes
+
+from tests.opval_specs_core import C
+
+BF16 = np.dtype(ml_dtypes.bfloat16)
+rs = np.random.RandomState(97531)
+
+
+def B(*s, lo=-2.0, hi=2.0):
+    """bf16 tensor arg (values exactly representable in bf16)."""
+    return rs.uniform(lo, hi, s).astype(np.float32).astype(BF16)
+
+
+def BP(*s, lo=0.5, hi=2.0):
+    return rs.uniform(lo, hi, s).astype(np.float32).astype(BF16)
+
+
+def _f32(a):
+    return np.asarray(a, np.float32)
+
+
+_TOL = 5e-2   # bf16 has an 8-bit mantissa: ~0.4% per element + reduce
+
+CASES = [
+    C("matmul", B(16, 32), B(32, 24),
+      g=lambda a, b: _f32(a) @ _f32(b), tol=_TOL, tag="bf16"),
+    C("mmul", B(8, 16), B(16, 8),
+      g=lambda a, b: _f32(a) @ _f32(b), tol=_TOL, tag="bf16"),
+    C("gemm", B(8, 12), B(12, 6),
+      g=lambda a, b, c=None, alpha=1.0, beta=1.0, trans_a=0, trans_b=0:
+      _f32(a) @ _f32(b), tol=_TOL, tag="bf16"),
+    C("tensordot", B(4, 8, 6), B(6, 4, 5), kw={"axes": ([2], [1])},
+      g=lambda a, b, axes=2: np.tensordot(_f32(a), _f32(b), axes),
+      tol=_TOL, tag="bf16"),
+    C("conv2d", B(2, 6, 6, 3, lo=-1, hi=1),
+      B(3, 3, 3, 4, lo=-0.5, hi=0.5),
+      g=lambda x, w, b=None, stride=(1, 1), padding="SAME",
+      dilation=(1, 1): __import__(
+          "tests.opval_specs_configs",
+          fromlist=["_tf_conv2d_golden"])._tf_conv2d_golden(
+          _f32(x), _f32(w), None, stride, padding, dilation),
+      tol=_TOL, tag="bf16"),
+    C("conv2d_nchw", B(2, 3, 5, 5, lo=-1, hi=1),
+      B(4, 3, 3, 3, lo=-0.5, hi=0.5), kw={"pads": (1, 1, 1, 1)},
+      g=lambda x, w, b=None, stride=(1, 1), pads=(1, 1, 1, 1),
+      dilation=(1, 1), groups=1: __import__(
+          "tests.opval_specs_nn",
+          fromlist=["_nchw_conv_golden"])._nchw_conv_golden(
+          _f32(x), _f32(w), None, stride, pads, dilation, groups),
+      tol=_TOL, tag="bf16"),
+    C("depthwise_conv2d", B(2, 6, 6, 3, lo=-1, hi=1),
+      B(3, 3, 1, 6, lo=-0.5, hi=0.5),
+      g=lambda x, w, stride=(1, 1), padding="SAME", dilation=(1, 1):
+      __import__("tests.opval_specs_nn",
+                 fromlist=["_depthwise_golden"])._depthwise_golden(
+          _f32(x), _f32(w), stride, padding, dilation),
+      tol=_TOL, tag="bf16"),
+    C("batch_norm", B(4, 8), B(8, lo=-1, hi=1), BP(8), BP(8, lo=0.5,
+                                                          hi=1.5),
+      B(8, lo=-1, hi=1),
+      g=lambda x, m, v, gamma, beta, eps=1e-5:
+      (_f32(x) - _f32(m)) / np.sqrt(_f32(v) + eps) * _f32(gamma)
+      + _f32(beta), tol=_TOL, tag="bf16"),
+    C("layer_norm", B(6, 16), BP(16), B(16, lo=-1, hi=1),
+      g=lambda x, gain, bias, eps=1e-5, axis=-1:
+      (_f32(x) - _f32(x).mean(-1, keepdims=True))
+      / np.sqrt(_f32(x).var(-1, keepdims=True) + eps) * _f32(gain)
+      + _f32(bias), tol=_TOL, tag="bf16"),
+    C("softmax", B(4, 16, lo=-3, hi=3),
+      g=lambda a, axis=-1: (lambda e: e / e.sum(-1, keepdims=True))(
+          np.exp(_f32(a) - _f32(a).max(-1, keepdims=True))),
+      tol=_TOL, tag="bf16"),
+    C("relu", B(3, 8), g=lambda a: np.maximum(_f32(a), 0.0), tol=_TOL,
+      tag="bf16"),
+    C("dot_product_attention", B(2, 6, 8, lo=-1, hi=1),
+      B(2, 6, 8, lo=-1, hi=1), B(2, 6, 8, lo=-1, hi=1),
+      g=lambda q, k, v, mask=None, scaled=True: __import__(
+          "tests.opval_specs_nn", fromlist=["_dpa_golden"])._dpa_golden(
+          _f32(q), _f32(k), _f32(v)), tol=_TOL, tag="bf16"),
+]

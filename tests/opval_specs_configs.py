@@ -1,0 +1,330 @@
+"""OpValidation specs, part 4: multi-config coverage for the
+stride/dilation/padding/layout-sensitive op families.
+
+Reference: the opvalidation corpus carries many cases per conv/pool/rnn
+op across configs (`platform-tests/.../opvalidation/LayerOpValidation.java`
+et al.) — exactly the class of coverage that catches orientation and
+padding-convention bugs (the round-4 deconv spatial flip hid in the one
+unexercised config).  Goldens here are TF / torch / closed-form numpy,
+never re-derivations of the op impls.  Asymmetric-SAME cases use TF
+directly because XLA string padding follows TF's asymmetric convention.
+"""
+import numpy as np
+
+from tests.opval_specs_nn import (C, F, FP, _depthwise_golden,
+                                  _gru_cell_golden, _gru_layer_golden,
+                                  _lstm_cell_golden, _lstm_layer_golden,
+                                  _lstm_layer_full_golden,
+                                  _nchw_conv_golden, _rnn_golden,
+                                  _sru_golden, _conv1d_golden,
+                                  _conv3d_golden)
+
+
+def _tf():
+    import tensorflow as tf
+    return tf
+
+
+def _tf_conv2d_golden(x, w, b=None, stride=(1, 1), padding="SAME",
+                      dilation=(1, 1)):
+    tf = _tf()
+    y = tf.nn.conv2d(x.astype(np.float64), w.astype(np.float64),
+                     strides=(1,) + tuple(stride) + (1,), padding=padding,
+                     dilations=(1,) + tuple(dilation) + (1,)).numpy()
+    return y if b is None else y + b
+
+
+def _tf_depthwise_golden(x, w, stride=(1, 1), padding="SAME",
+                         dilation=(1, 1)):
+    tf = _tf()
+    kh, kw = w.shape[:2]
+    ci = x.shape[-1]
+    # repo layout (kh, kw, 1, ci*mult) with group-major channel order ==
+    # TF's (kh, kw, ci, mult) after reshape
+    wt = w.reshape(kh, kw, ci, -1)
+    return tf.nn.depthwise_conv2d(
+        x.astype(np.float64), wt.astype(np.float64),
+        strides=(1,) + tuple(stride) + (1,), padding=padding,
+        dilations=tuple(dilation)).numpy()
+
+
+def _tf_separable_golden(x, wd, wp, stride=(1, 1), padding="SAME"):
+    tf = _tf()
+    return tf.nn.separable_conv2d(
+        x.astype(np.float64), wd.astype(np.float64),
+        wp.astype(np.float64), strides=(1,) + tuple(stride) + (1,),
+        padding=padding).numpy()
+
+
+def _tf_deconv2d_golden(x, w, b=None, stride=(2, 2), padding="SAME"):
+    tf = _tf()
+    B, H, W, ci = x.shape
+    co = w.shape[3]
+    y = tf.nn.conv2d_transpose(
+        x.astype(np.float64),
+        w.transpose(0, 1, 3, 2).astype(np.float64),
+        output_shape=(B, H * stride[0], W * stride[1], co),
+        strides=(1,) + tuple(stride) + (1,), padding=padding).numpy()
+    return y if b is None else y + b
+
+
+def _tf_pool_golden(mode):
+    def g(x, kernel=(2, 2), stride=(2, 2), padding="VALID"):
+        tf = _tf()
+        fn = tf.nn.max_pool2d if mode == "max" else tf.nn.avg_pool2d
+        return fn(x.astype(np.float64), kernel,
+                  (1,) + tuple(stride) + (1,), padding).numpy()
+    return g
+
+
+def _tf_resize_golden(method, antialias=True):
+    def g(x, size):
+        tf = _tf()
+        return tf.image.resize(x.astype(np.float32), size, method=method,
+                               antialias=antialias).numpy()
+    return g
+
+
+def _nchw_conv_asym_golden(x, w, b=None, stride=(1, 1),
+                           pads=(0, 0, 0, 0), dilation=(1, 1), groups=1):
+    """pads = (top, left, bottom, right): explicit-pad then VALID conv —
+    pins the pads ordering convention, which symmetric cases can't."""
+    xp = np.pad(x, ((0, 0), (0, 0), (pads[0], pads[2]),
+                    (pads[1], pads[3])))
+    return _nchw_conv_golden(xp, w, b, stride, (0, 0, 0, 0), dilation,
+                             groups)
+
+
+def _win_pool3d_golden(mode):
+    def g(x, kernel=(2, 2, 2), stride=(1, 1, 1), padding="VALID"):
+        from numpy.lib.stride_tricks import sliding_window_view
+        v = sliding_window_view(x, kernel, axis=(1, 2, 3))
+        v = v[:, ::stride[0], ::stride[1], ::stride[2]]
+        return v.max((-3, -2, -1)) if mode == "max" else v.mean(
+            (-3, -2, -1))
+    return g
+
+
+rs = np.random.RandomState(4321)
+CASES = []
+
+# ---- conv2d NHWC: asymmetric SAME under stride, and dilation ----
+_x66 = F(2, 6, 6, 3)
+_w333 = F(3, 3, 3, 4, lo=-0.5, hi=0.5)
+CASES += [
+    # 6x6, k3, s2, SAME -> XLA pads (0,1)x(0,1): the asymmetric case
+    C("conv2d", _x66, _w333, kw={"stride": (2, 2), "padding": "SAME"},
+      g=_tf_conv2d_golden, tol=1e-4, grad=(0, 1), grad_sample=8,
+      gtol=2e-2, tag="same-s2-asym"),
+    C("conv2d", _x66, _w333, kw={"dilation": (2, 2), "padding": "SAME"},
+      g=_tf_conv2d_golden, tol=1e-4, grad=(0, 1), grad_sample=8,
+      gtol=2e-2, tag="dilated-same"),
+    C("conv1d", F(2, 8, 3), F(3, 3, 5, lo=-0.5, hi=0.5),
+      kw={"stride": 2, "padding": "VALID"}, g=_conv1d_golden, tol=1e-4,
+      grad=(0, 1), grad_sample=8, gtol=2e-2, tag="s2-valid"),
+    C("conv1d", F(2, 8, 3), F(3, 3, 5, lo=-0.5, hi=0.5),
+      kw={"dilation": 2, "padding": "SAME"}, g=_conv1d_golden, tol=1e-4,
+      tag="dilated-same"),
+    C("conv3d", F(1, 4, 4, 4, 2), F(2, 2, 2, 2, 3, lo=-0.5, hi=0.5),
+      kw={"stride": (2, 2, 2), "padding": "VALID"},
+      g=lambda x, w, b=None, stride=(2, 2, 2), padding="VALID":
+      _conv3d_golden(x, w, b, stride, padding), tol=1e-4,
+      grad=(0, 1), grad_sample=8, gtol=2e-2, tag="s2-valid"),
+    C("depthwise_conv2d", _x66, F(3, 3, 1, 6, lo=-0.5, hi=0.5),
+      kw={"stride": (2, 2), "padding": "SAME"},
+      g=lambda x, w, stride=(1, 1), padding="SAME":
+      _tf_depthwise_golden(x, w, stride, padding), tol=1e-4,
+      grad=(0, 1), grad_sample=8, gtol=2e-2, tag="same-s2-asym"),
+    C("depthwise_conv2d", _x66, F(3, 3, 1, 6, lo=-0.5, hi=0.5),
+      kw={"dilation": (2, 2)}, g=_depthwise_golden, tol=1e-4,
+      tag="dilated"),
+    C("separable_conv2d", _x66, F(3, 3, 3, 2, lo=-0.5, hi=0.5),
+      F(1, 1, 6, 4, lo=-0.5, hi=0.5),
+      kw={"stride": (2, 2), "padding": "SAME"},
+      g=_tf_separable_golden, tol=1e-4, grad=(0, 1, 2), grad_sample=8,
+      gtol=2e-2, tag="same-s2-asym"),
+    C("pointwise_conv2d", F(2, 5, 3, 7), F(1, 1, 7, 2, lo=-0.5, hi=0.5),
+      g=lambda x, w: np.einsum("bhwi,io->bhwo", x, w.reshape(7, 2)),
+      tol=1e-4, tag="rect"),
+    C("deconv2d", F(2, 3, 3, 2), F(3, 3, 2, 4, lo=-0.5, hi=0.5),
+      kw={"stride": (2, 2), "padding": "SAME"},
+      g=lambda x, w, b=None, stride=(2, 2), padding="SAME":
+      _tf_deconv2d_golden(x, w, b, stride, padding), tol=1e-4,
+      grad=(0, 1), grad_sample=8, gtol=2e-2, tag="same-s2"),
+    # NCHW: asymmetric explicit pads pin the (top,left,bottom,right)
+    # ordering; a groups case pins grouped-channel layout
+    C("conv2d_nchw", F(2, 3, 5, 5), F(4, 3, 3, 3, lo=-0.5, hi=0.5),
+      kw={"pads": (0, 1, 2, 0)}, g=_nchw_conv_asym_golden, tol=1e-4,
+      grad=(0, 1), grad_sample=8, gtol=2e-2, tag="asym-pads"),
+    C("conv2d_nchw", F(2, 4, 5, 5), F(6, 2, 3, 3, lo=-0.5, hi=0.5),
+      kw={"pads": (1, 1, 1, 1), "groups": 2}, g=_nchw_conv_golden,
+      tol=1e-4, tag="groups2"),
+]
+
+# ---- pooling configs ----
+_x55 = F(2, 5, 5, 3)
+CASES += [
+    C("max_pooling2d", _x66, kw={"kernel": (3, 3), "stride": (1, 1),
+                                 "padding": "SAME"},
+      g=_tf_pool_golden("max"), grad=(0,), grad_sample=8,
+      tag="k3-s1-same"),
+    C("max_pooling2d", _x66, kw={"kernel": (3, 3), "stride": (2, 2),
+                                 "padding": "SAME"},
+      g=_tf_pool_golden("max"), tag="k3-s2-same-asym"),
+    C("avg_pooling2d", _x66, kw={"kernel": (3, 3), "stride": (1, 1),
+                                 "padding": "SAME"},
+      g=_tf_pool_golden("avg"), tol=1e-5, grad=(0,), grad_sample=8,
+      tag="k3-s1-same"),
+    C("avg_pooling2d", _x55, kw={"kernel": (2, 2), "stride": (2, 2),
+                                 "padding": "SAME"},
+      g=_tf_pool_golden("avg"), tol=1e-5, tag="k2-s2-same-asym"),
+    C("max_pooling1d", F(2, 8, 3), kw={"kernel": 3, "stride": 1,
+                                       "padding": "SAME"},
+      g=lambda x, kernel=2, stride=2, padding="VALID": __import__(
+          "torch.nn.functional", fromlist=["max_pool1d"]).max_pool1d(
+          __import__("torch").from_numpy(
+              x.transpose(0, 2, 1)).double(), kernel, stride,
+          padding=1).numpy().transpose(0, 2, 1), tag="k3-s1-same"),
+    C("avg_pooling1d", F(2, 8, 3), kw={"kernel": 3, "stride": 1,
+                                       "padding": "SAME"},
+      g=lambda x, kernel=2, stride=2, padding="VALID": _tf().nn.avg_pool1d(
+          x.astype(np.float64), kernel, stride, "SAME").numpy(),
+      tol=1e-5, tag="k3-s1-same"),
+    C("max_pooling3d", F(1, 4, 4, 4, 2), kw={"kernel": (2, 2, 2),
+                                             "stride": (1, 1, 1),
+                                             "padding": "VALID"},
+      g=_win_pool3d_golden("max"), tag="k2-s1-valid"),
+    C("avg_pooling3d", F(1, 4, 4, 4, 2), kw={"kernel": (2, 2, 2),
+                                             "stride": (1, 1, 1),
+                                             "padding": "VALID"},
+      g=_win_pool3d_golden("avg"), tol=1e-5, tag="k2-s1-valid"),
+    C("pnorm_pool2d", FP(2, 4, 4, 3), kw={"p": 2},
+      g=lambda x, kernel=(2, 2), stride=(2, 2), p=2, padding="VALID":
+      np.sqrt((x.reshape(2, 2, 2, 2, 2, 3) ** 2).sum((2, 4))),
+      tol=1e-4, tag="p2"),
+    C("max_pool2d_nchw", F(2, 3, 6, 6), kw={"pads": (1, 1, 1, 1)},
+      g=lambda x, kernel=(2, 2), stride=(2, 2), pads=(0, 0, 0, 0):
+      __import__("torch.nn.functional", fromlist=["max_pool2d"])
+      .max_pool2d(__import__("torch").from_numpy(x).double(), kernel,
+                  stride, padding=1).numpy(), tag="pads1"),
+    C("avg_pool2d_nchw", F(2, 3, 6, 6),
+      g=lambda x, kernel=(2, 2), stride=(2, 2), pads=(0, 0, 0, 0),
+      count_include_pad=False: x.reshape(2, 3, 3, 2, 3, 2).mean((3, 5)),
+      tol=1e-5, tag="valid"),
+    C("upsampling2d", F(2, 3, 3, 2), kw={"scale": 3},
+      g=lambda x, scale=2: np.repeat(np.repeat(x, scale, 1), scale, 2),
+      tag="scale3"),
+    C("upsampling3d", F(1, 2, 2, 2, 2), kw={"size": 3},
+      g=lambda x, size=2: np.repeat(np.repeat(np.repeat(
+          x, size, 1), size, 2), size, 3), tag="size3"),
+    C("lrn", F(2, 4, 4, 8),
+      g=lambda x, k=2.0, n=5, alpha=1e-4, beta=0.75: __import__(
+          "torch.nn.functional", fromlist=["local_response_norm"])
+      .local_response_norm(
+          __import__("torch").from_numpy(
+              x.transpose(0, 3, 1, 2)).double(), n, alpha * n, beta, k)
+      .numpy().transpose(0, 2, 3, 1), tol=1e-4, tag="defaults"),
+]
+
+# ---- normalization configs ----
+CASES += [
+    C("batch_norm", F(2, 3, 3, 4), F(4), FP(4, lo=0.5, hi=2.0),
+      kw={"eps": 1e-3},
+      g=lambda x, m, v, gamma=None, beta=None, eps=1e-5:
+      (x - m) / np.sqrt(v + eps), tol=1e-5, tag="4d-noaffine"),
+    C("batch_norm_nchw", F(2, 4, 3, 3), FP(4), F(4), F(4),
+      FP(4, lo=0.5, hi=2.0), kw={"eps": 1e-2},
+      g=lambda x, s, b, m, v, eps=1e-5: __import__(
+          "torch.nn.functional", fromlist=["batch_norm"]).batch_norm(
+          __import__("torch").from_numpy(x).double(),
+          __import__("torch").from_numpy(m).double(),
+          __import__("torch").from_numpy(v).double(),
+          __import__("torch").from_numpy(s).double(),
+          __import__("torch").from_numpy(b).double(),
+          False, 0.0, eps).numpy(), tol=1e-4, tag="eps1e-2"),
+    C("fused_batch_norm", F(3, 2, 2, 5), FP(5), F(5), kw={"eps": 1e-2},
+      g=None, check=None, tag="eps1e-2",
+      custom=lambda fn: np.testing.assert_allclose(
+          np.asarray(fn(_FBN_X, _FBN_S, _FBN_O, eps=1e-2)[0]),
+          _FBN_S * (_FBN_X - _FBN_X.mean((0, 1, 2)))
+          / np.sqrt(_FBN_X.var((0, 1, 2)) + 1e-2) + _FBN_O, atol=1e-4)),
+]
+_FBN_X, _FBN_S, _FBN_O = F(3, 2, 2, 5), FP(5), F(5)
+
+# ---- resize configs (downscale exercises the antialias kernel path) ----
+_r55 = F(1, 5, 5, 2)
+CASES += [
+    C("resize_bilinear", _r55, kw={"size": (3, 3)},
+      g=lambda x, size: _tf_resize_golden("bilinear")(x, size),
+      tol=1e-4, grad=(0,), grad_sample=8, tag="downscale"),
+    C("resize_bilinear", F(1, 4, 4, 2), kw={"size": (7, 5)},
+      g=lambda x, size: _tf_resize_golden("bilinear")(x, size),
+      tol=1e-4, tag="upscale-noninteger"),
+    C("resize_nearest", F(1, 4, 4, 2), kw={"size": (8, 8)},
+      g=lambda x, size: _tf_resize_golden("nearest", False)(x, size),
+      tag="upscale"),
+    C("resize_bicubic", _r55, kw={"size": (3, 3)},
+      g=lambda x, size: _tf_resize_golden("bicubic")(x, size),
+      tol=1e-3, tag="downscale"),
+    C("resize_lanczos", _r55, kw={"size": (3, 3)},
+      g=lambda x, size: _tf_resize_golden("lanczos3")(x, size),
+      tol=1e-3, tag="downscale"),
+    C("image_resize", F(1, 3, 3, 2), kw={"size": (6, 6),
+                                         "method": "nearest"},
+      g=lambda x, size, method: _tf_resize_golden("nearest", False)(
+          x, size), tag="nearest"),
+]
+
+# ---- recurrent configs (different shapes, optional states/biases) ----
+CASES += [
+    C("lstm_cell", F(1, 2), F(1, 3), F(1, 3),
+      F(2, 12, lo=-0.5, hi=0.5), F(3, 12, lo=-0.5, hi=0.5),
+      g=lambda x, h, c, wi, wh: _lstm_cell_golden(x, h, c, wi, wh),
+      tol=1e-4, tag="nobias"),
+    C("gru_cell", F(3, 4), F(3, 2),
+      F(4, 6, lo=-0.5, hi=0.5), F(2, 6, lo=-0.5, hi=0.5),
+      g=lambda x, h, wi, wh: _gru_cell_golden(x, h, wi, wh),
+      tol=1e-4, tag="nobias"),
+    C("lstm_layer", F(1, 3, 2), F(2, 12, lo=-0.5, hi=0.5),
+      F(3, 12, lo=-0.5, hi=0.5), F(12, lo=-0.5, hi=0.5),
+      g=_lstm_layer_golden, tol=1e-4, tag="h3"),
+    C("lstm_layer_full", F(3, 2, 4), F(4, 8, lo=-0.5, hi=0.5),
+      F(2, 8, lo=-0.5, hi=0.5), F(8, lo=-0.5, hi=0.5),
+      g=_lstm_layer_full_golden, tol=1e-4, tag="h2"),
+    C("gru_layer", F(2, 3, 3), F(2, 3, lo=-0.5, hi=0.5),
+      F(3, 9, lo=-0.5, hi=0.5), F(3, 9, lo=-0.5, hi=0.5),
+      F(9, lo=-0.5, hi=0.5), F(9, lo=-0.5, hi=0.5),
+      g=_gru_layer_golden, tol=1e-4, tag="h0"),
+    C("dynamic_rnn", F(2, 4, 3), F(3, 4, lo=-0.5, hi=0.5),
+      F(4, 4, lo=-0.5, hi=0.5), F(4, lo=-0.5, hi=0.5),
+      kw={"h0": F(2, 4, lo=-0.5, hi=0.5),
+          "seq_lengths": np.asarray([1, 4], np.int32)},
+      g=lambda x, w, rw, b=None, h0=None, seq_lengths=None:
+      _rnn_golden(x, w, rw, b, h0, seq_lengths), tol=1e-4,
+      tag="h0-ragged"),
+    C("static_rnn", F(2, 3, 3), F(3, 4, lo=-0.5, hi=0.5),
+      F(4, 4, lo=-0.5, hi=0.5), F(4, lo=-0.5, hi=0.5),
+      kw={"h0": F(2, 4, lo=-0.5, hi=0.5)},
+      g=lambda x, w, rw, b=None, h0=None:
+      _rnn_golden(x, w, rw, b, h0), tol=1e-4, tag="h0"),
+    C("sru_layer", F(2, 2, 2), np.zeros((2, 2), np.float32),
+      F(2, 6, lo=-0.5, hi=0.5), F(4, lo=-0.5, hi=0.5),
+      g=lambda x, c0, w, b: _sru_golden(x, c0, w, b), tol=1e-4,
+      tag="h2"),
+]
+
+#: ops that MUST carry >=2 value-checked configs (the gate in
+#: test_op_validation.py) — the stride/dilation/padding/layout-sensitive
+#: families where single-config passes hide convention bugs.
+CONFIG_CRITICAL = [
+    "conv2d", "conv1d", "conv3d", "depthwise_conv2d", "separable_conv2d",
+    "pointwise_conv2d", "deconv2d", "conv2d_nchw", "deconv2d_nchw",
+    "max_pooling2d", "avg_pooling2d", "max_pooling1d", "avg_pooling1d",
+    "max_pooling3d", "avg_pooling3d", "pnorm_pool2d", "max_pool2d_nchw",
+    "avg_pool2d_nchw", "upsampling2d", "upsampling3d", "lrn",
+    "batch_norm", "batch_norm_nchw", "fused_batch_norm",
+    "resize_bilinear", "resize_nearest", "resize_bicubic",
+    "resize_lanczos", "image_resize", "lstm_cell", "gru_cell",
+    "lstm_layer", "lstm_layer_full", "gru_layer", "dynamic_rnn",
+    "static_rnn", "sru_layer",
+]

@@ -32,11 +32,12 @@ def PSD(n):
     return a @ a.T + n * np.eye(n, dtype=np.float32)
 
 
-def C(op, *args, g=None, kw=None, grad=(), tol=1e-5, gtol=5e-3,
-      check=None, jit=True, custom=None, tag=""):
+def C(op, *args, g=None, kw=None, grad=(), grad_sample=0, tol=1e-5,
+      gtol=5e-3, check=None, jit=True, custom=None, tag=""):
     return OpTestCase(op=op, args=args, kwargs=kw or {}, golden=g,
-                      grad=grad, tol=tol, gtol=gtol, check=check, jit=jit,
-                      custom=custom, tag=tag)
+                      grad=grad, grad_sample=grad_sample, tol=tol,
+                      gtol=gtol, check=check, jit=jit, custom=custom,
+                      tag=tag)
 
 
 CASES = []

@@ -4,15 +4,40 @@ opvalidation test classes under `platform-tests/` — forward goldens,
 shape-function agreement, finite-difference gradients, and a coverage
 gate that FAILS on any registered op with neither a case nor an
 allowlist entry)."""
+import dataclasses
+
 import numpy as np
 import pytest
 
 from deeplearning4j_tpu.autodiff.validation import (coverage_report,
                                                     validate_case)
-from tests import opval_specs_core, opval_specs_misc, opval_specs_nn
+from tests import (opval_specs_bf16, opval_specs_configs,
+                   opval_specs_core, opval_specs_misc, opval_specs_nn)
+from tests.opval_grad_specs import AUGMENT, NONDIFF
 
-ALL_CASES = (opval_specs_core.CASES + opval_specs_nn.CASES
-             + opval_specs_misc.CASES)
+
+def _augment(cases):
+    """Apply the AUGMENT table: each listed op's first non-custom case
+    gains a gradient check (reference: gradientCheck defaults to true in
+    `TestCase.java`; exclusions are explicit)."""
+    todo = dict(AUGMENT)
+    out = []
+    for c in cases:
+        spec = todo.get(c.op)
+        if spec is not None and c.custom is None and not c.grad:
+            grad, sample, gtol = spec
+            c = dataclasses.replace(
+                c, grad=grad, grad_sample=sample,
+                gtol=gtol if gtol is not None else c.gtol)
+            del todo[c.op]
+        out.append(c)
+    assert not todo, f"AUGMENT ops with no augmentable case: {sorted(todo)}"
+    return out
+
+
+ALL_CASES = (_augment(opval_specs_core.CASES + opval_specs_nn.CASES
+                      + opval_specs_misc.CASES)
+             + opval_specs_configs.CASES + opval_specs_bf16.CASES)
 
 # Ops with no validation case, each with a reason (kept deliberately
 # tiny; a stale entry — op gains a case later — fails the gate too).
@@ -34,3 +59,35 @@ def test_registry_coverage():
     assert pct >= 0.90, (
         f"only {pct:.1%} of the registry is value-checked (goldens or "
         "property checks); need >= 90%")
+
+
+def test_gradient_coverage():
+    """Every registered op is either gradient-checked or has an explicit
+    non-differentiability reason — and neither list is stale (reference
+    OpValidation's gradient-coverage gate)."""
+    from deeplearning4j_tpu.autodiff.ops import OP_TABLE
+
+    registered = set(OP_TABLE)
+    graded = {c.op for c in ALL_CASES if c.grad}
+    unknown = (set(NONDIFF) | set(AUGMENT)) - registered
+    assert not unknown, f"grad specs name unregistered ops: {sorted(unknown)}"
+    stale = sorted(graded & set(NONDIFF))
+    assert not stale, f"NONDIFF entries now gradient-checked: {stale}"
+    missing = sorted(registered - graded - set(NONDIFF))
+    assert not missing, (
+        f"{len(missing)} ops neither gradient-checked nor excluded with "
+        f"a reason: {missing}")
+
+
+def test_config_coverage():
+    """Every stride/dilation/padding/layout-sensitive op carries >=2
+    value-checked configs (reference: the multi-case LayerOpValidation
+    corpus; single-config passes hid the round-4 deconv flip)."""
+    from collections import Counter
+
+    counts = Counter(c.op for c in ALL_CASES
+                     if c.golden is not None or c.check is not None
+                     or c.custom is not None)
+    thin = sorted(op for op in opval_specs_configs.CONFIG_CRITICAL
+                  if counts[op] < 2)
+    assert not thin, f"config-critical ops with <2 checked configs: {thin}"

@@ -82,3 +82,56 @@ def test_convert_cli_entry(tmp_path):
     main([src, dst])
     data = np.load(dst)
     assert sum(data[k].size for k in data.files) == 6 * 4 + 4
+
+
+def test_manifest_fetch_and_init_pretrained(tmp_path):
+    """Checksum-verified manifest distribution (reference
+    `ZooModel.initPretrained` + `DL4JResources` cache semantics)."""
+    from deeplearning4j_tpu.zoo import LeNet
+    from deeplearning4j_tpu.zoo.manifest import (build_manifest, fetch,
+                                                 load_manifest,
+                                                 sha256_file)
+
+    host = tmp_path / "host"
+    host.mkdir()
+    net = LeNet(n_classes=10, input_shape=(28, 28, 1)).init_model()
+    flat = np.asarray(net.params())
+    np.savez(host / "LeNet.npz", params=flat)
+
+    mpath = build_manifest(str(host))
+    entries = load_manifest(mpath)
+    assert entries["LeNet"]["sha256"] == sha256_file(
+        str(host / "LeNet.npz"))
+
+    cache = tmp_path / "cache"
+    calls = []
+
+    def hook(url, dest):
+        calls.append(url)
+        import shutil
+        shutil.copyfile(url, dest)
+
+    p1 = fetch("LeNet", mpath, cache_dir=str(cache), fetch_hook=hook)
+    assert len(calls) == 1 and os.path.dirname(p1) == str(cache)
+    # cache hit: the hook is NOT called again
+    p2 = fetch("LeNet", mpath, cache_dir=str(cache), fetch_hook=hook)
+    assert p2 == p1 and len(calls) == 1
+
+    # corrupt fetch -> checksum rejection, nothing cached
+    def bad_hook(url, dest):
+        with open(dest, "wb") as f:
+            f.write(b"garbage")
+
+    os.remove(p1)
+    with pytest.raises(IOError, match="checksum mismatch"):
+        fetch("LeNet", mpath, cache_dir=str(cache), fetch_hook=bad_hook)
+    assert not os.path.exists(p1)
+
+    # end-to-end: init_pretrained resolves through the manifest
+    loaded = LeNet(n_classes=10, input_shape=(28, 28, 1)).init_pretrained(
+        mpath, cache_dir=str(cache), fetch_hook=hook)
+    np.testing.assert_allclose(np.asarray(loaded.params()), flat)
+
+    # unknown model name is a KeyError listing what exists
+    with pytest.raises(KeyError, match="LeNet"):
+        fetch("NoSuchModel", mpath, cache_dir=str(cache), fetch_hook=hook)
