@@ -1594,12 +1594,20 @@ def _max_pool_with_argmax(x, kernel=(2, 2), stride=(2, 2),
     flat_idx = jnp.broadcast_to(hw * C + ch, x.shape).astype(jnp.int32)
     kh, kw = kernel
 
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        lowest = jnp.iinfo(x.dtype).min
+    else:
+        lowest = -jnp.inf
+    dims = (1, kh, kw, 1)
+    strides = (1,) + tuple(stride) + (1,)
+
+    # values via a plain max reduce_window — differentiable (the variadic
+    # value+index reduce below has no JVP, so it runs under stop_gradient
+    # purely to produce the argmax)
+    vals = lax.reduce_window(x, jnp.asarray(lowest, x.dtype), lax.max,
+                             dims, strides, padding)
+
     def both(xv, iv):
-        # max-reduce values and carry the argmax index alongside
-        if jnp.issubdtype(xv.dtype, jnp.integer):
-            lowest = jnp.iinfo(xv.dtype).min
-        else:
-            lowest = -jnp.inf
         # index sentinel = int max so value ties resolve to the real
         # (smaller) index, matching TF's lowest-index contract
         init = (jnp.asarray(lowest, xv.dtype),
@@ -1612,10 +1620,9 @@ def _max_pool_with_argmax(x, kernel=(2, 2), stride=(2, 2),
             return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
 
         return lax.reduce_window(
-            (xv, iv), init, reducer,
-            (1, kh, kw, 1), (1,) + tuple(stride) + (1,), padding)
+            (xv, iv), init, reducer, dims, strides, padding)
 
-    vals, idxs = both(x, flat_idx)
+    _, idxs = both(lax.stop_gradient(x), flat_idx)
     return vals, idxs
 
 

@@ -40,7 +40,9 @@ _aug(["clip_by_global_norm"], grad=(1, 2))
 
 # ---- selection by predicate ----
 _aug(["where", "select"], grad=(1, 2))
-_aug(["divide_no_nan"], grad=(0, 1))
+# (divide_no_nan and svd carry dedicated grad cases in opval_specs_core:
+# the default divide_no_nan case deliberately contains b=0 jump points,
+# and jax only defines the SVD JVP for full_matrices=False)
 
 # ---- shape/data movement (linear maps; catches index arithmetic) ----
 _aug(["transpose", "permute", "reshape", "reshape_onnx", "flatten2d",
@@ -54,14 +56,14 @@ _aug(["concat", "stack", "meshgrid"], grad=(0, 1))
 
 # ---- scatter / segment ----
 _aug(["scatter_sub", "scatter_update", "scatter_max", "scatter_min",
-      "scatter_mul", "scatter_div", "scatter_nd_add", "scatter_nd_sub",
+      "scatter_nd_add", "scatter_nd_sub",
       "scatter_nd_update", "scatter_nd_max", "scatter_nd_min"],
      grad=(0, 2))
 _aug(["scatter_nd"], grad=(1,))
 _aug(["sparse_to_dense"], grad=(2,))
-_aug(["segment_max", "segment_min", "segment_prod", "segment_mean",
+_aug(["segment_max", "segment_min", "segment_mean",
       "unsorted_segment_sum", "unsorted_segment_max",
-      "unsorted_segment_min", "unsorted_segment_prod",
+      "unsorted_segment_min",
       "unsorted_segment_mean", "unsorted_segment_sqrt_n"])
 _aug(["mergeavg"], grad=(0, 1, 2))
 
@@ -70,7 +72,7 @@ _aug(["cholesky", "matrix_inverse", "log_matrix_determinant", "slogdet",
       "logdet", "pinv", "expm", "matrix_band_part", "diag", "diag_part",
       "tril", "triu", "matrix_diag", "matrix_diag_part", "lu"],
      gtol=2e-2)
-_aug(["qr", "svd", "eig_sym"], gtol=5e-2)
+_aug(["qr", "eig_sym"], gtol=5e-2)
 _aug(["triangular_solve", "cholesky_solve", "lu_solve", "lstsq"],
      grad=(0, 1), gtol=2e-2)
 _aug(["matrix_set_diag", "kron"], grad=(0, 1))
@@ -216,3 +218,7 @@ _nd(["dynamic_partition", "dynamic_stitch"],
 _nd(["col2im"],
     "tuple-input custom-validated op; it is the adjoint of im2col, "
     "which is gradient-checked")
+_nd(["scatter_mul", "scatter_div", "segment_prod",
+     "unsorted_segment_prod"],
+    "jax defines no differentiation rule for multiplicative "
+    "scatter/segment reductions (NotImplementedError in the JVP)")

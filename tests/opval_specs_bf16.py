@@ -40,7 +40,7 @@ CASES = [
     C("gemm", B(8, 12), B(12, 6),
       g=lambda a, b, c=None, alpha=1.0, beta=1.0, trans_a=0, trans_b=0:
       _f32(a) @ _f32(b), tol=_TOL, tag="bf16"),
-    C("tensordot", B(4, 8, 6), B(6, 4, 5), kw={"axes": ([2], [1])},
+    C("tensordot", B(4, 8, 6), B(6, 4, 5), kw={"axes": ([2], [0])},
       g=lambda a, b, axes=2: np.tensordot(_f32(a), _f32(b), axes),
       tol=_TOL, tag="bf16"),
     C("conv2d", B(2, 6, 6, 3, lo=-1, hi=1),

@@ -123,7 +123,15 @@ CASES += [
       kw={"stride": 2, "padding": "VALID"}, g=_conv1d_golden, tol=1e-4,
       grad=(0, 1), grad_sample=8, gtol=2e-2, tag="s2-valid"),
     C("conv1d", F(2, 8, 3), F(3, 3, 5, lo=-0.5, hi=0.5),
-      kw={"dilation": 2, "padding": "SAME"}, g=_conv1d_golden, tol=1e-4,
+      kw={"dilation": 2, "padding": "SAME"},
+      # SAME with k3 d2 pads (2,2); the shared helper hard-codes pad=1
+      g=lambda x, w, stride=1, padding="SAME", dilation=2: __import__(
+          "torch.nn.functional", fromlist=["conv1d"]).conv1d(
+          __import__("torch").from_numpy(
+              x.transpose(0, 2, 1)).double(),
+          __import__("torch").from_numpy(
+              w.transpose(2, 1, 0)).double(), None, stride, 2,
+          dilation).numpy().transpose(0, 2, 1), tol=1e-4,
       tag="dilated-same"),
     C("conv3d", F(1, 4, 4, 4, 2), F(2, 2, 2, 2, 3, lo=-0.5, hi=0.5),
       kw={"stride": (2, 2, 2), "padding": "VALID"},
@@ -136,7 +144,9 @@ CASES += [
       _tf_depthwise_golden(x, w, stride, padding), tol=1e-4,
       grad=(0, 1), grad_sample=8, gtol=2e-2, tag="same-s2-asym"),
     C("depthwise_conv2d", _x66, F(3, 3, 1, 6, lo=-0.5, hi=0.5),
-      kw={"dilation": (2, 2)}, g=_depthwise_golden, tol=1e-4,
+      kw={"dilation": (2, 2)},
+      g=lambda x, w, stride=(1, 1), padding="SAME", dilation=(2, 2):
+      _tf_depthwise_golden(x, w, stride, padding, dilation), tol=1e-4,
       tag="dilated"),
     C("separable_conv2d", _x66, F(3, 3, 3, 2, lo=-0.5, hi=0.5),
       F(1, 1, 6, 4, lo=-0.5, hi=0.5),

@@ -88,6 +88,10 @@ CASES += [
     C("divide_no_nan",
       F(4), np.asarray([0.0, 2.0, 0.0, -1.5], np.float32),
       g=lambda a, b: np.where(b == 0, 0.0, a / np.where(b == 0, 1.0, b))),
+    # grad config: denominators bounded away from the b=0 jump (where the
+    # zero-substitution makes FD meaningless by design)
+    C("divide_no_nan", F(4), FP(4, lo=0.5, hi=2.0),
+      g=lambda a, b: a / b, grad=(0, 1), tag="grad"),
     C("squared_difference", _a, _b, g=lambda a, b: (a - b) ** 2,
       grad=(0, 1)),
     C("axpy", np.float32(1.7), F(3), F(3),
@@ -484,6 +488,12 @@ CASES += [
             out[0][:, :out[1].shape[0]] @ np.diag(out[1])
             @ out[2][:out[1].shape[0]],
             np.asarray(CASES_SVD_IN), atol=1e-4))),
+    # grad config: jax defines the SVD JVP only for the reduced form
+    C("svd", F(4, 3), kw={"full_matrices": False},
+      check=lambda out: np.testing.assert_allclose(
+          out[0] @ np.diag(out[1]) @ out[2],
+          np.asarray(CASES_SVD_IN2), atol=1e-4),
+      grad=(0,), gtol=5e-2, tag="reduced-grad"),
     C("eig_sym", _A4, check=lambda out: np.testing.assert_allclose(
         np.asarray(_A4, np.float64) @ out[1],
         out[1] * out[0][None, :], atol=1e-3)),
@@ -529,6 +539,7 @@ CASES += [
 # AFTER this module builds, so regenerate the same arrays by index)
 CASES_QR_IN = [c for c in CASES if c.op == "qr"][0].args[0]
 CASES_SVD_IN = [c for c in CASES if c.op == "svd"][0].args[0]
+CASES_SVD_IN2 = [c for c in CASES if c.op == "svd"][1].args[0]
 
 # ---- distances / reduce3 ----
 _d1, _d2 = F(3, 5), F(3, 5)
