@@ -1,0 +1,68 @@
+"""Composed-parallelism multihost worker (spawned by test_multihost via
+LocalLauncher — NOT a pytest file).
+
+2 processes x 4 local CPU devices = one 8-device global mesh.  The same
+composed dp x tp x pp transformer step from `parallel/composed.py` runs
+twice, with the PROCESS-SPANNING axis chosen differently each time
+(make_mesh reshapes devices in dict order, so the FIRST axis crosses the
+process boundary):
+
+- pass 1: {"model": 2, ...} — tensor parallelism (ring-attention
+  ppermute, all_gather, psum_scatter) rides the gloo inter-process
+  transport;
+- pass 2: {"pipe": 2, ...} — the GPipe activation ppermute crosses
+  processes.
+
+Each pass takes 2 SGD steps and writes its losses; the driver compares
+them to the single-device oracle trajectory (grad correctness across the
+process boundary, not just forward)."""
+import os
+import sys
+
+import numpy as np
+
+from deeplearning4j_tpu.parallel import multihost
+
+multihost.initialize()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.experimental import multihost_utils  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from deeplearning4j_tpu.parallel.composed import (  # noqa: E402
+    composed_train_step, init_stage_params)
+from deeplearning4j_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+out_dir = sys.argv[1]
+rank = multihost.process_index()
+
+S, D, H, FF, B, T = 2, 8, 2, 16, 8, 8
+rng = np.random.RandomState(7)
+params0 = init_stage_params(rng, S, D, H, FF)
+x_np = rng.randn(B, T, D).astype(np.float32) * 0.5
+y_np = rng.randn(B, T, D).astype(np.float32) * 0.5
+
+results = {}
+for tag, axes in (("tp_cross", {"model": 2, "data": 2, "pipe": 2}),
+                  ("pp_cross", {"pipe": 2, "data": 2, "model": 2})):
+    mesh = make_mesh(axes, jax.devices())
+    # identical full batch on every process -> replicated global arrays
+    x = multihost_utils.host_local_array_to_global_array(
+        x_np, mesh, P())
+    y = multihost_utils.host_local_array_to_global_array(
+        y_np, mesh, P())
+    step = composed_train_step(mesh, H, lr=0.2)
+    p = jax.tree_util.tree_map(jnp.asarray, params0)
+    losses = []
+    for _ in range(2):
+        p, loss = step(p, x, y)
+        # the scalar loss is replicated on every device; read it locally
+        losses.append(float(np.asarray(loss.addressable_data(0))))
+    results[tag] = losses
+    print(f"rank {rank}: {tag} mesh={axes} losses={losses}", flush=True)
+
+np.savez(os.path.join(out_dir, f"composed_{rank}.npz"),
+         tp_cross=np.asarray(results["tp_cross"]),
+         pp_cross=np.asarray(results["pp_cross"]))
+print(f"rank {rank}: composed multihost done", flush=True)
