@@ -141,19 +141,16 @@ def bench_bert_long_seq(batch=4, steps=5, t=2048, compute_dtype="bfloat16"):
     return batch * t * steps / dt
 
 
-def bench_bert_tf_import(batch=32, steps=5, t=128, layers=12,
-                         hidden=768, heads=12, vocab=30522):
-    """BASELINE config 3 AS WRITTEN: BERT-base fine-tune via SameDiff TF
-    import — build the frozen GraphDef in TF, import through
-    modelimport.tf_import, attach a trainable head, measure the jitted
-    SameDiff fine-tune step."""
+def build_tf_bert_frozen(batch=32, t=128, layers=12, hidden=768,
+                         heads=12, vocab=30522):
+    """Build the BERT-base-shaped frozen TF GraphDef (BASELINE config 3's
+    source model).  Returns (graph_def, frozen_concrete_fn, encoder_out
+    name) — shared by the bench and the full-depth import-conformance
+    test (`tests/test_modelimport.py`), so the timed path and the
+    value-asserted path are THE SAME graph."""
     import tensorflow as tf
     from tensorflow.python.framework.convert_to_constants import (
         convert_variables_to_constants_v2)
-
-    from deeplearning4j_tpu.autodiff import TrainingConfig
-    from deeplearning4j_tpu.modelimport import import_graph_def
-    from deeplearning4j_tpu.train.updaters import Adam
 
     rs = np.random.RandomState(0)
     H, NH, L, T, B = hidden, heads, layers, t, batch
@@ -209,9 +206,28 @@ def bench_bert_tf_import(batch=32, steps=5, t=128, layers=12,
         tf.function(f).get_concrete_function(
             tf.TensorSpec((B, T), tf.int32)))
     gd = frozen.graph.as_graph_def()
-    sd = import_graph_def(gd)
     # the frozen fn's structured output tensor names the true graph output
     enc = frozen.outputs[0].name.split(":")[0]
+    return gd, frozen, enc
+
+
+def bench_bert_tf_import(batch=32, steps=5, t=128, layers=12,
+                         hidden=768, heads=12, vocab=30522):
+    """BASELINE config 3 AS WRITTEN: BERT-base fine-tune via SameDiff TF
+    import — build the frozen GraphDef in TF, import through
+    modelimport.tf_import, attach a trainable head, measure the jitted
+    SameDiff fine-tune step.  (Values of this exact import path are
+    asserted against TF at full 12-layer depth in
+    tests/test_modelimport.py::test_tf_import_full_depth_bert.)"""
+    from deeplearning4j_tpu.autodiff import TrainingConfig
+    from deeplearning4j_tpu.modelimport import import_graph_def
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    rs = np.random.RandomState(0)
+    H, T, B = hidden, t, batch
+    gd, frozen, enc = build_tf_bert_frozen(batch, t, layers, hidden,
+                                           heads, vocab)
+    sd = import_graph_def(gd)
 
     # trainable MLM head over the imported (constant) encoder
     import jax

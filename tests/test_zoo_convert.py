@@ -70,6 +70,19 @@ def test_resnet50_zip_roundtrip_via_pretrained(tmp_path):
     (got,) = net.output(x)
     np.testing.assert_allclose(np.asarray(got), km.predict(x, verbose=0),
                                rtol=1e-3, atol=1e-4)
+    # the imported weights must be trainable end-to-end: fine-tune on a
+    # small batch and require the loss to decrease (reference: the
+    # transfer-learning-on-initPretrained workflow)
+    rs = np.random.RandomState(2)
+    xb = rs.rand(4, 32, 32, 3).astype(np.float32)
+    yb = np.eye(7, dtype=np.float32)[rs.randint(0, 7, 4)]
+    net.fit(xb, yb)
+    first = float(net.score())
+    scores = []
+    for _ in range(15):
+        net.fit(xb, yb)
+        scores.append(float(net.score()))
+    assert min(scores) < first, (first, scores)
 
 
 def test_convert_cli_entry(tmp_path):
