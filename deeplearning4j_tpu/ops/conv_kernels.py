@@ -97,3 +97,80 @@ def conv3x3_wgrad_xla(x, dy):
         return jnp.sum(y * dy.astype(jnp.float32))
 
     return jax.grad(loss)(w0)
+
+
+# ---------------------------------------------------------------------------
+# dgrad: conv-backward-data (VERDICT r4 #5 — wgrad alone cannot close the
+# 13.2 ms conv backward; dgrad is the other half).
+#
+# For a 3x3 stride-1 SAME conv, dx = SAME-conv(dy, Wt) where
+# Wt[i, j, co, ci] = W[2-i, 2-j, ci, co] (spatial rot180 + channel
+# transpose).  Same shifted-view trick as wgrad: the three row shifts of
+# the padded dy are materialized as stripe-partitionable views outside
+# the kernel; inside, each stripe does NINE [bh*W, Co] x [Co, Ci]
+# matmuls against the pre-flipped filter taps and accumulates in f32 —
+# the dy stripe stays resident in VMEM across all nine taps.
+# ---------------------------------------------------------------------------
+
+def _dgrad_kernel(dyt_ref, dym_ref, dyb_ref, wf_ref, out_ref, *, bh, W,
+                  Ci, Co):
+    wf = wf_ref[...]                             # [9, Co, Ci]
+    acc = jnp.zeros((bh * W, Ci), jnp.float32)
+    for i, ds_ref in enumerate((dyt_ref, dym_ref, dyb_ref)):
+        ds = ds_ref[0]                           # [bh, W+2, Co]
+        for j in range(3):
+            dij = ds[:, j:j + W, :].reshape(bh * W, Co).astype(
+                jnp.float32)
+            acc += jax.lax.dot_general(
+                dij, wf[i * 3 + j], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+    out_ref[0] = acc.reshape(bh, W, Ci)
+
+
+def conv3x3_dgrad_tpu(dy, w, block_rows: int = 0,
+                      interpret: bool = False):
+    """Input gradient of a 3x3 stride-1 SAME NHWC conv.
+
+    dy: [B, H, W, Co] output cotangent, w: [3, 3, Ci, Co] filter
+    -> dx [B, H, W, Ci] float32.
+    """
+    B, H, W, Co = dy.shape
+    Ci = w.shape[2]
+    if w.shape != (3, 3, Ci, Co):
+        raise ValueError(f"w {w.shape} is not [3, 3, Ci, {Co}]")
+    bh = block_rows or max(d for d in (1, 2, 4, 7, 8, 14, 16, 28, 32)
+                           if H % d == 0)
+    dyp = jnp.pad(dy, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    dyt = dyp[:, 0:H]
+    dym = dyp[:, 1:H + 1]
+    dyb = dyp[:, 2:H + 2]
+    # rot180 + channel transpose, one tap per row: wf[i*3+j] = Wt[i, j]
+    wf = jnp.flip(w, (0, 1)).transpose(0, 1, 3, 2).reshape(9, Co, Ci)
+    grid = (B, H // bh)
+
+    dy_spec = pl.BlockSpec((1, bh, W + 2, Co),
+                           lambda b, i: (b, i, 0, 0))
+    return pl.pallas_call(
+        functools.partial(_dgrad_kernel, bh=bh, W=W, Ci=Ci, Co=Co),
+        grid=grid,
+        in_specs=[dy_spec, dy_spec, dy_spec,
+                  pl.BlockSpec((9, Co, Ci), lambda b, i: (0, 0, 0))],
+        out_specs=pl.BlockSpec((1, bh, W, Ci),
+                               lambda b, i: (b, i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, W, Ci), jnp.float32),
+        interpret=interpret,
+    )(dyt, dym, dyb, wf)
+
+
+def conv3x3_dgrad_xla(dy, w):
+    """XLA reference: input grad via autodiff of the forward conv."""
+    B, H, W, Co = dy.shape
+    x0 = jnp.zeros((B, H, W, w.shape[2]), jnp.float32)
+
+    def loss(x):
+        y = jax.lax.conv_general_dilated(
+            x, w.astype(jnp.float32), (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return jnp.sum(y * dy.astype(jnp.float32))
+
+    return jax.grad(loss)(x0)

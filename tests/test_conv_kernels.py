@@ -43,3 +43,40 @@ def test_wgrad_rejects_mismatched_shapes():
     dy = jnp.zeros((1, 4, 8, 4))
     with pytest.raises(ValueError, match="mismatches"):
         conv3x3_wgrad_tpu(x, dy, interpret=True)
+
+
+# ---- dgrad (conv-backward-data) ----
+from deeplearning4j_tpu.ops.conv_kernels import (conv3x3_dgrad_tpu,  # noqa: E402
+                                                 conv3x3_dgrad_xla)
+
+
+@pytest.mark.parametrize("B,H,W,Ci,Co", [
+    (2, 8, 8, 8, 16),       # even rows, bh=8
+    (1, 7, 7, 16, 8),       # odd rows, bh=7 (the ResNet 7x7 tail shape)
+    (2, 14, 14, 8, 8),      # bh=14
+])
+def test_dgrad_matches_xla(B, H, W, Ci, Co):
+    dy = jnp.asarray(rs.randn(B, H, W, Co).astype(np.float32) * 0.5)
+    w = jnp.asarray(rs.randn(3, 3, Ci, Co).astype(np.float32) * 0.5)
+    got = np.asarray(conv3x3_dgrad_tpu(dy, w, interpret=True))
+    want = np.asarray(conv3x3_dgrad_xla(dy, w))
+    assert got.shape == (B, H, W, Ci)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_dgrad_bf16_inputs_accumulate_f32():
+    dy = jnp.asarray(rs.randn(2, 8, 8, 8).astype(np.float32))
+    w = jnp.asarray(rs.randn(3, 3, 8, 8).astype(np.float32) * 0.3)
+    got = np.asarray(conv3x3_dgrad_tpu(dy.astype(jnp.bfloat16),
+                                       w.astype(jnp.bfloat16),
+                                       interpret=True))
+    want = np.asarray(conv3x3_dgrad_xla(dy, w))
+    assert got.dtype == np.float32
+    np.testing.assert_allclose(got, want, rtol=3e-2, atol=0.12)
+
+
+def test_dgrad_rejects_bad_filter():
+    dy = jnp.zeros((1, 8, 8, 4))
+    w = jnp.zeros((5, 5, 4, 4))
+    with pytest.raises(ValueError, match="not \\[3, 3"):
+        conv3x3_dgrad_tpu(dy, w, interpret=True)

@@ -302,6 +302,41 @@ def stage_wgrad_ab(quick):
     return out
 
 
+@guard("7_dgrad_ab")
+def stage_dgrad_ab(quick):
+    """Pallas 3x3 dgrad kernel vs XLA's conv-backward-data at the
+    ResNet-50 block shapes (VERDICT r4 #5: wgrad covers only half the
+    13.2 ms conv backward).  Includes the pad+views pre-pass in its
+    timing — the honest end-to-end cost."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.conv_kernels import (conv3x3_dgrad_tpu,
+                                                     conv3x3_dgrad_xla)
+    rs = np.random.RandomState(0)
+    out = {}
+    shapes = [(64, 56, 56, 64, 64), (64, 28, 28, 128, 128),
+              (64, 14, 14, 256, 256), (64, 7, 7, 512, 512)]
+    for B, H, W, Ci, Co in (shapes[:2] if quick else shapes):
+        dy = jnp.asarray(rs.randn(B, H, W, Co).astype(np.float32) * 0.1
+                         ).astype(jnp.bfloat16)
+        w = jnp.asarray(rs.randn(3, 3, Ci, Co).astype(np.float32) * 0.1
+                        ).astype(jnp.bfloat16)
+        pallas_fn = jax.jit(conv3x3_dgrad_tpu)
+        xla_fn = jax.jit(conv3x3_dgrad_xla)
+        got = pallas_fn(dy, w)
+        want = xla_fn(dy, w)
+        jax.block_until_ready((got, want))
+        err = float(jnp.max(jnp.abs(got - want)))
+        tp = timeit(lambda: pallas_fn(dy, w),
+                    lambda: jax.block_until_ready(pallas_fn(dy, w)))
+        tx = timeit(lambda: xla_fn(dy, w),
+                    lambda: jax.block_until_ready(xla_fn(dy, w)))
+        out[f"{H}x{W}x{Ci}"] = {
+            "pallas_ms": round(tp * 1e3, 3), "xla_ms": round(tx * 1e3, 3),
+            "speedup": round(tx / tp, 3), "max_err": err}
+    return out
+
+
 def main():
     quick = "--quick" in sys.argv
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -320,6 +355,7 @@ def main():
     stage_ln_ab(quick)
     stage_conv_layout(quick)
     stage_wgrad_ab(quick)
+    stage_dgrad_ab(quick)
     print("[playbook] DONE", flush=True)
 
 
