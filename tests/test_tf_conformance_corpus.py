@@ -386,6 +386,42 @@ def _g(x):
     return tf.reshape(y, [tf.shape(y)[0], -1])
 
 
+# ---- Shape-derived scalar inputs (the _static_value fallback: size/k/
+# axis/multiples arriving from integer Shape subgraphs, not Consts) ----
+
+@case("topk-k-from-shape", [spec(3, 8)], [F(3, 8)])
+def _g(x):
+    # k = rank-derived scalar (Shape -> StridedSlice -> floordiv)
+    k = tf.shape(x)[1] // 4
+    vals, idx = tf.math.top_k(x, k=k)
+    return vals, tf.cast(idx, tf.int32)
+
+
+@case("resize-size-from-shape", [spec(1, 4, 6, 2)], [F(1, 4, 6, 2)])
+def _g(x):
+    # target size = 2x the input's own (static) spatial shape
+    sz = tf.shape(x)[1:3] * 2
+    return tf.image.resize(x, sz, method="nearest")
+
+
+@case("tile-reps-from-shape", [spec(2, 3)], [F(2, 3)])
+def _g(x):
+    reps = tf.stack([tf.shape(x)[1] // 3, 2])
+    return tf.tile(x, reps)
+
+
+@case("fill-dims-from-shape", [spec(2, 5)], [F(2, 5)])
+def _g(x):
+    dims = tf.shape(x) + 1
+    return tf.fill(dims, 0.5) + tf.reduce_mean(x)
+
+
+@case("cumsum-axis-from-rank", [spec(2, 6)], [F(2, 6)])
+def _g(x):
+    axis = tf.rank(x) - 1
+    return tf.cumsum(x, axis=axis)
+
+
 @pytest.mark.parametrize("name,fn,specs,inputs,tol", CORPUS,
                          ids=[c[0] for c in CORPUS])
 def test_tf_graph_conformance(name, fn, specs, inputs, tol):
