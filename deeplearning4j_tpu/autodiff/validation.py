@@ -100,6 +100,12 @@ def _compare(got, want, tol, what):
         if g.dtype == bool or np.issubdtype(g.dtype, np.integer):
             np.testing.assert_array_equal(
                 g, w.astype(g.dtype), err_msg=f"{what} leaf {i}")
+        elif np.issubdtype(g.dtype, np.complexfloating):
+            # compare as complex — a float64 cast would silently drop
+            # the imaginary half of every FFT-family check
+            np.testing.assert_allclose(
+                g.astype(np.complex128), w.astype(np.complex128),
+                rtol=tol, atol=tol, err_msg=f"{what} leaf {i}")
         else:
             np.testing.assert_allclose(
                 g.astype(np.float64), w.astype(np.float64),
