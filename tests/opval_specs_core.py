@@ -308,9 +308,9 @@ _t3 = F(2, 3, 4)
 CASES += [
     C("matmul", F(3, 4), F(4, 5), g=np.matmul, grad=(0, 1)),
     C("mmul", F(3, 4), F(4, 5), g=np.matmul, grad=(0, 1)),
-    C("batched_matmul", F(2, 3, 4), F(2, 4, 5), g=np.matmul, grad=(0, 1)),
+    C("batched_matmul", F(2, 3, 4), F(2, 4, 5), g=np.matmul, grad=(0, 1), grad_sample=16),
     C("tensordot", F(2, 3, 4), F(3, 4, 5),
-      g=lambda a, b, axes=2: np.tensordot(a, b, axes), grad=(0, 1)),
+      g=lambda a, b, axes=2: np.tensordot(a, b, axes), grad=(0, 1), grad_sample=16),
     C("transpose", _t3, g=lambda a, perm=None: np.transpose(a, perm),
       kw={"perm": (2, 0, 1)}),
     C("permute", _t3, (1, 2, 0), g=lambda a, p: np.transpose(a, p)),

@@ -46,7 +46,8 @@ CASES += [
     C("rgb_to_hsv", _img, g=_hsv_golden, tol=1e-4),
     C("hsv_to_rgb", _hsv_golden(F01(2, 4, 4, 3)).astype(np.float32),
       g=_hsv_inv_golden, tol=1e-4),
-    C("rgb_to_yiq", _img, g=lambda x: x @ _YIQ_M.T, tol=1e-4, grad=(0,)),
+    C("rgb_to_yiq", _img, g=lambda x: x @ _YIQ_M.T, tol=1e-4, grad=(0,),
+      grad_sample=12),
     C("yiq_to_rgb", (_img @ _YIQ_M.T).astype(np.float32),
       g=lambda x: x @ np.linalg.inv(_YIQ_M).T, tol=1e-4),
     C("rgb_to_yuv", _img, g=lambda x: x @ _YUV_M.T, tol=1e-4),
@@ -59,7 +60,7 @@ CASES += [
       kw={"factor": 1.4}, tol=1e-3),
     C("adjust_contrast", _img, g=lambda x, factor:
       _tf().image.adjust_contrast(x, factor).numpy().astype(np.float64),
-      kw={"factor": 1.8}, tol=1e-4, grad=(0,)),
+      kw={"factor": 1.8}, tol=1e-4, grad=(0,), grad_sample=12),
     C("adjust_contrast_v2", _img, g=lambda x, factor:
       _tf().image.adjust_contrast(x, factor).numpy().astype(np.float64),
       kw={"factor": 0.6}, tol=1e-4),
@@ -124,7 +125,8 @@ CASES += [
     C("resize_lanczos", np.ones((1, 4, 4, 1), np.float32), (6, 6),
       g=lambda x, size: np.ones((1, 6, 6, 1)), tol=1e-4),
     C("resize_area", F01(1, 6, 6, 2), (3, 3), g=lambda x, size:
-      x.reshape(1, 3, 2, 3, 2, 2).mean((2, 4)), tol=1e-5, grad=(0,)),
+      x.reshape(1, 3, 2, 3, 2, 2).mean((2, 4)), tol=1e-5, grad=(0,),
+      grad_sample=12),
 ]
 
 # ---- nms / boxes ----

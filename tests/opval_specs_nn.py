@@ -188,7 +188,7 @@ _img = F(2, 6, 6, 3)
 _w33 = F(3, 3, 3, 4, lo=-0.5, hi=0.5)
 CASES += [
     C("conv2d", _img, _w33, F(4), g=_nhwc_conv_golden, tol=1e-4,
-      grad=(0, 1), gtol=2e-2),
+      grad=(0, 1), grad_sample=12, gtol=2e-2),
     C("conv2d", _img, _w33, kw={"stride": (2, 2), "padding": "VALID"},
       g=_nhwc_conv_golden, tol=1e-4, tag="valid-s2"),
     C("depthwise_conv2d", _img, F(3, 3, 1, 6, lo=-0.5, hi=0.5),
@@ -360,7 +360,7 @@ def _dpa_golden(q, k, v, mask=None, scaled=True):
 _amask = (rs.rand(2, 4) > 0.3).astype(np.float32)
 CASES += [
     C("dot_product_attention", _q, _k, _v, g=_dpa_golden, tol=1e-4,
-      grad=(0, 1, 2), gtol=2e-2),
+      grad=(0, 1, 2), grad_sample=12, gtol=2e-2),
     C("dot_product_attention", _q, _k, _v, _amask, g=_dpa_golden,
       tol=1e-4, tag="masked"),
 ]
