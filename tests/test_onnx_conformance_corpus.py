@@ -80,6 +80,35 @@ case("batchnorm-inference",
                               _t(_bn_b), False, 0.0, 1e-4).numpy(),
      tol=1e-4)
 
+_w_asym = F(4, 3, 3, 3, lo=-0.4, hi=0.4)
+case("conv-asym-pads",
+     # ONNX pads = [t, l, b, r]: asymmetric values pin the ordering
+     [_N("Conv", ["x", "w"], ["y"], attr_ints("pads", [0, 1, 2, 0]),
+         attr_ints("strides", [1, 1]),
+         attr_ints("kernel_shape", [3, 3]))],
+     {"x": _x_img}, {"w": _w_asym},
+     lambda x: TTF.conv2d(
+         _t(np.pad(x, ((0, 0), (0, 0), (0, 2), (1, 0)))),
+         _t(_w_asym)).numpy())
+
+_w_grp = F(6, 1, 3, 3, lo=-0.4, hi=0.4)   # groups=3 over Ci=3
+case("conv-groups",
+     [_N("Conv", ["x", "w"], ["y"], attr_i("group", 3),
+         attr_ints("pads", [1, 1, 1, 1]),
+         attr_ints("kernel_shape", [3, 3]))],
+     {"x": _x_img}, {"w": _w_grp},
+     lambda x: TTF.conv2d(_t(x), _t(_w_grp), padding=1,
+                          groups=3).numpy())
+
+_w_dil_dec = F(2, 3, 2, 2, lo=-0.4, hi=0.4)
+case("convtranspose-dilated",
+     [_N("ConvTranspose", ["x", "w"], ["y"],
+         attr_ints("dilations", [2, 2]),
+         attr_ints("kernel_shape", [2, 2]))],
+     {"x": F(1, 2, 4, 4)}, {"w": _w_dil_dec},
+     lambda x: TTF.conv_transpose2d(_t(x), _t(_w_dil_dec),
+                                    dilation=2).numpy())
+
 # ---- linalg ----
 _gw = F(5, 4, lo=-0.5, hi=0.5)
 _gc = F(5)
