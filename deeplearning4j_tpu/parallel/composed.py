@@ -130,11 +130,15 @@ def block_oracle(p, h, n_heads: int):
 
 def composed_apply(stacked, x, mesh: Mesh, n_heads: int,
                    data_axis: str = "data", tp_axis: str = "model",
-                   pipe_axis: str = "pipe", num_microbatches=None):
+                   pipe_axis: str = "pipe", num_microbatches=None,
+                   remat: bool = False):
     """Forward through S pipelined sequence-parallel TP blocks.
 
     x: [B, T, D] with B sharded over `data_axis` and T over `tp_axis`.
     stacked: `init_stage_params` tree (leaves [S, ...]).
+    `remat=True` wraps the per-tick block in `jax.checkpoint` — at real
+    scale the pipeline holds M+S-1 ticks of activations live through the
+    backward pass, exactly where rematerialization pays (HBM for FLOPs).
     Returns [B, T, D] with the same sharding.
     """
     S = mesh.shape[pipe_axis]
@@ -148,6 +152,10 @@ def composed_apply(stacked, x, mesh: Mesh, n_heads: int,
     specs = stage_specs(tp_axis, pipe_axis)
     in_x = P(None, data_axis, tp_axis, None)     # [M, mb, T, D]
 
+    block = block_sp
+    if remat:
+        block = jax.checkpoint(block_sp, static_argnums=(2, 3))
+
     @partial(shard_map, mesh=mesh, in_specs=(specs, in_x),
              out_specs=in_x, check_vma=False)
     def run(params, xs_loc):
@@ -159,7 +167,7 @@ def composed_apply(stacked, x, mesh: Mesh, n_heads: int,
             incoming, outputs = carry
             inject = xs_loc[jnp.minimum(t, M - 1)]
             act_in = jnp.where(stage == 0, inject, incoming)
-            y = block_sp(p_local, act_in, n_heads, tp_axis)
+            y = block(p_local, act_in, n_heads, tp_axis)
             out_idx = t - (S - 1)
             valid = jnp.logical_and(stage == S - 1, out_idx >= 0)
             outputs = jax.lax.dynamic_update_index_in_dim(
@@ -194,7 +202,7 @@ def composed_oracle(stacked, x, n_heads: int):
 
 
 def composed_train_step(mesh: Mesh, n_heads: int, lr: float = 0.1,
-                        **axes):
+                        remat: bool = False, **axes):
     """Build the jitted full train step: forward through the 3D-parallel
     stack, MSE loss, grads, SGD update.  Returns step(params, x, y) ->
     (new_params, loss)."""
@@ -202,7 +210,8 @@ def composed_train_step(mesh: Mesh, n_heads: int, lr: float = 0.1,
     @jax.jit
     def step(params, x, y):
         def loss_fn(p):
-            out = composed_apply(p, x, mesh, n_heads, **axes)
+            out = composed_apply(p, x, mesh, n_heads, remat=remat,
+                                 **axes)
             return jnp.mean((out - y) ** 2)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)

@@ -82,3 +82,17 @@ def test_composed_more_microbatches():
     got = composed_apply(params, x, mesh, H, num_microbatches=4)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_composed_remat_matches_no_remat():
+    """jax.checkpoint around the per-tick block must not change the
+    training math — same grads, recomputed instead of stored."""
+    mesh = _mesh3d()
+    params = init_stage_params(np.random.RandomState(11), S, D, H, FF)
+    x, y = _inputs(5)
+    p1, l1 = composed_train_step(mesh, H, lr=0.2)(params, x, y)
+    p2, l2 = composed_train_step(mesh, H, lr=0.2, remat=True)(params, x, y)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    for k in p1:
+        np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p2[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
