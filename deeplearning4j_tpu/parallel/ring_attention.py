@@ -20,6 +20,7 @@ Use inside shard_map:
 """
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -101,3 +102,84 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         acc, m, l, _, _, _ = jax.lax.fori_loop(
             1, n, step, (acc, m, l, k, v, mask))
     return (acc / l[..., None]).astype(q.dtype)
+
+
+def ring_attention_flash(q, k, v, axis_name: str, scale=None,
+                         block_q: int = 256, block_k: int = 256,
+                         interpret: bool = False):
+    """Ring attention whose INNER chunk-vs-chunk attention runs the
+    Pallas flash kernel (`ops.attention_kernels.flash_attention_tpu`
+    with ``return_lse``), merging per-chunk results by logsumexp:
+
+        lse' = logaddexp(lse, lse_i)
+        out' = exp(lse - lse')*out + exp(lse_i - lse')*out_i
+
+    Non-causal (encoder / bidirectional long-context) only: causal ring
+    masking differs PER DEVICE at each ring step (below-diagonal chunks
+    are unmasked, the diagonal chunk is triangular), which would break
+    the single-program kernel launch — the einsum path in
+    `ring_attention` handles that case.
+
+    Differentiable via custom_vjp: the backward delegates to the einsum
+    ring's autodiff (mathematically the same function, so the gradients
+    are exact); a fused flash-bwd ring is a future multi-chip-measured
+    step.  Single-chip A/B is vacuous (axis size 1 = plain flash), so
+    adoption into dispatch waits for multi-chip hardware; correctness is
+    CPU-tested via interpret mode.
+    """
+    return _ring_flash(q, k, v, axis_name, scale, block_q, block_k,
+                       interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q, k, v, axis_name, scale, block_q, block_k, interpret):
+    from deeplearning4j_tpu.ops.attention_kernels import (
+        flash_attention_tpu)
+
+    n = jax.lax.psum(1, axis_name)
+    B, H, T, D = q.shape
+
+    def inner(kc, vc):
+        out, lse = flash_attention_tpu(
+            q, kc, vc, causal=False, scale=scale, block_q=block_q,
+            block_k=block_k, interpret=interpret, return_lse=True)
+        return out.astype(jnp.float32), lse.reshape(B, H, T)
+
+    def merge(out, lse, out_i, lse_i):
+        lse_new = jnp.logaddexp(lse, lse_i)
+        w_old = jnp.exp(lse - lse_new)[..., None]
+        w_new = jnp.exp(lse_i - lse_new)[..., None]
+        return w_old * out + w_new * out_i, lse_new
+
+    def step(i, carry):
+        out, lse, kc, vc = carry
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        out_i, lse_i = inner(kc, vc)
+        out, lse = merge(out, lse, out_i, lse_i)
+        return out, lse, kc, vc
+
+    out, lse = inner(k, v)
+    out, lse, _, _ = jax.lax.fori_loop(1, n, step, (out, lse, k, v))
+    return out.astype(q.dtype)
+
+
+def _ring_flash_fwd(q, k, v, axis_name, scale, block_q, block_k,
+                    interpret):
+    out = _ring_flash(q, k, v, axis_name, scale, block_q, block_k,
+                      interpret)
+    return out, (q, k, v)
+
+
+def _ring_flash_bwd(axis_name, scale, block_q, block_k, interpret, res,
+                    g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: ring_attention(q_, k_, v_,
+                                          axis_name=axis_name,
+                                          scale=scale), q, k, v)
+    return vjp(g)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)

@@ -386,3 +386,33 @@ def test_ring_attention_masked_causal_matches_full():
     out = jax.jit(f)(q, k, v, mask)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_flash_inner_matches_full():
+    """Ring attention with the Pallas flash kernel as the inner
+    chunk-vs-chunk attention (interpret mode on the CPU mesh) ==
+    unsharded full attention, and the logsumexp chunk merge is
+    differentiable."""
+    from deeplearning4j_tpu.parallel.ring_attention import (
+        ring_attention_flash)
+    mesh = make_mesh({"seq": 8})
+    B, H, T, D = 2, 2, 64, 16
+    q, k, v = _qkv(B=B, H=H, T=T, D=D, seed=9)
+    ref = mha_reference(q, k, v)
+
+    f = shard_map(
+        functools.partial(ring_attention_flash, axis_name="seq",
+                          block_q=8, block_k=8, interpret=True),
+        mesh=mesh,
+        in_specs=(P(None, None, "seq", None),) * 3,
+        out_specs=P(None, None, "seq", None),
+        check_vma=False)   # pallas_call outputs carry no vma type
+    out = jax.jit(f)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    g = jax.grad(lambda q_: jnp.sum(f(q_, k, v) ** 2))(q)
+    g_ref = jax.grad(
+        lambda q_: jnp.sum(mha_reference(q_, k, v) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=5e-4, atol=5e-5)
