@@ -104,9 +104,9 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     return (acc / l[..., None]).astype(q.dtype)
 
 
-def ring_attention_flash(q, k, v, axis_name: str, scale=None,
-                         block_q: int = 256, block_k: int = 256,
-                         interpret: bool = False):
+def ring_attention_flash(q, k, v, axis_name: str, causal: bool = False,
+                         scale=None, block_q: int = 256,
+                         block_k: int = 256, interpret: bool = False):
     """Ring attention whose INNER chunk-vs-chunk attention runs the
     Pallas flash kernel (`ops.attention_kernels.flash_attention_tpu`
     with ``return_lse``), merging per-chunk results by logsumexp:
@@ -114,11 +114,14 @@ def ring_attention_flash(q, k, v, axis_name: str, scale=None,
         lse' = logaddexp(lse, lse_i)
         out' = exp(lse - lse')*out + exp(lse_i - lse')*out_i
 
-    Non-causal (encoder / bidirectional long-context) only: causal ring
-    masking differs PER DEVICE at each ring step (below-diagonal chunks
-    are unmasked, the diagonal chunk is triangular), which would break
-    the single-program kernel launch — the einsum path in
-    `ring_attention` handles that case.
+    Causal needs NO per-step kernel variants with the contiguous chunk
+    layout: at ring step i the incoming chunk (source device
+    ``src = (my - i) mod n``) lies entirely BELOW the diagonal when
+    ``src < my`` (keep everything) or entirely ABOVE it (``src > my``:
+    suppress by forcing that chunk's lse to -inf so the merge no-ops);
+    only step 0 — the diagonal chunk, whose global q/k offsets are equal
+    — runs the causal kernel.  So every step launches the same plain
+    kernel and the diagonal step launches the causal one once.
 
     Differentiable via custom_vjp: the backward delegates to the einsum
     ring's autodiff (mathematically the same function, so the gradients
@@ -127,22 +130,25 @@ def ring_attention_flash(q, k, v, axis_name: str, scale=None,
     adoption into dispatch waits for multi-chip hardware; correctness is
     CPU-tested via interpret mode.
     """
-    return _ring_flash(q, k, v, axis_name, scale, block_q, block_k,
-                       interpret)
+    return _ring_flash(q, k, v, axis_name, causal, scale, block_q,
+                       block_k, interpret)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _ring_flash(q, k, v, axis_name, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, axis_name, causal, scale, block_q, block_k,
+                interpret):
     from deeplearning4j_tpu.ops.attention_kernels import (
         flash_attention_tpu)
 
     n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
     B, H, T, D = q.shape
 
-    def inner(kc, vc):
+    def inner(kc, vc, diag):
         out, lse = flash_attention_tpu(
-            q, kc, vc, causal=False, scale=scale, block_q=block_q,
-            block_k=block_k, interpret=interpret, return_lse=True)
+            q, kc, vc, causal=bool(causal and diag), scale=scale,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+            return_lse=True)
         return out.astype(jnp.float32), lse.reshape(B, H, T)
 
     def merge(out, lse, out_i, lse_i):
@@ -156,29 +162,33 @@ def _ring_flash(q, k, v, axis_name, scale, block_q, block_k, interpret):
         perm = [(j, (j + 1) % n) for j in range(n)]
         kc = jax.lax.ppermute(kc, axis_name, perm)
         vc = jax.lax.ppermute(vc, axis_name, perm)
-        out_i, lse_i = inner(kc, vc)
+        out_i, lse_i = inner(kc, vc, diag=False)
+        if causal:
+            src = (my - i) % n
+            lse_i = jnp.where(src < my, lse_i, NEG_INF)
         out, lse = merge(out, lse, out_i, lse_i)
         return out, lse, kc, vc
 
-    out, lse = inner(k, v)
+    out, lse = inner(k, v, diag=True)
     out, lse, _, _ = jax.lax.fori_loop(1, n, step, (out, lse, k, v))
     return out.astype(q.dtype)
 
 
-def _ring_flash_fwd(q, k, v, axis_name, scale, block_q, block_k,
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k,
                     interpret):
-    out = _ring_flash(q, k, v, axis_name, scale, block_q, block_k,
-                      interpret)
+    out = _ring_flash(q, k, v, axis_name, causal, scale, block_q,
+                      block_k, interpret)
     return out, (q, k, v)
 
 
-def _ring_flash_bwd(axis_name, scale, block_q, block_k, interpret, res,
-                    g):
+def _ring_flash_bwd(axis_name, causal, scale, block_q, block_k,
+                    interpret, res, g):
     q, k, v = res
     _, vjp = jax.vjp(
         lambda q_, k_, v_: ring_attention(q_, k_, v_,
                                           axis_name=axis_name,
-                                          scale=scale), q, k, v)
+                                          causal=causal, scale=scale),
+        q, k, v)
     return vjp(g)
 
 

@@ -416,3 +416,32 @@ def test_ring_attention_flash_inner_matches_full():
         lambda q_: jnp.sum(mha_reference(q_, k, v) ** 2))(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
                                rtol=5e-4, atol=5e-5)
+
+
+def test_ring_attention_flash_causal_matches_full():
+    """Causal flash-inner ring: the diagonal chunk runs the causal
+    kernel once, above-diagonal chunks are suppressed via lse=-inf —
+    must equal unsharded causal attention, grads included."""
+    from deeplearning4j_tpu.parallel.ring_attention import (
+        ring_attention_flash)
+    mesh = make_mesh({"seq": 8})
+    q, k, v = _qkv(B=1, H=2, T=64, D=16, seed=13)
+    ref = mha_reference(q, k, v, causal=True)
+
+    f = shard_map(
+        functools.partial(ring_attention_flash, axis_name="seq",
+                          causal=True, block_q=8, block_k=8,
+                          interpret=True),
+        mesh=mesh,
+        in_specs=(P(None, None, "seq", None),) * 3,
+        out_specs=P(None, None, "seq", None),
+        check_vma=False)
+    out = jax.jit(f)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    g = jax.grad(lambda v_: jnp.sum(f(q, k, v_) ** 2))(v)
+    g_ref = jax.grad(
+        lambda v_: jnp.sum(mha_reference(q, k, v_, causal=True) ** 2))(v)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=5e-4, atol=5e-5)
