@@ -215,6 +215,15 @@ def _embedding_lookup(table, idx):
 # ---- cnn (NHWC / HWIO) ----
 @register_op("conv2d")
 def _conv2d(x, w, b=None, stride=(1, 1), padding="SAME", dilation=(1, 1)):
+    # adoption hook (default OFF): when the Pallas conv-backward flags
+    # are enabled and the config is the 3x3-s1-SAME ResNet-body shape,
+    # route through the custom_vjp whose backward uses the wgrad/dgrad
+    # kernels (ops/conv_kernels.py; playbook stage 8 measures before any
+    # flip of the default)
+    from deeplearning4j_tpu.ops.conv_kernels import (conv3x3_eligible,
+                                                     conv3x3_same)
+    if conv3x3_eligible(x.shape, w.shape, b, stride, padding, dilation):
+        return conv3x3_same(x, w)
     y = lax.conv_general_dilated(
         x, w, window_strides=tuple(stride), padding=padding,
         rhs_dilation=tuple(dilation),

@@ -280,13 +280,24 @@ class ConvolutionLayer(Layer):
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         x = self.maybe_input_dropout(x, train, rng)
-        y = lax.conv_general_dilated(
-            x, params["W"],
-            window_strides=_pair(self.stride),
-            padding=self._padding_arg(),
-            rhs_dilation=_pair(self.dilation),
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        )
+        pad = self._padding_arg()
+        from deeplearning4j_tpu.ops.conv_kernels import (conv3x3_eligible,
+                                                         conv3x3_same)
+        # Pallas conv-backward adoption hook (default off; bias is added
+        # AFTER the conv here, so the conv itself qualifies) — see
+        # ops/conv_kernels.CONV_BWD_PALLAS + playbook stage 8
+        if conv3x3_eligible(x.shape, params["W"].shape, None,
+                            _pair(self.stride), pad,
+                            _pair(self.dilation)):
+            y = conv3x3_same(x, params["W"])
+        else:
+            y = lax.conv_general_dilated(
+                x, params["W"],
+                window_strides=_pair(self.stride),
+                padding=pad,
+                rhs_dilation=_pair(self.dilation),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
         if self.has_bias:
             y = y + params["b"]
         return self.act_fn()(y), state

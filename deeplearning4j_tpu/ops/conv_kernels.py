@@ -174,3 +174,63 @@ def conv3x3_dgrad_xla(dy, w):
         return jnp.sum(y * dy.astype(jnp.float32))
 
     return jax.grad(loss)(x0)
+
+
+# ---------------------------------------------------------------------------
+# Measured-dispatch adoption hook (the flash/fused-LN pattern): a
+# custom_vjp 3x3-s1-SAME conv whose BACKWARD routes to the Pallas
+# wgrad/dgrad kernels when the corresponding flag is on.  Default off —
+# `tunnel_playbook.py` stage 8 A/Bs the full train step with the flags
+# enabled and a measured win flips them (one line, or the
+# DL4J_TPU_CONV_BWD_PALLAS env var).
+# ---------------------------------------------------------------------------
+
+import os as _os
+
+CONV_BWD_PALLAS = {
+    "wgrad": "w" in _os.environ.get("DL4J_TPU_CONV_BWD_PALLAS", ""),
+    "dgrad": "d" in _os.environ.get("DL4J_TPU_CONV_BWD_PALLAS", ""),
+    #: interpret-mode for tests on CPU
+    "interpret": False,
+}
+
+
+def conv3x3_eligible(x_shape, w_shape, b, stride, padding, dilation) -> bool:
+    """The shapes this hook covers: 3x3, stride 1, SAME, no dilation,
+    NHWC, bias-free (the ResNet body conv)."""
+    return (any(CONV_BWD_PALLAS[k] for k in ("wgrad", "dgrad"))
+            and b is None
+            and tuple(stride) == (1, 1) and tuple(dilation) == (1, 1)
+            and padding == "SAME"
+            and len(w_shape) == 4 and w_shape[:2] == (3, 3)
+            and len(x_shape) == 4)
+
+
+@jax.custom_vjp
+def conv3x3_same(x, w):
+    return jax.lax.conv_general_dilated(
+        x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _c33_fwd(x, w):
+    return conv3x3_same(x, w), (x, w)
+
+
+def _c33_bwd(res, dy):
+    x, w = res
+    itp = CONV_BWD_PALLAS["interpret"]
+    # XLA's own cotangents for whichever side stays on the XLA path —
+    # the unused one is dead-code-eliminated under jit
+    _, pullback = jax.vjp(
+        lambda x_, w_: jax.lax.conv_general_dilated(
+            x_, w_, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC")), x, w)
+    dx_xla, dw_xla = pullback(dy)
+    dx = (conv3x3_dgrad_tpu(dy, w, interpret=itp).astype(x.dtype)
+          if CONV_BWD_PALLAS["dgrad"] else dx_xla)
+    dw = (conv3x3_wgrad_tpu(x, dy, interpret=itp).astype(w.dtype)
+          if CONV_BWD_PALLAS["wgrad"] else dw_xla)
+    return dx, dw
+
+
+conv3x3_same.defvjp(_c33_fwd, _c33_bwd)

@@ -80,3 +80,88 @@ def test_dgrad_rejects_bad_filter():
     w = jnp.zeros((5, 5, 4, 4))
     with pytest.raises(ValueError, match="not \\[3, 3"):
         conv3x3_dgrad_tpu(dy, w, interpret=True)
+
+
+# ---- measured-dispatch adoption hook ----
+from deeplearning4j_tpu.ops.conv_kernels import (CONV_BWD_PALLAS,  # noqa: E402
+                                                 conv3x3_same)
+
+
+def test_conv_bwd_pallas_hook_grads_match_xla():
+    """With the adoption flags on (interpret mode), the conv2d op's
+    backward runs the Pallas wgrad+dgrad kernels and must produce the
+    same gradients as the XLA path — the train-step-level contract the
+    on-chip A/B (playbook stage 8) assumes."""
+    import jax
+    from deeplearning4j_tpu.autodiff.ops import OP_TABLE
+
+    x = jnp.asarray(rs.randn(2, 8, 8, 4).astype(np.float32) * 0.5)
+    w = jnp.asarray(rs.randn(3, 3, 4, 8).astype(np.float32) * 0.3)
+    tgt = jnp.asarray(rs.randn(2, 8, 8, 8).astype(np.float32))
+
+    def loss(x_, w_):
+        y = OP_TABLE["conv2d"](x_, w_)
+        return jnp.sum((y - tgt) ** 2)
+
+    gx_ref, gw_ref = jax.grad(loss, (0, 1))(x, w)
+
+    old = dict(CONV_BWD_PALLAS)
+    try:
+        CONV_BWD_PALLAS.update(wgrad=True, dgrad=True, interpret=True)
+        out_hook = OP_TABLE["conv2d"](x, w)
+        # forward identical (same XLA conv)
+        np.testing.assert_allclose(
+            np.asarray(out_hook),
+            np.asarray(conv3x3_same(x, w)), rtol=1e-6)
+        gx, gw = jax.grad(loss, (0, 1))(x, w)
+    finally:
+        CONV_BWD_PALLAS.clear()
+        CONV_BWD_PALLAS.update(old)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_ref),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_ref),
+                               rtol=1e-4, atol=1e-4)
+    # flags off again: hook must not engage (plain path, bias works)
+    y = OP_TABLE["conv2d"](x, w, jnp.zeros(8, jnp.float32))
+    assert y.shape == (2, 8, 8, 8)
+
+
+def test_conv_layer_hook_training_matches_xla():
+    """Layer-level contract: a small conv net trains identically with the
+    Pallas backward hook on (interpret) and off."""
+    import jax
+    from deeplearning4j_tpu.nn import (ConvolutionLayer, InputType,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration,
+                                       OutputLayer)
+    from deeplearning4j_tpu.train import Sgd
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.1))
+                .list([ConvolutionLayer(n_out=4, kernel_size=3,
+                                        convolution_mode="Same",
+                                        has_bias=False,
+                                        activation="relu"),
+                       OutputLayer(n_out=3, loss="mcxent",
+                                   activation="softmax")])
+                .set_input_type(InputType.convolutional(6, 6, 2)).build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 6, 6, 2).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 4)]
+
+    net_a = build()
+    net_a.fit(x, y)
+    ref = np.asarray(net_a.params())
+
+    old = dict(CONV_BWD_PALLAS)
+    try:
+        CONV_BWD_PALLAS.update(wgrad=True, dgrad=True, interpret=True)
+        net_b = build()
+        net_b.fit(x, y)
+        got = np.asarray(net_b.params())
+    finally:
+        CONV_BWD_PALLAS.clear()
+        CONV_BWD_PALLAS.update(old)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)

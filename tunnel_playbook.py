@@ -337,6 +337,47 @@ def stage_dgrad_ab(quick):
     return out
 
 
+@guard("8_conv_bwd_hook_ab")
+def stage_conv_hook_ab(quick):
+    """End-to-end adoption A/B: the full ResNet-50 train step with the
+    Pallas conv-backward hook enabled (wgrad, dgrad, both) vs the XLA
+    default.  A measured win flips CONV_BWD_PALLAS's default (or sets
+    DL4J_TPU_CONV_BWD_PALLAS); a loss gets this table committed as the
+    negative result."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.conv_kernels import CONV_BWD_PALLAS
+    from deeplearning4j_tpu.train.updaters import Nesterovs
+    from deeplearning4j_tpu.zoo import ResNet50
+    batch = 64
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(batch, 224, 224, 3).astype(np.float32))
+    y = jnp.asarray(np.eye(1000, dtype=np.float32)[
+        rng.randint(0, 1000, batch)])
+    out = {}
+    for tag, flags in [("xla", {}), ("wgrad", {"wgrad": True}),
+                       ("dgrad", {"dgrad": True}),
+                       ("both", {"wgrad": True, "dgrad": True})]:
+        old = dict(CONV_BWD_PALLAS)
+        try:
+            CONV_BWD_PALLAS.update(wgrad=False, dgrad=False,
+                                   interpret=False)
+            CONV_BWD_PALLAS.update(flags)
+            net = ResNet50(n_classes=1000, input_shape=(224, 224, 3),
+                           updater=Nesterovs(0.1, 0.9),
+                           compute_dtype="bfloat16").init_model()
+            dt = timeit(lambda: net.fit(x, y),
+                        lambda: float(net.score()),
+                        n=5 if quick else 10)
+            out[tag] = {"ms_per_step": round(dt * 1e3, 2),
+                        "samples_per_sec": round(batch / dt, 1)}
+        except Exception as e:
+            out[tag] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        finally:
+            CONV_BWD_PALLAS.clear()
+            CONV_BWD_PALLAS.update(old)
+    return out
+
+
 def main():
     quick = "--quick" in sys.argv
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -356,6 +397,7 @@ def main():
     stage_conv_layout(quick)
     stage_wgrad_ab(quick)
     stage_dgrad_ab(quick)
+    stage_conv_hook_ab(quick)
     print("[playbook] DONE", flush=True)
 
 
