@@ -567,103 +567,117 @@ def _input_type(layers_cfg: List[dict]) -> InputType:
         f"Unsupported input rank {len(shape)}")
 
 
+def _open_model(path: str):
+    """(model_config_dict, fetch(layer_name) -> path-keyed weights) for
+    either container: legacy HDF5 or the Keras 3 ``.keras`` zip."""
+    import zipfile
+
+    if zipfile.is_zipfile(path):
+        from deeplearning4j_tpu.modelimport.keras_v3 import read_keras_v3
+        return read_keras_v3(path)
+    import h5py
+    with h5py.File(path, "r") as f:
+        cfg = json.loads(f.attrs["model_config"])
+        names = ({lc["config"]["name"] for lc in
+                  cfg["config"]["layers"]})
+        weights = {n: _layer_weights(f, n) for n in names}
+    return cfg, lambda n: weights.get(n, {})
+
+
 class KerasModelImport:
     """Entry points (reference `KerasModelImport`):
     `import_keras_sequential_model_and_weights`,
-    `import_keras_model_and_weights` (functional)."""
+    `import_keras_model_and_weights` (functional).  Both accept legacy
+    HDF5 and Keras 3 ``.keras`` saves."""
 
     @staticmethod
     def import_keras_sequential_model_and_weights(
             path: str) -> MultiLayerNetwork:
-        import h5py
-        with h5py.File(path, "r") as f:
-            cfg = json.loads(f.attrs["model_config"])
-            if cfg["class_name"] != "Sequential":
+        cfg, fetch = _open_model(path)
+        if cfg["class_name"] != "Sequential":
+            raise UnsupportedKerasConfigurationException(
+                f"Not a Sequential model: {cfg['class_name']} — use "
+                "import_keras_model_and_weights")
+        layers_cfg = cfg["config"]["layers"]
+        mapped: List[Layer] = []
+        names: List[Optional[str]] = []
+        for i, lc in enumerate(layers_cfg):
+            cls = lc["class_name"]
+            if cls not in LAYER_MAP:
                 raise UnsupportedKerasConfigurationException(
-                    f"Not a Sequential model: {cfg['class_name']} — use "
-                    "import_keras_model_and_weights")
-            layers_cfg = cfg["config"]["layers"]
-            mapped: List[Layer] = []
-            names: List[Optional[str]] = []
-            for i, lc in enumerate(layers_cfg):
-                cls = lc["class_name"]
-                if cls not in LAYER_MAP:
-                    raise UnsupportedKerasConfigurationException(
-                        f"Unsupported Keras layer '{cls}' — register via "
-                        "register_keras_layer")
-                is_output = i == len(layers_cfg) - 1
-                layer = LAYER_MAP[cls](lc["config"], is_output)
-                if layer is None:
-                    continue
-                layer.name = lc["config"]["name"]
-                mapped.append(layer)
-                names.append(lc["config"]["name"])
-            conf = (NeuralNetConfiguration.builder()
-                    .list(mapped)
-                    .set_input_type(_input_type(layers_cfg))
-                    .build())
-            net = MultiLayerNetwork(conf).init()
-            for layer, name in zip(mapped, names):
-                w = _layer_weights(f, name)
-                if w:
-                    _set_weights(net, name, layer, w)
+                    f"Unsupported Keras layer '{cls}' — register via "
+                    "register_keras_layer")
+            is_output = i == len(layers_cfg) - 1
+            layer = LAYER_MAP[cls](lc["config"], is_output)
+            if layer is None:
+                continue
+            layer.name = lc["config"]["name"]
+            mapped.append(layer)
+            names.append(lc["config"]["name"])
+        conf = (NeuralNetConfiguration.builder()
+                .list(mapped)
+                .set_input_type(_input_type(layers_cfg))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        for layer, name in zip(mapped, names):
+            w = fetch(name)
+            if w:
+                _set_weights(net, name, layer, w)
         return net
 
     @staticmethod
     def import_keras_model_and_weights(path: str) -> ComputationGraph:
-        import h5py
-        with h5py.File(path, "r") as f:
-            cfg = json.loads(f.attrs["model_config"])
-            if cfg["class_name"] == "Sequential":
+        cfg, fetch = _open_model(path)
+        if cfg["class_name"] == "Sequential":
+            raise UnsupportedKerasConfigurationException(
+                "Sequential model — use "
+                "import_keras_sequential_model_and_weights")
+        conf_cfg = cfg["config"]
+        layers_cfg = conf_cfg["layers"]
+        by_name = {lc["config"]["name"]: lc for lc in layers_cfg}
+        b = GraphBuilder()
+        input_names = _node_refs(conf_cfg["input_layers"])
+        b.add_inputs(*input_names)
+        types = []
+        for n in input_names:
+            types.append(_input_type([by_name[n]]))
+        b.set_input_types(*types)
+        output_names = _node_refs(conf_cfg["output_layers"])
+        mapped: Dict[str, Layer] = {}
+        for lc in layers_cfg:
+            name = lc["config"]["name"]
+            cls = lc["class_name"]
+            inbound = _inbound_names(lc)
+            if cls == "InputLayer":
+                continue
+            if cls in ("Add", "Average", "Maximum", "Subtract",
+                       "Multiply"):
+                op = {"Add": "Add", "Average": "Average",
+                      "Maximum": "Max", "Subtract": "Subtract",
+                      "Multiply": "Product"}[cls]
+                b.add_vertex(name, ElementWiseVertex(op=op), *inbound)
+                continue
+            if cls == "Concatenate":
+                b.add_vertex(name, MergeVertex(), *inbound)
+                continue
+            if cls not in LAYER_MAP:
                 raise UnsupportedKerasConfigurationException(
-                    "Sequential model — use "
-                    "import_keras_sequential_model_and_weights")
-            conf_cfg = cfg["config"]
-            layers_cfg = conf_cfg["layers"]
-            by_name = {lc["config"]["name"]: lc for lc in layers_cfg}
-            b = GraphBuilder()
-            input_names = _node_refs(conf_cfg["input_layers"])
-            b.add_inputs(*input_names)
-            types = []
-            for n in input_names:
-                types.append(_input_type([by_name[n]]))
-            b.set_input_types(*types)
-            output_names = _node_refs(conf_cfg["output_layers"])
-            mapped: Dict[str, Layer] = {}
-            for lc in layers_cfg:
-                name = lc["config"]["name"]
-                cls = lc["class_name"]
-                inbound = _inbound_names(lc)
-                if cls == "InputLayer":
-                    continue
-                if cls in ("Add", "Average", "Maximum", "Subtract",
-                           "Multiply"):
-                    op = {"Add": "Add", "Average": "Average",
-                          "Maximum": "Max", "Subtract": "Subtract",
-                          "Multiply": "Product"}[cls]
-                    b.add_vertex(name, ElementWiseVertex(op=op), *inbound)
-                    continue
-                if cls == "Concatenate":
-                    b.add_vertex(name, MergeVertex(), *inbound)
-                    continue
-                if cls not in LAYER_MAP:
-                    raise UnsupportedKerasConfigurationException(
-                        f"Unsupported Keras layer '{cls}'")
-                layer = LAYER_MAP[cls](lc["config"],
-                                       name in output_names)
-                if layer is None:
-                    # structural no-op: alias by inserting identity
-                    b.add_layer(name, ActivationLayer(activation="identity"),
-                                *inbound)
-                    continue
-                b.add_layer(name, layer, *inbound)
-                mapped[name] = layer
-            b.set_outputs(*output_names)
-            net = ComputationGraph(b.build()).init()
-            for name, layer in mapped.items():
-                w = _layer_weights(f, name)
-                if w:
-                    _set_weights(net, name, layer, w)
+                    f"Unsupported Keras layer '{cls}'")
+            layer = LAYER_MAP[cls](lc["config"],
+                                   name in output_names)
+            if layer is None:
+                # structural no-op: alias by inserting identity
+                b.add_layer(name, ActivationLayer(activation="identity"),
+                            *inbound)
+                continue
+            b.add_layer(name, layer, *inbound)
+            mapped[name] = layer
+        b.set_outputs(*output_names)
+        net = ComputationGraph(b.build()).init()
+        for name, layer in mapped.items():
+            w = fetch(name)
+            if w:
+                _set_weights(net, name, layer, w)
         return net
 
 

@@ -1,0 +1,144 @@
+"""Keras 3 ``.keras`` (zip) container support.
+
+Reference role: `KerasModelImport` reads legacy HDF5; Keras 3's default
+save format is a zip of ``config.json`` + ``model.weights.h5``, where the
+weights file keys layers by their AUTO-GENERATED object paths (snake-case
+class name + per-class counter over top-level layers — custom layer
+names do NOT appear) and stores each layer's variables positionally as
+``vars/0, vars/1, ...`` in build order, with sublayer nesting for RNN
+cells (``lstm/cell/vars``), Bidirectional
+(``bidirectional/{forward_layer,backward_layer}/cell/vars``) and
+TimeDistributed (``time_distributed/layer/vars``).
+
+This module resolves that layout back to the canonical trailing names
+(`kernel`, `bias`, `recurrent_kernel`, `gamma`, ...) the shared weight
+copier (`keras._set_weights`) consumes, so the ``.keras`` and H5 paths
+share every converter and every conformance test pattern.
+"""
+from __future__ import annotations
+
+import io
+import json
+import re
+import zipfile
+from typing import Dict, List
+
+import numpy as np
+
+__all__ = ["read_keras_v3"]
+
+
+def _snake(name: str) -> str:
+    """keras.utils.naming.to_snake_case semantics."""
+    n = re.sub(r"\W+", "", name)
+    n = re.sub(r"(.)([A-Z][a-z]+)", r"\1_\2", n)
+    n = re.sub(r"([a-z])([A-Z])", r"\1_\2", n)
+    return n.lower()
+
+
+def _var_names(cls: str, cfg: dict) -> List[str]:
+    """Positional variable names per layer class (Keras build order)."""
+    bias = ["bias"] if cfg.get("use_bias", True) else []
+    if cls in ("Dense", "Conv1D", "Conv2D", "Conv3D", "Conv2DTranspose",
+               "Conv1DTranspose", "Conv3DTranspose"):
+        return ["kernel"] + bias
+    if cls == "DepthwiseConv2D":
+        return ["depthwise_kernel"] + bias
+    if cls == "SeparableConv2D":
+        return ["depthwise_kernel", "pointwise_kernel"] + bias
+    if cls == "Embedding":
+        return ["embeddings"]
+    if cls == "PReLU":
+        return ["alpha"]
+    if cls == "BatchNormalization":
+        names = []
+        if cfg.get("scale", True):
+            names.append("gamma")
+        if cfg.get("center", True):
+            names.append("beta")
+        return names + ["moving_mean", "moving_variance"]
+    if cls == "LayerNormalization":
+        names = []
+        if cfg.get("scale", True):
+            names.append("gamma")
+        if cfg.get("center", True):
+            names.append("beta")
+        return names
+    if cls in ("LSTM", "SimpleRNN", "GRU"):
+        return ["kernel", "recurrent_kernel"] + bias
+    return []           # parameterless (Flatten, Activation, pooling, ...)
+
+
+def _read_vars(group, names: List[str], where: str) -> Dict[str, np.ndarray]:
+    if "vars" not in group:
+        return {}
+    vs = group["vars"]
+    keys = sorted(vs.keys(), key=int)
+    if len(keys) != len(names):
+        raise ValueError(
+            f"{where}: {len(keys)} saved variables but the layer config "
+            f"implies {names} — unsupported layer variant for .keras "
+            "import (export to legacy H5 as a workaround)")
+    return {name: np.asarray(vs[k]) for name, k in zip(names, keys)}
+
+
+class _V3Weights:
+    """config-layer-name -> path-keyed weight dict resolver."""
+
+    def __init__(self, h5file, layers_cfg: List[dict]):
+        self._by_name: Dict[str, Dict[str, np.ndarray]] = {}
+        counters: Dict[str, int] = {}
+        layers_group = h5file["layers"] if "layers" in h5file else {}
+        for lc in layers_cfg:
+            cls = lc["class_name"]
+            base = _snake(cls)
+            idx = counters.get(base, 0)
+            counters[base] = idx + 1
+            auto = base if idx == 0 else f"{base}_{idx}"
+            cfg_name = lc["config"]["name"]
+            if auto not in layers_group:
+                self._by_name[cfg_name] = {}
+                continue
+            g = layers_group[auto]
+            cfg = lc["config"]
+            out: Dict[str, np.ndarray] = {}
+            if cls in ("LSTM", "SimpleRNN", "GRU"):
+                out = _read_vars(g["cell"], _var_names(cls, cfg), auto)
+            elif cls == "Bidirectional":
+                inner = cfg["layer"]
+                icls = inner["class_name"]
+                names = _var_names(icls, inner["config"])
+                for d in ("forward_layer", "backward_layer"):
+                    sub = g[d]
+                    src = sub["cell"] if icls in ("LSTM", "SimpleRNN",
+                                                  "GRU") else sub
+                    for nm, arr in _read_vars(src, names,
+                                              f"{auto}/{d}").items():
+                        out[f"{d}/{nm}"] = arr
+            elif cls == "TimeDistributed":
+                inner = cfg["layer"]
+                names = _var_names(inner["class_name"], inner["config"])
+                for nm, arr in _read_vars(g["layer"], names,
+                                          f"{auto}/layer").items():
+                    out[f"layer/{nm}"] = arr
+            else:
+                out = _read_vars(g, _var_names(cls, cfg), auto)
+            self._by_name[cfg_name] = out
+
+    def layer(self, name: str) -> Dict[str, np.ndarray]:
+        return self._by_name.get(name, {})
+
+
+def read_keras_v3(path: str):
+    """Open a ``.keras`` zip; returns (model_config_dict, fetch) where
+    fetch(layer_config_name) yields the path-keyed weight dict in the
+    same shape the legacy-H5 reader produces."""
+    import h5py
+
+    with zipfile.ZipFile(path) as z:
+        cfg = json.loads(z.read("config.json"))
+        wbytes = z.read("model.weights.h5")
+    layers_cfg = cfg["config"]["layers"]
+    with h5py.File(io.BytesIO(wbytes), "r") as f:
+        weights = _V3Weights(f, layers_cfg)
+    return cfg, weights.layer

@@ -741,3 +741,52 @@ def test_tf_import_full_depth_bert():
     for _ in range(5):
         sd.fit(ids, lab_v)
     assert sd.score() < first, (first, sd.score())
+
+
+def test_keras_v3_zip_sequential_import_matches_keras():
+    """Keras 3 `.keras` zip container (the Keras 3 DEFAULT save format):
+    auto-path/positional-vars weight resolution must reproduce keras's
+    own predictions — same contract as the legacy-H5 tests."""
+    import tempfile
+
+    tf.keras.utils.set_random_seed(5)
+    L = tf.keras.layers
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((6, 6, 2)),
+        L.Conv2D(4, 3, padding="same", activation="relu", name="c1"),
+        L.BatchNormalization(name="bn"),
+        L.Flatten(name="fl"),
+        L.Dense(8, activation="tanh", name="d1"),
+        L.Dense(3, activation="softmax", name="out")])
+    path = tempfile.mktemp(suffix=".keras")
+    km.save(path)
+
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = np.random.RandomState(0).rand(3, 6, 6, 2).astype(np.float32)
+    got = np.asarray(net.output(x))
+    want = km.predict(x, verbose=0)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_keras_v3_zip_recurrent_import_matches_keras():
+    """.keras container with the nested layouts: Bidirectional LSTM
+    (forward_layer/backward_layer/cell/vars), TimeDistributed
+    (layer/vars), plain LSTM (cell/vars), use_bias=False Dense."""
+    import tempfile
+
+    tf.keras.utils.set_random_seed(6)
+    L = tf.keras.layers
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((5, 4)),
+        L.Bidirectional(L.LSTM(3, return_sequences=True), name="bd"),
+        L.TimeDistributed(L.Dense(4, activation="relu"), name="td"),
+        L.LSTM(3, name="l2"),
+        L.Dense(2, use_bias=False, name="out")])
+    path = tempfile.mktemp(suffix=".keras")
+    km.save(path)
+
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = np.random.RandomState(1).rand(2, 5, 4).astype(np.float32)
+    got = np.asarray(net.output(x))
+    want = km.predict(x, verbose=0)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
