@@ -148,3 +148,25 @@ def test_manifest_fetch_and_init_pretrained(tmp_path):
     # unknown model name is a KeyError listing what exists
     with pytest.raises(KeyError, match="LeNet"):
         fetch("NoSuchModel", mpath, cache_dir=str(cache), fetch_hook=hook)
+
+
+def test_convert_accepts_keras_v3_zip(tmp_path):
+    """The converter CLI consumes the Keras 3 `.keras` container through
+    the same import path as legacy H5."""
+    from deeplearning4j_tpu.modelimport import KerasModelImport
+
+    tf.keras.utils.set_random_seed(9)
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((6,)),
+        tf.keras.layers.Dense(5, activation="relu"),
+        tf.keras.layers.Dense(3, activation="softmax")])
+    src = str(tmp_path / "m.keras")
+    km.save(src)
+    dst = str(tmp_path / "m.npz")
+    msg = convert(src, dst, "npz")
+    assert "npz" in msg
+    net = KerasModelImport.import_keras_sequential_model_and_weights(src)
+    x = np.random.RandomState(2).rand(2, 6).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               km.predict(x, verbose=0),
+                               rtol=1e-4, atol=1e-5)
