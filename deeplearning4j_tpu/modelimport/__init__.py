@@ -3,6 +3,6 @@
 from deeplearning4j_tpu.modelimport.keras import (  # noqa: F401
     KerasModelImport, UnsupportedKerasConfigurationException)
 from deeplearning4j_tpu.modelimport.tf_import import (  # noqa: F401
-    TFImportRegistry, import_graph_def)
+    TFImportRegistry, import_graph_def, import_saved_model)
 from deeplearning4j_tpu.modelimport.onnx_import import (  # noqa: F401
     OnnxImportRegistry, UnmappedOnnxOpException, import_onnx_model)

@@ -1151,3 +1151,33 @@ def import_graph_def(graph_def, input_names: List[str] = None) -> SameDiff:
             f"GraphDef has a dependency cycle outside loop frames "
             f"(unresolved: {stuck})")
     return sd
+
+
+def import_saved_model(path: str, signature: str = "serving_default"):
+    """Import a TF2 SavedModel directory (reference
+    `TFGraphMapper.importGraph` consumes frozen GraphDefs; TF2 users hold
+    SavedModels, so this freezes the requested serving signature with
+    `convert_variables_to_constants_v2` and walks the result through
+    `import_graph_def`).
+
+    Returns ``(sd, input_names, output_names)``: the SameDiff graph plus
+    the signature's placeholder names (feed keys for `sd.output`) and
+    the graph output names, in signature order.
+    """
+    import tensorflow as tf
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+
+    loaded = tf.saved_model.load(path)
+    sigs = getattr(loaded, "signatures", {})
+    if signature not in sigs:
+        raise UnmappedTFOpException(
+            f"SavedModel at {path} has no signature {signature!r} "
+            f"(available: {sorted(sigs)})")
+    frozen = convert_variables_to_constants_v2(sigs[signature])
+    gd = frozen.graph.as_graph_def()
+    sd = import_graph_def(gd)
+    input_names = [t.name.split(":")[0] for t in frozen.inputs
+                   if t.dtype != tf.resource]
+    output_names = [t.name.split(":")[0] for t in frozen.outputs]
+    return sd, input_names, output_names

@@ -790,3 +790,28 @@ def test_keras_v3_zip_recurrent_import_matches_keras():
     got = np.asarray(net.output(x))
     want = km.predict(x, verbose=0)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_tf_saved_model_import(tmp_path):
+    """TF2 SavedModel directory -> freeze serving signature -> SameDiff;
+    predictions match the SavedModel's own."""
+    from deeplearning4j_tpu.modelimport import import_saved_model
+
+    tf.keras.utils.set_random_seed(11)
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((7,), name="feats"),
+        tf.keras.layers.Dense(9, activation="relu"),
+        tf.keras.layers.Dense(4, activation="softmax")])
+    d = str(tmp_path / "sm")
+    tf.saved_model.save(km, d)
+
+    sd, inputs, outputs = import_saved_model(d)
+    assert len(inputs) == 1 and len(outputs) == 1
+    x = np.random.RandomState(3).rand(5, 7).astype(np.float32)
+    want = km.predict(x, verbose=0)
+    got = np.asarray(sd.output({inputs[0]: x}, outputs[0])[outputs[0]])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    # missing signature -> named diagnostic
+    with pytest.raises(UnmappedTFOpException, match="no signature"):
+        import_saved_model(d, signature="nope")
