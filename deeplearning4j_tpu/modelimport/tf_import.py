@@ -1177,7 +1177,14 @@ def import_saved_model(path: str, signature: str = "serving_default"):
     frozen = convert_variables_to_constants_v2(sigs[signature])
     gd = frozen.graph.as_graph_def()
     sd = import_graph_def(gd)
-    input_names = [t.name.split(":")[0] for t in frozen.inputs
+    def _var_name(t):
+        # Placeholders are single-output, so ':0' always drops; a non-zero
+        # output of a multi-output op must keep its ':i' suffix — that is
+        # how import_graph_def registers it (plain 'name' means output 0).
+        op, _, idx = t.name.partition(":")
+        return op if idx in ("", "0") else t.name
+
+    input_names = [_var_name(t) for t in frozen.inputs
                    if t.dtype != tf.resource]
-    output_names = [t.name.split(":")[0] for t in frozen.outputs]
+    output_names = [_var_name(t) for t in frozen.outputs]
     return sd, input_names, output_names

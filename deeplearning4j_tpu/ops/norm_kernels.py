@@ -34,26 +34,35 @@ def layer_norm_reference(x, gain, bias=None, eps: float = 1e-5):
 # -- forward kernel ---------------------------------------------------------
 
 def _ln_fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps):
+    # Mosaic constraint (found on real v5e, not representable in interpret
+    # mode): one kernel may not mix 2D and 1D outputs — the stats are
+    # therefore (blk, 1) blocks (full lane cover exempts the 128-divisibility
+    # rule), squeezed by the caller.
     x = x_ref[...].astype(jnp.float32)
     mean = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.mean((x - mean) ** 2, axis=-1, keepdims=True)
     rstd = jax.lax.rsqrt(var + eps)
     y = (x - mean) * rstd * g_ref[...] + b_ref[...]
     y_ref[...] = y.astype(y_ref.dtype)
-    mean_ref[...] = mean[..., 0]
-    rstd_ref[...] = rstd[..., 0]
+    mean_ref[...] = mean
+    rstd_ref[...] = rstd
 
 
 def _ln_bwd_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref,
                    dx_ref, dg_ref, db_ref):
+    # dg/db partials: a (1, F) block violates Mosaic's 8-sublane rule, so
+    # each grid step broadcasts its partial over an (8, F) block; the caller
+    # reads sublane 0 of each.
     x = x_ref[...].astype(jnp.float32)
     dy = dy_ref[...].astype(jnp.float32)
     g = g_ref[...]
-    mean = mean_ref[...][..., None]
-    rstd = rstd_ref[...][..., None]
+    mean = mean_ref[...]
+    rstd = rstd_ref[...]
     xhat = (x - mean) * rstd
-    dg_ref[...] = jnp.sum(dy * xhat, axis=0)[None, :]
-    db_ref[...] = jnp.sum(dy, axis=0)[None, :]
+    dg_ref[...] = jnp.broadcast_to(
+        jnp.sum(dy * xhat, axis=0)[None, None, :], dg_ref.shape)
+    db_ref[...] = jnp.broadcast_to(
+        jnp.sum(dy, axis=0)[None, None, :], db_ref.shape)
     wdy = dy * g
     c1 = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
     c2 = jnp.mean(wdy, axis=-1, keepdims=True)
@@ -86,14 +95,14 @@ def layer_norm_tpu(x, gain, bias=None, eps: float = 1e-5,
                   pl.BlockSpec((F,), lambda i: (0,)),
                   pl.BlockSpec((F,), lambda i: (0,))],
         out_specs=[pl.BlockSpec((blk, F), lambda i: (i, 0)),
-                   pl.BlockSpec((blk,), lambda i: (i,)),
-                   pl.BlockSpec((blk,), lambda i: (i,))],
+                   pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+                   pl.BlockSpec((blk, 1), lambda i: (i, 0))],
         out_shape=[jax.ShapeDtypeStruct((rows, F), x.dtype),
-                   jax.ShapeDtypeStruct((rows,), jnp.float32),
-                   jax.ShapeDtypeStruct((rows,), jnp.float32)],
+                   jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((rows, 1), jnp.float32)],
         interpret=interpret,
     )(x2, gain.astype(jnp.float32), bias_.astype(jnp.float32))
-    return y.reshape(x.shape), mean, rstd
+    return y.reshape(x.shape), mean[:, 0], rstd[:, 0]
 
 
 def layer_norm_bwd_tpu(x, gain, mean, rstd, dy, block_rows: int = 256,
@@ -111,19 +120,19 @@ def layer_norm_bwd_tpu(x, gain, mean, rstd, dy, block_rows: int = 256,
         grid=grid,
         in_specs=[pl.BlockSpec((blk, F), lambda i: (i, 0)),
                   pl.BlockSpec((F,), lambda i: (0,)),
-                  pl.BlockSpec((blk,), lambda i: (i,)),
-                  pl.BlockSpec((blk,), lambda i: (i,)),
+                  pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+                  pl.BlockSpec((blk, 1), lambda i: (i, 0)),
                   pl.BlockSpec((blk, F), lambda i: (i, 0))],
         out_specs=[pl.BlockSpec((blk, F), lambda i: (i, 0)),
-                   pl.BlockSpec((1, F), lambda i: (i, 0)),
-                   pl.BlockSpec((1, F), lambda i: (i, 0))],
+                   pl.BlockSpec((1, 8, F), lambda i: (i, 0, 0)),
+                   pl.BlockSpec((1, 8, F), lambda i: (i, 0, 0))],
         out_shape=[jax.ShapeDtypeStruct((rows, F), x.dtype),
-                   jax.ShapeDtypeStruct((grid[0], F), jnp.float32),
-                   jax.ShapeDtypeStruct((grid[0], F), jnp.float32)],
+                   jax.ShapeDtypeStruct((grid[0], 8, F), jnp.float32),
+                   jax.ShapeDtypeStruct((grid[0], 8, F), jnp.float32)],
         interpret=interpret,
-    )(x2, gain.astype(jnp.float32), mean, rstd, dy2)
-    return (dx.reshape(x.shape), dg_part.sum(0).astype(gain.dtype),
-            db_part.sum(0))
+    )(x2, gain.astype(jnp.float32), mean[:, None], rstd[:, None], dy2)
+    return (dx.reshape(x.shape), dg_part[:, 0].sum(0).astype(gain.dtype),
+            db_part[:, 0].sum(0))
 
 
 # -- custom_vjp dispatcher --------------------------------------------------

@@ -815,3 +815,31 @@ def test_tf_saved_model_import(tmp_path):
     # missing signature -> named diagnostic
     with pytest.raises(UnmappedTFOpException, match="no signature"):
         import_saved_model(d, signature="nope")
+
+
+def test_tf_saved_model_multi_output_op_signature(tmp_path):
+    """A signature output that is a NON-ZERO output of a multi-output op
+    (tf.split) must keep its ':i' suffix — stripping it silently resolves
+    to output 0 of the op."""
+    from deeplearning4j_tpu.modelimport import import_saved_model
+
+    class M(tf.Module):
+        @tf.function(input_signature=[tf.TensorSpec([None, 6], tf.float32)])
+        def serve(self, x):
+            lo, hi = tf.split(x, 2, axis=1)
+            return {"lo": lo * 2.0, "hi": hi + 1.0, "second_half": hi}
+
+    m = M()
+    d = str(tmp_path / "sm_multi")
+    tf.saved_model.save(m, d, signatures={"serving_default": m.serve})
+
+    sd, inputs, outputs = import_saved_model(d)
+    x = np.random.RandomState(5).rand(3, 6).astype(np.float32)
+    want = {k: np.asarray(v) for k, v in m.serve(tf.constant(x)).items()}
+    got = sd.output({inputs[0]: x}, *outputs)
+    # order-insensitive: every signature output value must be produced by
+    # exactly one imported output name
+    got_vals = [np.asarray(got[o]) for o in outputs]
+    for key, val in want.items():
+        assert any(v.shape == val.shape and np.allclose(v, val, atol=1e-6)
+                   for v in got_vals), f"signature output {key} not matched"
