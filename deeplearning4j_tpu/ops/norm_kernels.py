@@ -137,8 +137,9 @@ def layer_norm_bwd_tpu(x, gain, mean, rstd, dy, block_rows: int = 256,
 
 # -- custom_vjp dispatcher --------------------------------------------------
 
-# Pending on-hardware measurement (the fused_attention _FLASH_MIN_SEQ
-# analog): below this row count XLA's fused chain wins on overhead alone.
+# Measured on v5e-1 (TUNNEL_VALIDATION stage 4, 2026-07-31): fused LN
+# fwd+bwd beats XLA's fused chain 1.07x at 8k rows and 1.06x at 64k rows
+# (D=768 BERT shapes).  Below ~1k rows dispatch overhead dominates.
 _LN_MIN_ROWS = 1024
 
 
