@@ -460,6 +460,7 @@ class ComputationGraph:
         self._rng = jax.random.PRNGKey(conf.seed)
         self._topo = conf.topological_order()
         self._train_step = None
+        self._scan_step = None
         self._output_fn = None
         self._vertex_types: Dict[str, InputType] = {}
 
@@ -584,7 +585,7 @@ class ComputationGraph:
         return penalty
 
     # ---- compiled step ----
-    def _build_train_step(self):
+    def _build_step_body(self):
         conf = self.conf
 
         def step(params, state, opt_state, inputs, labels, lmasks, rng,
@@ -632,12 +633,55 @@ class ComputationGraph:
                     lambda p_, u_: p_ - u_, params[name], upd)
             return new_params, new_state, new_opt, loss, rng, iteration + 1
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return step
 
     def _get_train_step(self):
         if self._train_step is None:
-            self._train_step = self._build_train_step()
+            self._train_step = jax.jit(self._build_step_body(),
+                                       donate_argnums=(0, 1, 2))
         return self._train_step
+
+    def _get_scan_step(self):
+        if self._scan_step is None:
+            from deeplearning4j_tpu.utils.scan_fit import make_scan_step
+            self._scan_step = make_scan_step(self._build_step_body())
+        return self._scan_step
+
+    def fit_steps(self, features, labels, labels_masks=None):
+        """Run k training steps in one device dispatch; every array in
+        `features`/`labels`/`labels_masks` carries a leading `[k, batch]`
+        steps axis.  Same math as k sequential `fit` calls (scan carries
+        params/updater/rng/iteration); listeners fire once per block."""
+        from deeplearning4j_tpu.utils.counters import advance, device_counters
+        inputs = self._as_input_dict(features)
+        labels = self._as_list(labels)
+        if labels_masks is not None and not isinstance(labels_masks,
+                                                       (list, tuple)):
+            labels_masks = [labels_masks]
+        lmasks = (None if labels_masks is None
+                  else [jnp.asarray(m) for m in labels_masks])
+        k = next(iter(inputs.values())).shape[0]
+        for name, arr in inputs.items():
+            if arr.shape[0] != k:
+                raise ValueError(f"steps axis mismatch: input '{name}' has "
+                                 f"{arr.shape[0]} steps, expected {k}")
+        for i, lab in enumerate(labels):
+            if lab.shape[0] != k:
+                raise ValueError(f"steps axis mismatch: label {i} has "
+                                 f"{lab.shape[0]} steps, expected {k} — "
+                                 f"every array needs a leading [k, batch] "
+                                 f"steps axis")
+        step = self._get_scan_step()
+        it_dev, ep_dev = device_counters(self)
+        (self.params_, self.state_, self.opt_state_, losses, self._rng,
+         new_it) = step(self.params_, self.state_, self.opt_state_,
+                        (inputs, labels, lmasks), self._rng, it_dev, ep_dev)
+        self._score = losses[-1]
+        self._last_batch_size = int(next(iter(inputs.values())).shape[1])
+        advance(self, new_it, steps=int(k))
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, self.epoch)
+        return losses
 
     # ---- public API ----
     def _as_input_dict(self, features) -> Dict[str, jnp.ndarray]:

@@ -236,6 +236,7 @@ class MultiLayerNetwork:
         self.listeners: List[Any] = []
         self._rng = jax.random.PRNGKey(conf.seed)
         self._train_step = None
+        self._scan_step = None
         self._output_fn = None
         self._layer_types: List[InputType] = []
 
@@ -367,6 +368,9 @@ class MultiLayerNetwork:
 
     # ---- compiled step ----
     def _build_train_step(self):
+        return jax.jit(self._build_step_body(), donate_argnums=(0, 1, 2))
+
+    def _build_step_body(self):
         conf = self.conf
 
         def step(params, state, opt_state, x, y, fmask, lmask, rng,
@@ -419,12 +423,47 @@ class MultiLayerNetwork:
                     lambda p_, u_: p_ - u_, params[name], upd)
             return new_params, new_state, new_opt, loss, rng, iteration + 1
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return step
 
     def _get_train_step(self):
         if self._train_step is None:
             self._train_step = self._build_train_step()
         return self._train_step
+
+    def _get_scan_step(self):
+        if self._scan_step is None:
+            from deeplearning4j_tpu.utils.scan_fit import make_scan_step
+            self._scan_step = make_scan_step(self._build_step_body())
+        return self._scan_step
+
+    def fit_steps(self, xs, ys, features_masks=None, labels_masks=None):
+        """Run `k = xs.shape[0]` training steps in one device dispatch.
+
+        `xs`/`ys` (and optional masks) carry a leading steps axis:
+        `[k, batch, ...]`.  Equivalent to `k` sequential `fit(x, y)`
+        calls (same math, same updater/iteration semantics) but compiled
+        as a single `lax.scan`, eliminating per-step host→device dispatch
+        latency.  Listeners fire once per block with the final loss;
+        per-step losses are returned as a length-k array."""
+        from deeplearning4j_tpu.utils.counters import advance, device_counters
+        xs = jnp.asarray(xs)
+        ys = jnp.asarray(ys)
+        if xs.shape[0] != ys.shape[0]:
+            raise ValueError(f"steps axis mismatch: xs {xs.shape[0]} vs "
+                             f"ys {ys.shape[0]}")
+        fm = None if features_masks is None else jnp.asarray(features_masks)
+        lm = None if labels_masks is None else jnp.asarray(labels_masks)
+        step = self._get_scan_step()
+        it_dev, ep_dev = device_counters(self)
+        (self.params_, self.state_, self.opt_state_, losses, self._rng,
+         new_it) = step(self.params_, self.state_, self.opt_state_,
+                        (xs, ys, fm, lm), self._rng, it_dev, ep_dev)
+        self._score = losses[-1]
+        self._last_batch_size = int(xs.shape[1])
+        advance(self, new_it, steps=int(xs.shape[0]))
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, self.epoch)
+        return losses
 
     # ---- public API ----
     def fit(self, data, labels=None, *, epochs: int = 1, features_mask=None,

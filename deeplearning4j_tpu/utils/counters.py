@@ -28,10 +28,10 @@ def device_counters(model):
     return model._iter_dev, model._epoch_dev
 
 
-def advance(model, new_iter_dev) -> None:
-    """Record a completed step: store the device-side `iteration + 1`
+def advance(model, new_iter_dev, steps: int = 1) -> None:
+    """Record `steps` completed steps: store the device-side counter
     returned by the compiled step and advance the host shadow in lockstep
     (no sync forced)."""
     model._iter_dev = new_iter_dev
-    model.iteration += 1
+    model.iteration += steps
     model._iter_sync = model.iteration

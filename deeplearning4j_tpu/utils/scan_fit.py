@@ -1,0 +1,35 @@
+"""Fused multi-step training dispatch (shared by MultiLayerNetwork,
+ComputationGraph).
+
+The TPU-native form of the reference's `fit(DataSetIterator)` hot loop
+(`MultiLayerNetwork.fit(DataSetIterator)` upstream): per-step host
+dispatch costs ~3 ms/step through a remote PJRT link (measured,
+bench_artifacts/PERF_ANALYSIS.md round 5), so steady-state training
+scans a compiled step over a device-resident `[k, batch, ...]` block —
+one host dispatch per k steps, with params/updater-state/rng/iteration
+flowing step-to-step as scan carries.
+"""
+import jax
+
+
+def make_scan_step(body):
+    """Wrap a train-step `body` into a jitted k-step scan.
+
+    `body(params, state, opt_state, *batch, rng, iteration, epoch)` must
+    return `(params, state, opt_state, loss, rng, iteration + 1)` — the
+    contract of `_build_step_body` in both network classes.  The returned
+    function takes `batches`, a tuple whose array leaves carry a leading
+    steps axis, and returns the final carry plus the per-step losses.
+    """
+    def many(params, state, opt_state, batches, rng, iteration, epoch):
+        def tick(carry, batch):
+            p, s, o, r, it = carry
+            p, s, o, loss, r, it = body(p, s, o, *batch, r, it, epoch)
+            return (p, s, o, r, it), loss
+
+        (params, state, opt_state, rng, iteration), losses = \
+            jax.lax.scan(tick, (params, state, opt_state, rng, iteration),
+                         batches)
+        return params, state, opt_state, losses, rng, iteration
+
+    return jax.jit(many, donate_argnums=(0, 1, 2))

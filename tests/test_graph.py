@@ -6,6 +6,7 @@ multi-input/multi-output, merge/elementwise vertices, residual topology,
 JSON round-trip, save/load, gradients vs finite differences.
 """
 import numpy as np
+import jax
 import jax.numpy as jnp
 import pytest
 
@@ -237,3 +238,31 @@ def test_cg_gradient_checkpointing_matches_plain():
                                np.asarray(b_.params()), atol=1e-6)
     assert ComputationGraphConfiguration.from_json(
         b_.conf.to_json()).remat
+
+
+def test_cg_fit_steps_matches_sequential_fit():
+    """ComputationGraph.fit_steps == k sequential fit() calls, bit-exact."""
+    rng = np.random.RandomState(0)
+    xs = rng.rand(4, 8, 6).astype(np.float32)
+    ys = np.eye(3, dtype=np.float32)[rng.randint(0, 3, (4, 8))]
+
+    def build():
+        conf = (GraphBuilder().seed(11)
+                .updater(Adam(1e-2))
+                .add_inputs("in")
+                .add_layer("d", DenseLayer(n_out=16, activation="relu"), "in")
+                .add_layer("out", OutputLayer(n_out=3, loss="mcxent",
+                                              activation="softmax"), "d")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(6)).build())
+        return ComputationGraph(conf).init()
+
+    a, b = build(), build()
+    for i in range(4):
+        a.fit(xs[i], ys[i])
+    losses = b.fit_steps(xs, ys)
+    assert losses.shape == (4,)
+    for la, lb in zip(jax.tree_util.tree_leaves(a.params_),
+                      jax.tree_util.tree_leaves(b.params_)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    assert a.iteration == b.iteration == 4

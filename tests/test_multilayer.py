@@ -199,3 +199,25 @@ def test_gradient_checkpointing_matches_plain():
     # config round-trips the flag
     from deeplearning4j_tpu.nn import MultiLayerConfiguration
     assert MultiLayerConfiguration.from_json(b_.conf.to_json()).remat
+
+
+def test_fit_steps_matches_sequential_fit():
+    """fit_steps (one lax.scan dispatch over k steps) must be bit-equal to
+    k sequential fit() calls — same updater math, rng chain, counters."""
+    rng = np.random.RandomState(3)
+    xs = rng.rand(5, 8, 2).astype(np.float32)
+    ys = np.eye(2, dtype=np.float32)[rng.randint(0, 2, (5, 8))]
+
+    a = MultiLayerNetwork(mlp_conf()).init()
+    b = MultiLayerNetwork(mlp_conf()).init()
+    for i in range(5):
+        a.fit(xs[i], ys[i])
+    losses = b.fit_steps(xs, ys)
+    assert losses.shape == (5,)
+    np.testing.assert_allclose(np.asarray(a.params()),
+                               np.asarray(b.params()), atol=0)
+    assert a.iteration == b.iteration == 5
+    assert abs(a.score() - b.score()) < 1e-7
+    # mixing modes keeps the counter chain intact
+    b.fit(xs[0], ys[0])
+    assert b.iteration == 6
