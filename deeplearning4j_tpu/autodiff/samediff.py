@@ -482,6 +482,7 @@ class SameDiff:
         self.iteration = 0
         self.epoch = 0
         self._train_step = None
+        self._scan_step = None
         self._output_fns: Dict[Tuple[str, ...], Callable] = {}
         self._key = jax.random.PRNGKey(0)
         self.math = SDMath(self)
@@ -518,6 +519,7 @@ class SameDiff:
 
     def _invalidate(self):
         self._train_step = None
+        self._scan_step = None
         self._output_fns = {}
 
     # ---- declaration API ----
@@ -777,7 +779,7 @@ class SameDiff:
                     loss = loss + 0.5 * cfg.l2 * jnp.sum(arr * arr)
         return loss
 
-    def _build_train_step(self):
+    def _build_step_body(self):
         cfg = self.training_config
         has_rng = RNG_FEED in self._nodes   # static at trace time; the step
         # cache is invalidated whenever the graph mutates
@@ -797,7 +799,27 @@ class SameDiff:
                                               variables, upd)
             return new_vars, new_opt, loss, rng, iteration + 1
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        return step
+
+    def _build_train_step(self):
+        return jax.jit(self._build_step_body(), donate_argnums=(0, 1))
+
+    def _build_scan_step(self):
+        """k steps per dispatch (see utils/scan_fit.py); SameDiff's carry
+        is (variables, opt_state, rng, iteration), scanning over feeds."""
+        body = self._build_step_body()
+
+        def many(variables, opt_state, feeds, rng, iteration, epoch):
+            def tick(carry, feed):
+                v, o, r, it = carry
+                v, o, loss, r, it = body(v, o, feed, r, it, epoch)
+                return (v, o, r, it), loss
+
+            (variables, opt_state, rng, iteration), losses = jax.lax.scan(
+                tick, (variables, opt_state, rng, iteration), feeds)
+            return variables, opt_state, losses, rng, iteration
+
+        return jax.jit(many, donate_argnums=(0, 1))
 
     def fit(self, data=None, labels=None, *, iterator=None, epochs: int = 1,
             feeds: Optional[Dict[str, Any]] = None) -> "SameDiff":
@@ -857,6 +879,34 @@ class SameDiff:
             it_dev, ep_dev)
         self._score = loss
         advance(self, new_it)
+
+    def fit_steps(self, feeds: Dict[str, Any]):
+        """Run k training steps in one device dispatch: every feed array
+        carries a leading `[k, batch, ...]` steps axis.  Same math as k
+        sequential `fit(feeds=...)` calls (variables/updater-state/rng/
+        iteration flow step-to-step as scan carries); returns the
+        length-k per-step loss array."""
+        from deeplearning4j_tpu.utils.counters import advance, device_counters
+        if self.training_config is None:
+            raise ValueError("set_training_config(...) first (reference "
+                             "throws the same)")
+        if not self._loss_names:
+            raise ValueError("set_loss_variables(...) first")
+        if self.opt_state_ is None:
+            self.opt_state_ = self.training_config.updater.init_state(
+                self.variables_)
+        from deeplearning4j_tpu.utils.scan_fit import check_steps_axes
+        feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
+        k = check_steps_axes(feeds.items())
+        if self._scan_step is None:
+            self._scan_step = self._build_scan_step()
+        it_dev, ep_dev = device_counters(self)
+        (self.variables_, self.opt_state_, losses, self._key,
+         new_it) = self._scan_step(self.variables_, self.opt_state_, feeds,
+                                   self._key, it_dev, ep_dev)
+        self._score = losses[-1]
+        advance(self, new_it, steps=int(k))
+        return losses
 
     def score(self) -> float:
         s = getattr(self, "_score", None)

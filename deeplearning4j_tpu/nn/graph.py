@@ -660,17 +660,11 @@ class ComputationGraph:
             labels_masks = [labels_masks]
         lmasks = (None if labels_masks is None
                   else [jnp.asarray(m) for m in labels_masks])
-        k = next(iter(inputs.values())).shape[0]
-        for name, arr in inputs.items():
-            if arr.shape[0] != k:
-                raise ValueError(f"steps axis mismatch: input '{name}' has "
-                                 f"{arr.shape[0]} steps, expected {k}")
-        for i, lab in enumerate(labels):
-            if lab.shape[0] != k:
-                raise ValueError(f"steps axis mismatch: label {i} has "
-                                 f"{lab.shape[0]} steps, expected {k} — "
-                                 f"every array needs a leading [k, batch] "
-                                 f"steps axis")
+        from deeplearning4j_tpu.utils.scan_fit import check_steps_axes
+        k = check_steps_axes(
+            [(f"input '{n}'", a) for n, a in inputs.items()]
+            + [(f"label {i}", l) for i, l in enumerate(labels)]
+            + [(f"labels_mask {i}", m) for i, m in enumerate(lmasks or [])])
         step = self._get_scan_step()
         it_dev, ep_dev = device_counters(self)
         (self.params_, self.state_, self.opt_state_, losses, self._rng,

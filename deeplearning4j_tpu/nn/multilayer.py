@@ -446,13 +446,13 @@ class MultiLayerNetwork:
         latency.  Listeners fire once per block with the final loss;
         per-step losses are returned as a length-k array."""
         from deeplearning4j_tpu.utils.counters import advance, device_counters
+        from deeplearning4j_tpu.utils.scan_fit import check_steps_axes
         xs = jnp.asarray(xs)
         ys = jnp.asarray(ys)
-        if xs.shape[0] != ys.shape[0]:
-            raise ValueError(f"steps axis mismatch: xs {xs.shape[0]} vs "
-                             f"ys {ys.shape[0]}")
         fm = None if features_masks is None else jnp.asarray(features_masks)
         lm = None if labels_masks is None else jnp.asarray(labels_masks)
+        check_steps_axes([("xs", xs), ("ys", ys), ("features_masks", fm),
+                          ("labels_masks", lm)])
         step = self._get_scan_step()
         it_dev, ep_dev = device_counters(self)
         (self.params_, self.state_, self.opt_state_, losses, self._rng,
@@ -467,9 +467,15 @@ class MultiLayerNetwork:
 
     # ---- public API ----
     def fit(self, data, labels=None, *, epochs: int = 1, features_mask=None,
-            labels_mask=None):
+            labels_mask=None, fused_steps: int = 1):
         """fit(x, y) for one batch, or fit(iterator, epochs=N)
-        (reference `fit(INDArray, INDArray)` / `fit(DataSetIterator, int)`)."""
+        (reference `fit(INDArray, INDArray)` / `fit(DataSetIterator, int)`).
+
+        `fused_steps=k` stacks k consecutive batches and trains them in a
+        single compiled dispatch (`fit_steps`), hiding per-step host
+        dispatch latency; odd-sized tail batches (and any batch whose
+        shape differs from its block) fall back to the per-step path, so
+        results are identical to `fused_steps=1` up to listener cadence."""
         if labels is not None:
             self._fit_batch(jnp.asarray(data), jnp.asarray(labels),
                             features_mask, labels_mask)
@@ -477,17 +483,41 @@ class MultiLayerNetwork:
         for _ in range(epochs):
             if hasattr(data, "reset"):
                 data.reset()
-            for ds in data:
-                fm = getattr(ds, "features_mask", None)
-                lm = getattr(ds, "labels_mask", None)
-                self._fit_batch(jnp.asarray(ds.features), jnp.asarray(ds.labels),
-                                None if fm is None else jnp.asarray(fm),
-                                None if lm is None else jnp.asarray(lm))
+            if fused_steps > 1:
+                self._fit_epoch_fused(data, fused_steps)
+            else:
+                for ds in data:
+                    fm = getattr(ds, "features_mask", None)
+                    lm = getattr(ds, "labels_mask", None)
+                    self._fit_batch(jnp.asarray(ds.features),
+                                    jnp.asarray(ds.labels),
+                                    None if fm is None else jnp.asarray(fm),
+                                    None if lm is None else jnp.asarray(lm))
             self.epoch += 1
             for lst in self.listeners:
                 if hasattr(lst, "on_epoch_end"):
                     lst.on_epoch_end(self)
         return self
+
+    def _fit_epoch_fused(self, iterator, k: int):
+        from deeplearning4j_tpu.utils.scan_fit import blocks_of
+        for block in blocks_of(iterator, k):
+            if len(block) == 1:
+                ds = block[0]
+                fm = getattr(ds, "features_mask", None)
+                lm = getattr(ds, "labels_mask", None)
+                self._fit_batch(jnp.asarray(ds.features),
+                                jnp.asarray(ds.labels),
+                                None if fm is None else jnp.asarray(fm),
+                                None if lm is None else jnp.asarray(lm))
+            else:
+                fms = [getattr(ds, "features_mask", None) for ds in block]
+                lms = [getattr(ds, "labels_mask", None) for ds in block]
+                self.fit_steps(
+                    np.stack([np.asarray(ds.features) for ds in block]),
+                    np.stack([np.asarray(ds.labels) for ds in block]),
+                    None if fms[0] is None else np.stack(fms),
+                    None if lms[0] is None else np.stack(lms))
 
     def _fit_batch(self, x, y, fmask=None, lmask=None):
         from deeplearning4j_tpu.utils.counters import advance, device_counters

@@ -9,7 +9,60 @@ scans a compiled step over a device-resident `[k, batch, ...]` block —
 one host dispatch per k steps, with params/updater-state/rng/iteration
 flowing step-to-step as scan carries.
 """
+import numpy as np
+
 import jax
+
+
+def blocks_of(iterator, k: int):
+    """Group consecutive same-shape DataSets from `iterator` into lists of
+    exactly `k` (ready for one fused `fit_steps` dispatch).  Batches that
+    don't fill a block — the epoch tail, or a shape change mid-stream —
+    are yielded as single-element lists so the caller takes the per-step
+    path instead of compiling a new scan executable for a one-off k."""
+    def key(ds):
+        fm = getattr(ds, "features_mask", None)
+        lm = getattr(ds, "labels_mask", None)
+        return (np.shape(ds.features), np.shape(ds.labels),
+                None if fm is None else np.shape(fm),
+                None if lm is None else np.shape(lm))
+
+    buf, buf_key = [], None
+    for ds in iterator:
+        dk = key(ds)
+        if buf and dk != buf_key:
+            for b in buf:
+                yield [b]
+            buf = []
+        buf.append(ds)
+        buf_key = dk
+        if len(buf) == k:
+            yield buf
+            buf = []
+    for b in buf:
+        yield [b]
+
+
+def check_steps_axes(named_arrays):
+    """Validate that every non-None array shares one leading steps axis.
+
+    `named_arrays` is an iterable of (name, array-or-None); returns k.
+    Raising here (with the offending name) beats the opaque
+    'different leading axis sizes' error lax.scan gives after tracing."""
+    k, ref = None, None
+    for name, a in named_arrays:
+        if a is None:
+            continue
+        if k is None:
+            k, ref = a.shape[0], name
+        elif a.shape[0] != k:
+            raise ValueError(
+                f"steps axis mismatch: '{name}' has {a.shape[0]} steps but "
+                f"'{ref}' has {k} — every array needs the same leading "
+                f"[k, batch, ...] steps axis")
+    if k is None:
+        raise ValueError("fit_steps needs at least one array input")
+    return k
 
 
 def make_scan_step(body):

@@ -180,10 +180,7 @@ class BertModel:
                                  -1))
 
     # ---- compiled steps ----
-    def _step(self, kind: str):
-        if kind in self._steps:
-            return self._steps[kind]
-
+    def _step_body(self, kind: str):
         loss_fn = self._mlm_loss if kind == "mlm" else self._cls_loss
 
         def step(params, opt_state, iteration, epoch, *batch):
@@ -195,8 +192,33 @@ class BertModel:
                                                 params, upd)
             return new_params, new_opt, loss, iteration + 1
 
-        self._steps[kind] = jax.jit(step, donate_argnums=(0, 1))
+        return step
+
+    def _step(self, kind: str):
+        if kind not in self._steps:
+            self._steps[kind] = jax.jit(self._step_body(kind),
+                                        donate_argnums=(0, 1))
         return self._steps[kind]
+
+    def _scan_step(self, kind: str):
+        """k steps per dispatch (see utils/scan_fit.py for the rationale);
+        BERT's step carry is (params, opt, iteration) — no state/rng."""
+        key = "scan_" + kind
+        if key not in self._steps:
+            body = self._step_body(kind)
+
+            def many(params, opt_state, iteration, epoch, batches):
+                def tick(carry, batch):
+                    p, o, it = carry
+                    p, o, loss, it = body(p, o, it, epoch, *batch)
+                    return (p, o, it), loss
+
+                (params, opt_state, iteration), losses = jax.lax.scan(
+                    tick, (params, opt_state, iteration), batches)
+                return params, opt_state, losses, iteration
+
+            self._steps[key] = jax.jit(many, donate_argnums=(0, 1))
+        return self._steps[key]
 
 
     # ---- public API ----
@@ -231,6 +253,34 @@ class BertModel:
         # float() round-trip stalls the dispatch pipeline (measured 2x step
         # time on v5e via the remote tunnel); score() materializes lazily
         return loss
+
+    def fit_steps(self, mds):
+        """Run k train steps in one device dispatch: every array in `mds`
+        carries a leading `[k, batch]` steps axis.  Same math as k
+        sequential `fit_batch` calls; returns the length-k loss array."""
+        from deeplearning4j_tpu.utils.counters import advance, device_counters
+        from deeplearning4j_tpu.utils.scan_fit import check_steps_axes
+        ids, input_mask = [jnp.asarray(f) for f in mds.features]
+        (labels,) = [jnp.asarray(l) for l in mds.labels]
+        lm0 = None if mds.labels_masks is None \
+            else jnp.asarray(mds.labels_masks[0])
+        k = check_steps_axes([("ids", ids), ("input_mask", input_mask),
+                              ("labels", labels), ("labels_mask", lm0)])
+        it, ep = device_counters(self)
+        if mds.labels_masks is not None:                 # masked LM
+            lmask = lm0
+            step = self._scan_step("mlm")
+            self.params_, self.opt_state_, losses, new_it = step(
+                self.params_, self.opt_state_, it, ep,
+                (ids.astype(jnp.int32), input_mask, labels, lmask))
+        else:                                            # classification
+            step = self._scan_step("cls")
+            self.params_, self.opt_state_, losses, new_it = step(
+                self.params_, self.opt_state_, it, ep,
+                (ids.astype(jnp.int32), input_mask, labels))
+        self._score = losses[-1]
+        advance(self, new_it, steps=int(k))
+        return losses
 
     def score(self) -> float:
         s = getattr(self, "_score", None)

@@ -105,3 +105,32 @@ def test_bert_save_load_resume(tmp_path):
     l1 = model.fit_batch(mds)
     l2 = m2.fit_batch(mds)
     assert np.isclose(l1, l2, rtol=1e-4)
+
+
+def test_bert_fit_steps_matches_sequential():
+    """fit_steps (k steps fused into one lax.scan dispatch) must match k
+    sequential fit_batch calls bit-exactly on the MLM path."""
+    from deeplearning4j_tpu.data.dataset import MultiDataSet
+    import jax
+
+    rng = np.random.RandomState(0)
+    k, b, t, vocab = 4, 8, 16, 100
+    ids = rng.randint(0, vocab, (k, b, t)).astype(np.int32)
+    mask = np.ones((k, b, t), np.float32)
+    lmask = (rng.rand(k, b, t) < 0.15).astype(np.float32)
+
+    a = BertModel(BertConfig.tiny(), seed=0, updater=Adam(1e-3))
+    b_ = BertModel(BertConfig.tiny(), seed=0, updater=Adam(1e-3))
+    seq_losses = []
+    for i in range(k):
+        mds = MultiDataSet(features=[ids[i], mask[i]], labels=[ids[i]],
+                           labels_masks=[lmask[i]])
+        seq_losses.append(float(a.fit_batch(mds)))
+    stacked = MultiDataSet(features=[ids, mask], labels=[ids],
+                           labels_masks=[lmask])
+    losses = b_.fit_steps(stacked)
+    np.testing.assert_allclose(np.asarray(losses), seq_losses, rtol=1e-6)
+    for la, lb in zip(jax.tree_util.tree_leaves(a.params_),
+                      jax.tree_util.tree_leaves(b_.params_)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    assert a.iteration == b_.iteration == k

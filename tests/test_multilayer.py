@@ -221,3 +221,18 @@ def test_fit_steps_matches_sequential_fit():
     # mixing modes keeps the counter chain intact
     b.fit(xs[0], ys[0])
     assert b.iteration == 6
+
+
+def test_fit_iterator_fused_steps_matches_sequential():
+    """fit(iterator, fused_steps=4) == fit(iterator): blocks of 4 go
+    through one scan dispatch, the odd tail through the per-step path."""
+    x, y = two_moons(n=72)          # 144 samples -> 9 batches of 16
+    a = MultiLayerNetwork(mlp_conf()).init()
+    b = MultiLayerNetwork(mlp_conf()).init()
+    ita = ArrayDataSetIterator(x, y, batch_size=16)
+    itb = ArrayDataSetIterator(x, y, batch_size=16)
+    a.fit(ita, epochs=2)
+    b.fit(itb, epochs=2, fused_steps=4)
+    np.testing.assert_allclose(np.asarray(a.params()),
+                               np.asarray(b.params()), atol=0)
+    assert a.iteration == b.iteration == 18

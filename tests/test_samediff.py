@@ -473,3 +473,31 @@ def test_rng_tags_survive_save_load():
     outs = sd2.output({}, a.name, b.name)
     assert not np.allclose(np.asarray(outs[a.name]),
                            np.asarray(outs[b.name]))
+
+
+def test_sd_fit_steps_matches_sequential():
+    """SameDiff.fit_steps == k sequential fit() calls, bit-exact."""
+    import jax
+
+    def build():
+        sd = _mlp_sd()
+        sd.set_training_config(TrainingConfig(
+            updater=Adam(1e-2),
+            data_set_feature_mapping=["input"],
+            data_set_label_mapping=["label"]))
+        return sd
+
+    x, y = _toy()
+    k = 5
+    a, b = build(), build()
+    for _ in range(k):
+        a.fit(x, y)
+    feeds = {"input": np.broadcast_to(x, (k,) + x.shape).copy(),
+             "label": np.broadcast_to(y, (k,) + y.shape).copy()}
+    losses = b.fit_steps(feeds)
+    assert losses.shape == (k,)
+    for la, lb in zip(jax.tree_util.tree_leaves(a.variables_),
+                      jax.tree_util.tree_leaves(b.variables_)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    assert a.iteration == b.iteration == k
+    assert abs(a.score() - b.score()) < 1e-7
