@@ -35,9 +35,14 @@ def _time_steps(fit_fn, n_warmup, n_steps, sync_fn=None):
 
 
 def bench_resnet50(batch=64, steps=20, image=224, classes=1000,
-                   compute_dtype="bfloat16"):
+                   compute_dtype="bfloat16", fused_steps=10):
     """bf16 compute / f32 master params — the TPU-native precision choice
-    (f32: ~375 samples/sec on v5e; bf16: ~1636)."""
+    (f32: ~375 samples/sec on v5e; bf16: ~1636).
+
+    `fused_steps=k` uses the fit_steps scan dispatch (one host dispatch
+    per k steps) — the measured per-step host gap through the remote
+    PJRT tunnel is ~3 ms (PERF_ANALYSIS.md r5).  Falls back to per-step
+    dispatch if the fused path fails to compile."""
     import jax
     from deeplearning4j_tpu.train.updaters import Nesterovs
     from deeplearning4j_tpu.zoo import ResNet50
@@ -51,6 +56,28 @@ def bench_resnet50(batch=64, steps=20, image=224, classes=1000,
     x = jnp.asarray(rng.rand(batch, image, image, 3).astype(np.float32))
     y = jnp.asarray(
         np.eye(classes, dtype=np.float32)[rng.randint(0, classes, batch)])
+
+    if fused_steps and fused_steps > 1 and steps % fused_steps == 0:
+        xs = jnp.broadcast_to(x, (fused_steps,) + x.shape)
+        ys = jnp.broadcast_to(y, (fused_steps,) + y.shape)
+        try:
+            def block():
+                net.fit_steps(xs, ys)
+
+            dt = _time_steps(block, n_warmup=1,
+                             n_steps=steps // fused_steps,
+                             sync_fn=lambda: float(net.score()))
+            return batch * steps / dt
+        except Exception as e:   # pragma: no cover - fused path is a perf
+            print(f"[bench] fused path failed ({type(e).__name__}: "
+                  f"{str(e)[:120]}); falling back to per-step dispatch",
+                  file=sys.stderr, flush=True)
+            # a runtime failure may strike AFTER buffer donation deleted
+            # params_/state_/opt_state_ — rebuild before the fallback
+            net = ResNet50(n_classes=classes,
+                           input_shape=(image, image, 3),
+                           updater=Nesterovs(0.1, 0.9),
+                           compute_dtype=compute_dtype).init_model()
 
     def step():
         net.fit(x, y)

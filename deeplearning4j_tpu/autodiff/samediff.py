@@ -807,19 +807,15 @@ class SameDiff:
     def _build_scan_step(self):
         """k steps per dispatch (see utils/scan_fit.py); SameDiff's carry
         is (variables, opt_state, rng, iteration), scanning over feeds."""
+        from deeplearning4j_tpu.utils.scan_fit import make_scan_step
         body = self._build_step_body()
 
-        def many(variables, opt_state, feeds, rng, iteration, epoch):
-            def tick(carry, feed):
-                v, o, r, it = carry
-                v, o, loss, r, it = body(v, o, feed, r, it, epoch)
-                return (v, o, r, it), loss
+        def tick(carry, epoch, feed):
+            v, o, r, it = carry
+            v, o, loss, r, it = body(v, o, feed, r, it, epoch)
+            return (v, o, r, it), loss
 
-            (variables, opt_state, rng, iteration), losses = jax.lax.scan(
-                tick, (variables, opt_state, rng, iteration), feeds)
-            return variables, opt_state, losses, rng, iteration
-
-        return jax.jit(many, donate_argnums=(0, 1))
+        return make_scan_step(tick)
 
     def fit(self, data=None, labels=None, *, iterator=None, epochs: int = 1,
             feeds: Optional[Dict[str, Any]] = None) -> "SameDiff":
@@ -901,9 +897,10 @@ class SameDiff:
         if self._scan_step is None:
             self._scan_step = self._build_scan_step()
         it_dev, ep_dev = device_counters(self)
-        (self.variables_, self.opt_state_, losses, self._key,
-         new_it) = self._scan_step(self.variables_, self.opt_state_, feeds,
-                                   self._key, it_dev, ep_dev)
+        ((self.variables_, self.opt_state_, self._key, new_it),
+         losses) = self._scan_step(
+            (self.variables_, self.opt_state_, self._key, it_dev),
+            ep_dev, feeds)
         self._score = losses[-1]
         advance(self, new_it, steps=int(k))
         return losses

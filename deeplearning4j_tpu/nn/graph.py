@@ -644,7 +644,16 @@ class ComputationGraph:
     def _get_scan_step(self):
         if self._scan_step is None:
             from deeplearning4j_tpu.utils.scan_fit import make_scan_step
-            self._scan_step = make_scan_step(self._build_step_body())
+            body = self._build_step_body()
+
+            def tick(carry, epoch, batch):
+                p, s, o, r, it = carry
+                ins, ys, lm = batch
+                p, s, o, loss, r, it = body(p, s, o, ins, ys, lm,
+                                            r, it, epoch)
+                return (p, s, o, r, it), loss
+
+            self._scan_step = make_scan_step(tick)
         return self._scan_step
 
     def fit_steps(self, features, labels, labels_masks=None):
@@ -667,9 +676,10 @@ class ComputationGraph:
             + [(f"labels_mask {i}", m) for i, m in enumerate(lmasks or [])])
         step = self._get_scan_step()
         it_dev, ep_dev = device_counters(self)
-        (self.params_, self.state_, self.opt_state_, losses, self._rng,
-         new_it) = step(self.params_, self.state_, self.opt_state_,
-                        (inputs, labels, lmasks), self._rng, it_dev, ep_dev)
+        ((self.params_, self.state_, self.opt_state_, self._rng, new_it),
+         losses) = step((self.params_, self.state_, self.opt_state_,
+                         self._rng, it_dev), ep_dev,
+                        (inputs, labels, lmasks))
         self._score = losses[-1]
         self._last_batch_size = int(next(iter(inputs.values())).shape[1])
         advance(self, new_it, steps=int(k))

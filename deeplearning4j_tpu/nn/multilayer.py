@@ -433,7 +433,14 @@ class MultiLayerNetwork:
     def _get_scan_step(self):
         if self._scan_step is None:
             from deeplearning4j_tpu.utils.scan_fit import make_scan_step
-            self._scan_step = make_scan_step(self._build_step_body())
+            body = self._build_step_body()
+
+            def tick(carry, epoch, batch):
+                p, s, o, r, it = carry
+                p, s, o, loss, r, it = body(p, s, o, *batch, r, it, epoch)
+                return (p, s, o, r, it), loss
+
+            self._scan_step = make_scan_step(tick)
         return self._scan_step
 
     def fit_steps(self, xs, ys, features_masks=None, labels_masks=None):
@@ -455,9 +462,9 @@ class MultiLayerNetwork:
                           ("labels_masks", lm)])
         step = self._get_scan_step()
         it_dev, ep_dev = device_counters(self)
-        (self.params_, self.state_, self.opt_state_, losses, self._rng,
-         new_it) = step(self.params_, self.state_, self.opt_state_,
-                        (xs, ys, fm, lm), self._rng, it_dev, ep_dev)
+        ((self.params_, self.state_, self.opt_state_, self._rng, new_it),
+         losses) = step((self.params_, self.state_, self.opt_state_,
+                         self._rng, it_dev), ep_dev, (xs, ys, fm, lm))
         self._score = losses[-1]
         self._last_batch_size = int(xs.shape[1])
         advance(self, new_it, steps=int(xs.shape[0]))
@@ -477,6 +484,10 @@ class MultiLayerNetwork:
         shape differs from its block) fall back to the per-step path, so
         results are identical to `fused_steps=1` up to listener cadence."""
         if labels is not None:
+            if fused_steps != 1:
+                raise ValueError(
+                    "fused_steps applies to the iterator form only; for a "
+                    "pre-stacked [k, batch, ...] block call fit_steps(xs, ys)")
             self._fit_batch(jnp.asarray(data), jnp.asarray(labels),
                             features_mask, labels_mask)
             return self
@@ -487,29 +498,25 @@ class MultiLayerNetwork:
                 self._fit_epoch_fused(data, fused_steps)
             else:
                 for ds in data:
-                    fm = getattr(ds, "features_mask", None)
-                    lm = getattr(ds, "labels_mask", None)
-                    self._fit_batch(jnp.asarray(ds.features),
-                                    jnp.asarray(ds.labels),
-                                    None if fm is None else jnp.asarray(fm),
-                                    None if lm is None else jnp.asarray(lm))
+                    self._fit_dataset(ds)
             self.epoch += 1
             for lst in self.listeners:
                 if hasattr(lst, "on_epoch_end"):
                     lst.on_epoch_end(self)
         return self
 
+    def _fit_dataset(self, ds):
+        fm = getattr(ds, "features_mask", None)
+        lm = getattr(ds, "labels_mask", None)
+        self._fit_batch(jnp.asarray(ds.features), jnp.asarray(ds.labels),
+                        None if fm is None else jnp.asarray(fm),
+                        None if lm is None else jnp.asarray(lm))
+
     def _fit_epoch_fused(self, iterator, k: int):
         from deeplearning4j_tpu.utils.scan_fit import blocks_of
         for block in blocks_of(iterator, k):
             if len(block) == 1:
-                ds = block[0]
-                fm = getattr(ds, "features_mask", None)
-                lm = getattr(ds, "labels_mask", None)
-                self._fit_batch(jnp.asarray(ds.features),
-                                jnp.asarray(ds.labels),
-                                None if fm is None else jnp.asarray(fm),
-                                None if lm is None else jnp.asarray(lm))
+                self._fit_dataset(block[0])
             else:
                 fms = [getattr(ds, "features_mask", None) for ds in block]
                 lms = [getattr(ds, "labels_mask", None) for ds in block]

@@ -65,24 +65,20 @@ def check_steps_axes(named_arrays):
     return k
 
 
-def make_scan_step(body):
-    """Wrap a train-step `body` into a jitted k-step scan.
+def make_scan_step(tick):
+    """Wrap a per-class `tick` adapter into the jitted k-step scan.
 
-    `body(params, state, opt_state, *batch, rng, iteration, epoch)` must
-    return `(params, state, opt_state, loss, rng, iteration + 1)` — the
-    contract of `_build_step_body` in both network classes.  The returned
-    function takes `batches`, a tuple whose array leaves carry a leading
-    steps axis, and returns the final carry plus the per-step losses.
-    """
-    def many(params, state, opt_state, batches, rng, iteration, epoch):
-        def tick(carry, batch):
-            p, s, o, r, it = carry
-            p, s, o, loss, r, it = body(p, s, o, *batch, r, it, epoch)
-            return (p, s, o, r, it), loss
+    `tick(carry, epoch, batch) -> (carry, loss)` adapts one class's step
+    body to a scan carry (each class carries a different tuple: MLN/CG
+    `(params, state, opt, rng, it)`, SameDiff `(vars, opt, rng, it)`,
+    BERT `(params, opt, it)`).  The returned function is
+    `step(carry, epoch, batches) -> (carry, losses)`; the whole carry is
+    donated (every element is replaced from the return by the callers —
+    `advance()` for the counter, attribute reassignment for the rest).
+    `epoch` is NOT donated: `device_counters` caches it across calls."""
+    def many(carry, epoch, batches):
+        carry, losses = jax.lax.scan(
+            lambda c, b: tick(c, epoch, b), carry, batches)
+        return carry, losses
 
-        (params, state, opt_state, rng, iteration), losses = \
-            jax.lax.scan(tick, (params, state, opt_state, rng, iteration),
-                         batches)
-        return params, state, opt_state, losses, rng, iteration
-
-    return jax.jit(many, donate_argnums=(0, 1, 2))
+    return jax.jit(many, donate_argnums=(0,))

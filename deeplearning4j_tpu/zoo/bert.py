@@ -205,19 +205,15 @@ class BertModel:
         BERT's step carry is (params, opt, iteration) — no state/rng."""
         key = "scan_" + kind
         if key not in self._steps:
+            from deeplearning4j_tpu.utils.scan_fit import make_scan_step
             body = self._step_body(kind)
 
-            def many(params, opt_state, iteration, epoch, batches):
-                def tick(carry, batch):
-                    p, o, it = carry
-                    p, o, loss, it = body(p, o, it, epoch, *batch)
-                    return (p, o, it), loss
+            def tick(carry, epoch, batch):
+                p, o, it = carry
+                p, o, loss, it = body(p, o, it, epoch, *batch)
+                return (p, o, it), loss
 
-                (params, opt_state, iteration), losses = jax.lax.scan(
-                    tick, (params, opt_state, iteration), batches)
-                return params, opt_state, losses, iteration
-
-            self._steps[key] = jax.jit(many, donate_argnums=(0, 1))
+            self._steps[key] = make_scan_step(tick)
         return self._steps[key]
 
 
@@ -270,13 +266,13 @@ class BertModel:
         if mds.labels_masks is not None:                 # masked LM
             lmask = lm0
             step = self._scan_step("mlm")
-            self.params_, self.opt_state_, losses, new_it = step(
-                self.params_, self.opt_state_, it, ep,
+            (self.params_, self.opt_state_, new_it), losses = step(
+                (self.params_, self.opt_state_, it), ep,
                 (ids.astype(jnp.int32), input_mask, labels, lmask))
         else:                                            # classification
             step = self._scan_step("cls")
-            self.params_, self.opt_state_, losses, new_it = step(
-                self.params_, self.opt_state_, it, ep,
+            (self.params_, self.opt_state_, new_it), losses = step(
+                (self.params_, self.opt_state_, it), ep,
                 (ids.astype(jnp.int32), input_mask, labels))
         self._score = losses[-1]
         advance(self, new_it, steps=int(k))
