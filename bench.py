@@ -34,29 +34,17 @@ def _time_steps(fit_fn, n_warmup, n_steps, sync_fn=None):
     return time.perf_counter() - t0
 
 
-def bench_resnet50(batch=64, steps=20, image=224, classes=1000,
-                   compute_dtype="bfloat16", fused_steps=10):
-    """bf16 compute / f32 master params — the TPU-native precision choice
-    (f32: ~375 samples/sec on v5e; bf16: ~1636).
+def _time_train(make_net, x, y, steps, fused_steps):
+    """Train-throughput timing with the fused k-step dispatch.
 
     `fused_steps=k` uses the fit_steps scan dispatch (one host dispatch
-    per k steps) — the measured per-step host gap through the remote
-    PJRT tunnel is ~3 ms (PERF_ANALYSIS.md r5).  Falls back to per-step
-    dispatch if the fused path fails to compile."""
-    import jax
-    from deeplearning4j_tpu.train.updaters import Nesterovs
-    from deeplearning4j_tpu.zoo import ResNet50
-
+    per k steps) — the measured per-step host gap through the remote PJRT
+    tunnel is ~3 ms (PERF_ANALYSIS.md r5).  Falls back to per-step
+    dispatch if the fused path fails (rebuilding the net first: a runtime
+    failure may strike after buffer donation deleted the params)."""
     import jax.numpy as jnp
 
-    net = ResNet50(n_classes=classes, input_shape=(image, image, 3),
-                   updater=Nesterovs(0.1, 0.9),
-                   compute_dtype=compute_dtype).init_model()
-    rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.rand(batch, image, image, 3).astype(np.float32))
-    y = jnp.asarray(
-        np.eye(classes, dtype=np.float32)[rng.randint(0, classes, batch)])
-
+    net = make_net()
     if fused_steps and fused_steps > 1 and steps % fused_steps == 0:
         xs = jnp.broadcast_to(x, (fused_steps,) + x.shape)
         ys = jnp.broadcast_to(y, (fused_steps,) + y.shape)
@@ -64,45 +52,54 @@ def bench_resnet50(batch=64, steps=20, image=224, classes=1000,
             def block():
                 net.fit_steps(xs, ys)
 
-            dt = _time_steps(block, n_warmup=1,
-                             n_steps=steps // fused_steps,
-                             sync_fn=lambda: float(net.score()))
-            return batch * steps / dt
-        except Exception as e:   # pragma: no cover - fused path is a perf
+            return _time_steps(block, n_warmup=1,
+                               n_steps=steps // fused_steps,
+                               sync_fn=lambda: float(net.score()))
+        except Exception as e:   # pragma: no cover - perf fallback
             print(f"[bench] fused path failed ({type(e).__name__}: "
                   f"{str(e)[:120]}); falling back to per-step dispatch",
                   file=sys.stderr, flush=True)
-            # a runtime failure may strike AFTER buffer donation deleted
-            # params_/state_/opt_state_ — rebuild before the fallback
-            net = ResNet50(n_classes=classes,
-                           input_shape=(image, image, 3),
-                           updater=Nesterovs(0.1, 0.9),
-                           compute_dtype=compute_dtype).init_model()
+            net = make_net()
 
     def step():
         net.fit(x, y)
 
-    dt = _time_steps(step, n_warmup=3, n_steps=steps,
-                     sync_fn=lambda: float(net.score()))
+    return _time_steps(step, n_warmup=3, n_steps=steps,
+                       sync_fn=lambda: float(net.score()))
+
+
+def bench_resnet50(batch=64, steps=20, image=224, classes=1000,
+                   compute_dtype="bfloat16", fused_steps=10):
+    """bf16 compute / f32 master params — the TPU-native precision choice
+    (f32: ~375 samples/sec on v5e; bf16: ~1636)."""
+    from deeplearning4j_tpu.train.updaters import Nesterovs
+    from deeplearning4j_tpu.zoo import ResNet50
+
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(batch, image, image, 3).astype(np.float32))
+    y = jnp.asarray(
+        np.eye(classes, dtype=np.float32)[rng.randint(0, classes, batch)])
+
+    dt = _time_train(
+        lambda: ResNet50(n_classes=classes, input_shape=(image, image, 3),
+                         updater=Nesterovs(0.1, 0.9),
+                         compute_dtype=compute_dtype).init_model(),
+        x, y, steps, fused_steps)
     return batch * steps / dt
 
 
-def bench_lenet(batch=256, steps=30):
-    import jax
+def bench_lenet(batch=256, steps=30, fused_steps=10):
     from deeplearning4j_tpu.zoo import LeNet
 
     import jax.numpy as jnp
 
-    net = LeNet().init_model()
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.rand(batch, 28, 28, 1).astype(np.float32))
     y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.randint(0, 10, batch)])
 
-    def step():
-        net.fit(x, y)
-
-    dt = _time_steps(step, n_warmup=3, n_steps=steps,
-                     sync_fn=lambda: float(net.score()))
+    dt = _time_train(lambda: LeNet().init_model(), x, y, steps, fused_steps)
     return batch * steps / dt
 
 
@@ -128,6 +125,30 @@ def bench_bert_base(batch=64, steps=10, t=128, compute_dtype="bfloat16"):
     mds = MultiDataSet(features=[jnp.asarray(ids), jnp.asarray(mask)],
                        labels=[jnp.asarray(ids)],
                        labels_masks=[jnp.asarray(lmask)])   # sparse labels
+
+    fused = 5
+    if steps % fused == 0:
+        stk = MultiDataSet(
+            features=[jnp.broadcast_to(f, (fused,) + f.shape)
+                      for f in mds.features],
+            labels=[jnp.broadcast_to(l, (fused,) + l.shape)
+                    for l in mds.labels],
+            labels_masks=[jnp.broadcast_to(m, (fused,) + m.shape)
+                          for m in mds.labels_masks])
+        try:
+            def block():
+                model.fit_steps(stk)
+
+            dt = _time_steps(block, n_warmup=1, n_steps=steps // fused,
+                             sync_fn=lambda: model.score())
+            return batch * t * steps / dt
+        except Exception as e:   # pragma: no cover - perf fallback
+            print(f"[bench] bert fused path failed ({type(e).__name__}: "
+                  f"{str(e)[:120]}); per-step fallback",
+                  file=sys.stderr, flush=True)
+            model = BertModel(BertConfig.base(max_len=t,
+                                              compute_dtype=compute_dtype),
+                              updater=Adam(1e-4))
 
     def step():
         model.fit_batch(mds)
@@ -278,23 +299,20 @@ def bench_bert_tf_import(batch=32, steps=5, t=128, layers=12,
     return B * T * steps / dt
 
 
-def bench_lstm_charlm(batch=64, steps=10, t=64, vocab=77):
-    import jax
+def bench_lstm_charlm(batch=64, steps=10, t=64, vocab=77, fused_steps=5):
     from deeplearning4j_tpu.zoo import TextGenLSTM
 
     import jax.numpy as jnp
 
-    net = TextGenLSTM(n_classes=vocab, input_shape=(t, vocab)).init_model()
     rng = np.random.RandomState(0)
     idx = rng.randint(0, vocab, (batch, t))
     x = jnp.asarray(np.eye(vocab, dtype=np.float32)[idx])
     y = jnp.asarray(np.eye(vocab, dtype=np.float32)[np.roll(idx, -1, 1)])
 
-    def step():
-        net.fit(x, y)
-
-    dt = _time_steps(step, n_warmup=2, n_steps=steps,
-                     sync_fn=lambda: float(net.score()))
+    dt = _time_train(
+        lambda: TextGenLSTM(n_classes=vocab,
+                            input_shape=(t, vocab)).init_model(),
+        x, y, steps, fused_steps)
     return batch * t * steps / dt
 
 
