@@ -27,18 +27,20 @@ from deeplearning4j_tpu.parallel.mesh import make_mesh
 from deeplearning4j_tpu.parallel.sharding import ShardingRules, shard_model_params
 
 
-def _shard_batch(x, mesh: Mesh, axis: str):
-    """Place a host batch with its leading dim split over the data axis.
+def _shard_batch(x, mesh: Mesh, axis: str, batch_dim: int = 0):
+    """Place a host batch with its batch dim split over the data axis.
     Batch size must divide by the axis size (the reference likewise requires
-    workers | batch, `ParallelWrapper.splitter`)."""
+    workers | batch, `ParallelWrapper.splitter`).  `batch_dim=1` handles
+    stacked `[k, batch, ...]` fit_steps blocks (steps axis leads)."""
     def place(leaf):
         leaf = jnp.asarray(leaf)
         n = mesh.shape[axis]
-        if leaf.shape[0] % n:
+        if leaf.shape[batch_dim] % n:
             raise ValueError(
-                f"Batch size {leaf.shape[0]} not divisible by data-parallel "
-                f"degree {n}")
-        spec = P(*([axis] + [None] * (leaf.ndim - 1)))
+                f"Batch size {leaf.shape[batch_dim]} not divisible by "
+                f"data-parallel degree {n}")
+        spec = P(*([None] * batch_dim + [axis]
+                   + [None] * (leaf.ndim - batch_dim - 1)))
         return jax.device_put(leaf, NamedSharding(mesh, spec))
     return jax.tree_util.tree_map(place, x)
 
@@ -232,6 +234,18 @@ class ParallelWrapper:
                 self._fit_ds(ds)
             m.epoch += 1
         return self
+
+    def fit_steps(self, xs, ys):
+        """SPMD fused dispatch: a `[k, batch, ...]` block trains as k data-
+        parallel steps in ONE compiled dispatch — the model's `fit_steps`
+        scan with the batch axis (axis 1) sharded over the data axis.
+        Composes the two latency hiders: per-step all-reduce stays inside
+        the compiled scan, and the host dispatches once per k steps."""
+        self._place_model()
+        xs = _shard_batch(xs, self.mesh, self.data_axis, batch_dim=1)
+        ys = _shard_batch(ys, self.mesh, self.data_axis, batch_dim=1)
+        with self.mesh:
+            return self.model.fit_steps(xs, ys)
 
     def fit_host_local(self, features, labels):
         """Multi-host fit: every process passes its *local* slice of the

@@ -236,3 +236,21 @@ def test_dynamic_batching_inference_concurrent_clients():
         np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-6)
     # fewer dispatches than requests -> aggregation actually happened
     assert len(calls) < len(reqs), calls
+
+
+def test_dp_fit_steps_matches_single_device():
+    """SPMD fused dispatch: pw.fit_steps([k, batch, ...]) == k single-
+    device fit calls (scan + per-step all-reduce inside one dispatch)."""
+    x, y = _data(64)
+    k = 4
+    xs = np.broadcast_to(np.asarray(x), (k,) + np.asarray(x).shape).copy()
+    ys = np.broadcast_to(np.asarray(y), (k,) + np.asarray(y).shape).copy()
+    a = _net(seed=7)
+    for _ in range(k):
+        a.fit(x, y)
+    b = _net(seed=7)
+    pw = ParallelWrapper.builder(b).build()
+    losses = pw.fit_steps(xs, ys)
+    assert losses.shape == (k,)
+    np.testing.assert_allclose(a.params(), b.params(), rtol=1e-5, atol=1e-6)
+    assert b.iteration == k
