@@ -704,28 +704,66 @@ class ComputationGraph:
             labels = [labels]
         return [jnp.asarray(l) for l in labels]
 
-    def fit(self, data, labels=None, *, epochs: int = 1):
+    def fit(self, data, labels=None, *, epochs: int = 1,
+            fused_steps: int = 1):
         """fit(features, labels) for one batch (single- or multi-output), or
-        fit(MultiDataSetIterator | DataSetIterator, epochs=N)."""
+        fit(MultiDataSetIterator | DataSetIterator, epochs=N).
+
+        `fused_steps=k` fuses blocks of k consecutive same-shape batches
+        into one compiled dispatch (`fit_steps`); tails and shape changes
+        fall back to per-step dispatch (identical math either way)."""
         if labels is not None:
+            if fused_steps != 1:
+                raise ValueError(
+                    "fused_steps applies to the iterator form only; for a "
+                    "pre-stacked [k, batch, ...] block call fit_steps")
             self._fit_batch(self._as_input_dict(data), self._as_list(labels))
             return self
         for _ in range(epochs):
             if hasattr(data, "reset"):
                 data.reset()
-            for ds in data:
-                lmasks = getattr(ds, "labels_mask", None)
-                if lmasks is not None and not isinstance(lmasks, (list, tuple)):
-                    lmasks = [lmasks]
-                self._fit_batch(self._as_input_dict(ds.features),
-                                self._as_list(ds.labels),
-                                None if lmasks is None else
-                                [jnp.asarray(m) for m in lmasks])
+            if fused_steps > 1:
+                self._fit_epoch_fused(data, fused_steps)
+            else:
+                for ds in data:
+                    self._fit_dataset(ds)
             self.epoch += 1
             for lst in self.listeners:
                 if hasattr(lst, "on_epoch_end"):
                     lst.on_epoch_end(self)
         return self
+
+    def _fit_dataset(self, ds):
+        lmasks = getattr(ds, "labels_mask", None)
+        if lmasks is not None and not isinstance(lmasks, (list, tuple)):
+            lmasks = [lmasks]
+        self._fit_batch(self._as_input_dict(ds.features),
+                        self._as_list(ds.labels),
+                        None if lmasks is None else
+                        [jnp.asarray(m) for m in lmasks])
+
+    def _fit_epoch_fused(self, iterator, k: int):
+        from deeplearning4j_tpu.utils.scan_fit import blocks_of
+        for block in blocks_of(iterator, k):
+            if len(block) == 1:
+                self._fit_dataset(block[0])
+                continue
+            feats = [self._as_input_dict(ds.features) for ds in block]
+            labs = [self._as_list(ds.labels) for ds in block]
+            lms = []
+            for ds in block:
+                lm = getattr(ds, "labels_mask", None)
+                if lm is not None and not isinstance(lm, (list, tuple)):
+                    lm = [lm]
+                lms.append(lm)
+            stacked_feats = {n: np.stack([np.asarray(f[n]) for f in feats])
+                             for n in feats[0]}
+            stacked_labs = [np.stack([np.asarray(l[i]) for l in labs])
+                            for i in range(len(labs[0]))]
+            stacked_lms = (None if lms[0] is None else
+                           [np.stack([np.asarray(m[i]) for m in lms])
+                            for i in range(len(lms[0]))])
+            self.fit_steps(stacked_feats, stacked_labs, stacked_lms)
 
     def _fit_batch(self, inputs: Dict[str, jnp.ndarray],
                    labels: List[jnp.ndarray], lmasks=None):

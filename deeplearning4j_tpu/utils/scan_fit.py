@@ -20,12 +20,19 @@ def blocks_of(iterator, k: int):
     don't fill a block — the epoch tail, or a shape change mid-stream —
     are yielded as single-element lists so the caller takes the per-step
     path instead of compiling a new scan executable for a one-off k."""
+    def shapes(x):
+        if x is None:
+            return None
+        if isinstance(x, (list, tuple)):            # multi-input/-output
+            return tuple(np.shape(e) for e in x)
+        if isinstance(x, dict):
+            return tuple(sorted((k, np.shape(v)) for k, v in x.items()))
+        return np.shape(x)
+
     def key(ds):
-        fm = getattr(ds, "features_mask", None)
-        lm = getattr(ds, "labels_mask", None)
-        return (np.shape(ds.features), np.shape(ds.labels),
-                None if fm is None else np.shape(fm),
-                None if lm is None else np.shape(lm))
+        return (shapes(ds.features), shapes(ds.labels),
+                shapes(getattr(ds, "features_mask", None)),
+                shapes(getattr(ds, "labels_mask", None)))
 
     buf, buf_key = [], None
     for ds in iterator:

@@ -266,3 +266,39 @@ def test_cg_fit_steps_matches_sequential_fit():
                       jax.tree_util.tree_leaves(b.params_)):
         np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
     assert a.iteration == b.iteration == 4
+
+
+def test_cg_fit_iterator_fused_matches_sequential():
+    """CG fit(iterator, fused_steps=3) == fit(iterator): multi-input
+    graphs stack per-name; the 7-batch epoch leaves a 1-batch tail."""
+    from deeplearning4j_tpu.data.dataset import MultiDataSet
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+
+    rng = np.random.RandomState(2)
+    batches = []
+    for _ in range(7):
+        xa = rng.rand(8, 4).astype(np.float32)
+        xb = rng.rand(8, 6).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 8)]
+        batches.append(MultiDataSet(features=[xa, xb], labels=[y]))
+
+    def build():
+        conf = (GraphBuilder().seed(0).updater(Sgd(1e-1))
+                .add_inputs("a", "b")
+                .set_input_types(InputType.feed_forward(4),
+                                 InputType.feed_forward(6))
+                .add_layer("da", DenseLayer(n_out=5, activation="tanh"), "a")
+                .add_layer("db", DenseLayer(n_out=7, activation="tanh"), "b")
+                .add_vertex("m", MergeVertex(), "da", "db")
+                .add_layer("out", OutputLayer(n_out=2, loss="mcxent",
+                                              activation="softmax"), "m")
+                .set_outputs("out").build())
+        return ComputationGraph(conf).init()
+
+    a, b = build(), build()
+    a.fit(ListDataSetIterator(batches), epochs=2)
+    b.fit(ListDataSetIterator(batches), epochs=2, fused_steps=3)
+    for la, lb in zip(jax.tree_util.tree_leaves(a.params_),
+                      jax.tree_util.tree_leaves(b.params_)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    assert a.iteration == b.iteration == 14
