@@ -348,14 +348,33 @@ def _wait_for_backend(max_wait_s=1800.0, retry_every_s=120.0):
             }))
             return 0
         if elapsed + retry_every_s > max_wait_s:
-            print(json.dumps({
+            line = {
                 "metric": "resnet50_train_samples_per_sec_per_chip",
                 "value": None,
                 "unit": "samples/sec/chip",
                 "error": (f"TPU backend unreachable: {probes} probes over "
                           f"{elapsed / 60:.1f} min (axon tunnel down); "
                           "no measurement possible"),
-            }))
+            }
+            # value stays None (nothing was measured in THIS run), but
+            # surface the most recent real-hardware measurement from the
+            # in-repo validation artifacts so a tunnel outage at bench
+            # time doesn't erase the round's on-chip data
+            try:
+                tv = json.load(open(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "bench_artifacts", "TUNNEL_VALIDATION.json")))
+                head = tv.get("stages", {}).get("1_headline", {})
+                if head.get("resnet50_samples_per_sec"):
+                    line["last_hw_measurement"] = {
+                        "resnet50_samples_per_sec":
+                            head["resnet50_samples_per_sec"],
+                        "measured_at": tv.get("started"),
+                        "source": "bench_artifacts/TUNNEL_VALIDATION.json",
+                    }
+            except Exception:
+                pass
+            print(json.dumps(line))
             return 0
         print(f"[bench] backend unreachable (probe {probes}); retrying in "
               f"{retry_every_s:.0f}s ({(max_wait_s - elapsed) / 60:.0f} min "
