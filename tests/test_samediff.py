@@ -501,3 +501,40 @@ def test_sd_fit_steps_matches_sequential():
         np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
     assert a.iteration == b.iteration == k
     assert abs(a.score() - b.score()) < 1e-7
+
+
+def test_sd_fit_steps_rng_path_matches_sequential():
+    """fit_steps through a graph WITH dropout (the has_rng step branch):
+    the scan must split the carry key exactly like sequential fit."""
+    import jax
+
+    def build():
+        sd = SameDiff.create()
+        x = sd.placeholder("input", shape=(-1, 4))
+        y = sd.placeholder("label", shape=(-1, 3))
+        w0 = sd.var("w0", "XAVIER", 4, 16)
+        b0 = sd.var("b0", np.zeros(16, np.float32))
+        w1 = sd.var("w1", "XAVIER", 16, 3)
+        b1 = sd.var("b1", np.zeros(3, np.float32))
+        h = sd.nn.tanh(sd.nn.linear(x, w0, b0))
+        h = sd.nn.dropout(h, p=0.3)
+        logits = sd.nn.linear(h, w1, b1, name="logits")
+        sd.loss.softmax_cross_entropy(y, logits, name="loss")
+        sd.set_loss_variables("loss")
+        sd.set_training_config(TrainingConfig(
+            updater=Adam(1e-2),
+            data_set_feature_mapping=["input"],
+            data_set_label_mapping=["label"]))
+        return sd
+
+    x, y = _toy()
+    k = 4
+    a, b = build(), build()
+    for _ in range(k):
+        a.fit(x, y)
+    feeds = {"input": np.broadcast_to(x, (k,) + x.shape).copy(),
+             "label": np.broadcast_to(y, (k,) + y.shape).copy()}
+    b.fit_steps(feeds)
+    for la, lb in zip(jax.tree_util.tree_leaves(a.variables_),
+                      jax.tree_util.tree_leaves(b.variables_)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
