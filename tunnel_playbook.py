@@ -378,6 +378,107 @@ def stage_conv_hook_ab(quick):
     return out
 
 
+@guard("9_fused_dispatch")
+def stage_fused_dispatch(quick):
+    """Fused k-step dispatch A/B (the ~3 ms/step host-gap lever,
+    PERF_ANALYSIS.md r5): per-step fit vs fit_steps(k=10) at b64 vs
+    fit_steps(k=4) at b256.  bench.py adopts the fused path by default
+    (with per-step fallback); this stage is the measurement behind it."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.train.updaters import Nesterovs
+    from deeplearning4j_tpu.zoo import ResNet50
+
+    rng = np.random.RandomState(0)
+
+    def build():
+        return ResNet50(n_classes=1000, input_shape=(224, 224, 3),
+                        updater=Nesterovs(0.1, 0.9),
+                        compute_dtype="bfloat16").init_model()
+
+    def data(k, b):
+        xs = jnp.asarray(rng.rand(k, b, 224, 224, 3).astype(np.float32))
+        ys = jnp.asarray(np.eye(1000, dtype=np.float32)[
+            rng.randint(0, 1000, (k, b))])
+        return xs, ys
+
+    out = {}
+    net = build()
+    x, y = data(1, 64)
+    x, y = x[0], y[0]
+    dt = timeit(lambda: net.fit(x, y), lambda: float(net.score()),
+                n=5 if quick else 20)
+    out["per_step_b64"] = {"ms_per_step": round(dt * 1e3, 2),
+                           "samples_per_sec": round(64 / dt, 1)}
+    del net
+
+    for tag, k, b, blocks in [("fused_k10_b64", 10, 64, 2 if quick else 4),
+                              ("fused_k4_b256", 4, 256, 2 if quick else 3)]:
+        try:
+            net = build()
+            xs, ys = data(k, b)
+            t0 = time.time()
+            net.fit_steps(xs, ys)
+            float(net.score())
+            compile_s = round(time.time() - t0, 1)
+            dt = timeit(lambda: net.fit_steps(xs, ys),
+                        lambda: float(net.score()), warm=0, n=blocks) / k
+            out[tag] = {"ms_per_step": round(dt * 1e3, 2),
+                        "samples_per_sec": round(b / dt, 1),
+                        "compile_s": compile_s}
+            del net, xs, ys
+        except Exception as e:
+            out[tag] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    return out
+
+
+@guard("10_auto_layout")
+def stage_auto_layout(quick):
+    """AUTO-layout A/B (the 3.1 ms/step retiling-copy lever): compile the
+    ResNet step with Layout.AUTO on every input, place params in the
+    compiler-preferred layouts, and time vs the default-layout step."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.layout import Format, Layout
+    from deeplearning4j_tpu.train.updaters import Nesterovs
+    from deeplearning4j_tpu.utils.counters import device_counters
+    from deeplearning4j_tpu.zoo import ResNet50
+
+    b = 64
+    net = ResNet50(n_classes=1000, input_shape=(224, 224, 3),
+                   updater=Nesterovs(0.1, 0.9),
+                   compute_dtype="bfloat16").init_model()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(b, 224, 224, 3).astype(np.float32))
+    y = jnp.asarray(np.eye(1000, dtype=np.float32)[
+        rng.randint(0, 1000, b)])
+    out = {}
+    dt = timeit(lambda: net.fit(x, y), lambda: float(net.score()),
+                n=5 if quick else 20)
+    out["default_layout_ms"] = round(dt * 1e3, 2)
+
+    body = net._build_step_body()
+    it_dev, ep_dev = device_counters(net)
+    args = (net.params_, net.state_, net.opt_state_, {"input": x}, [y],
+            None, net._rng, it_dev, ep_dev)
+    auto = Format(Layout.AUTO)
+    fmt_tree = jax.tree_util.tree_map(lambda _: auto, args)
+    step = jax.jit(body, donate_argnums=(0, 1, 2), in_shardings=fmt_tree)
+    compiled = step.lower(*args).compile()
+    placed = jax.tree_util.tree_map(jax.device_put, args,
+                                    compiled.input_formats)
+    x_p, y_p, ep_p = placed[3], placed[4], placed[8]
+    p, s2, o, loss, r, it = compiled(*placed)
+    jax.block_until_ready(loss)
+    n = 5 if quick else 20
+    t0 = time.perf_counter()
+    for _ in range(n):
+        p, s2, o, loss, r, it = compiled(p, s2, o, x_p, y_p, None, r, it,
+                                         ep_p)
+    float(loss)
+    out["auto_layout_ms"] = round((time.perf_counter() - t0) / n * 1e3, 2)
+    return out
+
+
 def main():
     quick = "--quick" in sys.argv
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -398,6 +499,8 @@ def main():
     stage_wgrad_ab(quick)
     stage_dgrad_ab(quick)
     stage_conv_hook_ab(quick)
+    stage_fused_dispatch(quick)
+    stage_auto_layout(quick)
     print("[playbook] DONE", flush=True)
 
 
