@@ -233,9 +233,8 @@ def _conv2d(x, w, b=None, stride=(1, 1), padding="SAME", dilation=(1, 1)):
 
 @register_op("max_pooling2d")
 def _max_pool(x, kernel=(2, 2), stride=(2, 2), padding="VALID"):
-    return lax.reduce_window(x, -jnp.inf, lax.max,
-                             (1,) + tuple(kernel) + (1,),
-                             (1,) + tuple(stride) + (1,), padding)
+    from deeplearning4j_tpu.ops.pool_kernels import max_pool2d
+    return max_pool2d(x, tuple(kernel), tuple(stride), padding)
 
 
 @register_op("avg_pooling2d")

@@ -509,14 +509,18 @@ class SubsamplingLayer(Layer):
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         kh, kw = _pair(self.kernel_size)
         sh, sw = _pair(self.stride)
-        pad = _padding_2d(self.convolution_mode, self.padding)
+        pad2 = _padding_2d(self.convolution_mode, self.padding)
+        pad = pad2
         if pad != "SAME":
             pad = ((0, 0), tuple(pad[0]), tuple(pad[1]), (0, 0))
         dims = (1, kh, kw, 1)
         strides = (1, sh, sw, 1)
         pt = self.pooling_type.upper()
         if pt == "MAX":
-            y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pad)
+            from deeplearning4j_tpu.ops.pool_kernels import max_pool2d
+            p2 = pad2 if isinstance(pad2, str) \
+                else (tuple(pad2[0]), tuple(pad2[1]))
+            y = max_pool2d(x, (kh, kw), (sh, sw), p2)
         elif pt in ("AVG", "AVERAGE"):
             s = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
             ones = jnp.ones_like(x)

@@ -479,6 +479,71 @@ def stage_auto_layout(quick):
     return out
 
 
+@guard("11_pool_bwd")
+def stage_pool_bwd(quick):
+    """Taps max-pool backward vs XLA select-and-scatter (0.88 ms/step in
+    the r5 profile): isolated at the ResNet stem shape, then the full
+    train step with POOL_BWD_TAPS on.  Win → flip the flag default;
+    loss → commit the table."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from deeplearning4j_tpu.ops.pool_kernels import (POOL_BWD_TAPS,
+                                                     max_pool2d_taps)
+    from deeplearning4j_tpu.train.updaters import Nesterovs
+    from deeplearning4j_tpu.zoo import ResNet50
+
+    out = {}
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(64, 112, 112, 64).astype(np.bfloat16))
+
+    def pool_xla(a):
+        return lax.reduce_window(a, -jnp.inf, lax.max, (1, 3, 3, 1),
+                                 (1, 2, 2, 1), "SAME")
+
+    t = pool_xla(x) * 0.9
+    g_xla = jax.jit(jax.grad(lambda a: jnp.sum((pool_xla(a) - t) ** 2)))
+    g_tap = jax.jit(jax.grad(lambda a: jnp.sum(
+        (max_pool2d_taps(a, (3, 3), (2, 2), "SAME") - t) ** 2)))
+    r = g_xla(x); jax.block_until_ready(r)
+    r2 = g_tap(x); jax.block_until_ready(r2)
+    out["isolated_max_err"] = float(jnp.max(jnp.abs(
+        r.astype(jnp.float32) - r2.astype(jnp.float32))))
+    n = 10 if quick else 30
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = g_xla(x)
+    jax.block_until_ready(r)
+    out["isolated_xla_ms"] = round((time.perf_counter() - t0) / n * 1e3, 3)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r2 = g_tap(x)
+    jax.block_until_ready(r2)
+    out["isolated_taps_ms"] = round((time.perf_counter() - t0) / n * 1e3, 3)
+
+    batch = 64
+    xb = jnp.asarray(rng.rand(batch, 224, 224, 3).astype(np.float32))
+    yb = jnp.asarray(np.eye(1000, dtype=np.float32)[
+        rng.randint(0, 1000, batch)])
+    for tag, flag in [("step_xla", False), ("step_taps", True)]:
+        old = dict(POOL_BWD_TAPS)
+        try:
+            POOL_BWD_TAPS["enabled"] = flag
+            net = ResNet50(n_classes=1000, input_shape=(224, 224, 3),
+                           updater=Nesterovs(0.1, 0.9),
+                           compute_dtype="bfloat16").init_model()
+            dt = timeit(lambda: net.fit(xb, yb),
+                        lambda: float(net.score()), n=5 if quick else 15)
+            out[tag] = {"ms_per_step": round(dt * 1e3, 2),
+                        "samples_per_sec": round(batch / dt, 1)}
+        except Exception as e:
+            out[tag] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        finally:
+            POOL_BWD_TAPS.clear()
+            POOL_BWD_TAPS.update(old)
+    return out
+
+
 def main():
     quick = "--quick" in sys.argv
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -501,6 +566,7 @@ def main():
     stage_conv_hook_ab(quick)
     stage_fused_dispatch(quick)
     stage_auto_layout(quick)
+    stage_pool_bwd(quick)
     print("[playbook] DONE", flush=True)
 
 
