@@ -220,3 +220,30 @@ def composed_train_step(mesh: Mesh, n_heads: int, lr: float = 0.1,
         return new, loss
 
     return step
+
+
+def composed_train_steps(mesh: Mesh, n_heads: int, lr: float = 0.1,
+                         remat: bool = False, **axes):
+    """Fused k-step form of `composed_train_step`: the fused-dispatch
+    lever (utils/scan_fit.py) composed WITH 3D parallelism — k
+    dp×tp×pp steps (pipeline ticks, TP collectives, DP psum all inside)
+    run as one `lax.scan` dispatch.  `xs`/`ys` carry a leading steps
+    axis; returns (params, per-step losses)."""
+
+    @jax.jit
+    def steps(params, xs, ys):
+        def tick(p, batch):
+            x, y = batch
+
+            def loss_fn(q):
+                out = composed_apply(q, x, mesh, n_heads, remat=remat,
+                                     **axes)
+                return jnp.mean((out - y) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(p)
+            return jax.tree_util.tree_map(lambda a, g: a - lr * g, p,
+                                          grads), loss
+
+        return jax.lax.scan(tick, params, (xs, ys))
+
+    return steps

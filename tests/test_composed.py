@@ -96,3 +96,28 @@ def test_composed_remat_matches_no_remat():
     for k in p1:
         np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p2[k]),
                                    rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_composed_fused_steps_match_sequential():
+    """composed_train_steps (k 3D-parallel steps in one scan dispatch)
+    equals k sequential composed_train_step calls bit-for-bit."""
+    from deeplearning4j_tpu.parallel.composed import composed_train_steps
+
+    mesh = _mesh3d()
+    params = init_stage_params(np.random.RandomState(7), S, D, H, FF)
+    x, y = _inputs()
+    k = 3
+    xs = jnp.broadcast_to(x, (k,) + x.shape)
+    ys = jnp.broadcast_to(y, (k,) + y.shape)
+
+    step = composed_train_step(mesh, H, lr=0.2)
+    p_seq = params
+    for _ in range(k):
+        p_seq, loss_seq = step(p_seq, x, y)
+
+    p_fused, losses = composed_train_steps(mesh, H, lr=0.2)(params, xs, ys)
+    assert losses.shape == (k,)
+    assert np.isclose(float(losses[-1]), float(loss_seq), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(p_seq),
+                    jax.tree_util.tree_leaves(p_fused)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
