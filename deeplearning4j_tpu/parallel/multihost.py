@@ -71,11 +71,14 @@ def global_mesh(axes: Optional[Dict[str, int]] = None):
     return make_mesh(axes, jax.devices())
 
 
-def shard_host_local_batch(mesh, batch, axis: str = "data"):
+def shard_host_local_batch(mesh, batch, axis: str = "data",
+                           batch_dim: int = 0):
     """Each process contributes its *local* slice of the global batch; the
     result is one global jax.Array sharded over `axis` (the SPMD analog of
     Spark partitioning an RDD of DataSets across executors).  All processes
-    must feed equal-sized local batches."""
+    must feed equal-sized local batches.  `batch_dim=1` handles stacked
+    `[k, batch, ...]` fit_steps blocks (steps axis leads, sharded on the
+    batch axis)."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -83,8 +86,11 @@ def shard_host_local_batch(mesh, batch, axis: str = "data"):
 
     def place(leaf):
         leaf = np.asarray(leaf)
-        spec = P(*([axis] + [None] * (leaf.ndim - 1)))
-        global_shape = (leaf.shape[0] * nproc,) + leaf.shape[1:]
+        spec = P(*([None] * batch_dim + [axis]
+                   + [None] * (leaf.ndim - batch_dim - 1)))
+        global_shape = (leaf.shape[:batch_dim]
+                        + (leaf.shape[batch_dim] * nproc,)
+                        + leaf.shape[batch_dim + 1:])
         return jax.make_array_from_process_local_data(
             NamedSharding(mesh, spec), leaf, global_shape)
     return jax.tree_util.tree_map(place, batch)

@@ -261,6 +261,22 @@ class ParallelWrapper:
             self.model.fit(x, y)
         return self
 
+    def fit_steps_host_local(self, xs, ys):
+        """Multi-host fused dispatch: every process passes its local slice
+        of a `[k, local_batch, ...]` block; the global `[k, batch, ...]`
+        array trains as k steps in ONE dispatch per host (scan + per-step
+        all-reduce inside the executable — the SharedTraining data path
+        with the r5 host-latency lever)."""
+        from deeplearning4j_tpu.parallel.multihost import (
+            shard_host_local_batch)
+        self._place_model()
+        xs = shard_host_local_batch(self.mesh, xs, self.data_axis,
+                                    batch_dim=1)
+        ys = shard_host_local_batch(self.mesh, ys, self.data_axis,
+                                    batch_dim=1)
+        with self.mesh:
+            return self.model.fit_steps(xs, ys)
+
     def average_updaters(self):
         return self  # parity no-op: single logical updater state
 

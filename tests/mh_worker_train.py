@@ -40,8 +40,16 @@ conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.1))
         .set_input_type(InputType.feed_forward(10)).build())
 net = MultiLayerNetwork(conf).init()
 pw = ParallelWrapper(net, mesh)
-for _ in range(steps):
-    pw.fit_host_local(xl, yl)
+fused = len(sys.argv) > 3 and sys.argv[3] == "fused"
+if fused:
+    # same local data every step, stacked on a leading steps axis: k
+    # steps in ONE dispatch per host (scan + psum inside the executable)
+    xs = np.broadcast_to(xl, (steps,) + xl.shape).copy()
+    ys = np.broadcast_to(yl, (steps,) + yl.shape).copy()
+    pw.fit_steps_host_local(xs, ys)
+else:
+    for _ in range(steps):
+        pw.fit_host_local(xl, yl)
 
 params = np.asarray(net.params())
 np.savez(os.path.join(out_dir, f"params_{rank}.npz"), params=params,

@@ -198,3 +198,24 @@ def test_two_process_composed_tp_pp_across_boundary(tmp_path):
         p, loss = oracle_step(p)
         want.append(float(loss))
     np.testing.assert_allclose(r0["tp_cross"], want, rtol=1e-4)
+
+
+def test_two_process_fused_fit_steps_matches_per_step(tmp_path):
+    """fit_steps_host_local: k DP steps in one dispatch per host across a
+    REAL 2-process boundary — params must bit-match the per-step
+    multi-process run (same data, same seeds)."""
+    steps = 5
+    a_dir = tmp_path / "per_step"
+    b_dir = tmp_path / "fused"
+    a_dir.mkdir(); b_dir.mkdir()
+    launcher = LocalLauncher(num_processes=2, devices_per_process=2)
+    launcher.run(os.path.join(HERE, "mh_worker_train.py"),
+                 [str(a_dir), str(steps)], timeout=420)
+    launcher.run(os.path.join(HERE, "mh_worker_train.py"),
+                 [str(b_dir), str(steps), "fused"], timeout=420)
+    pa = np.load(a_dir / "params_0.npz")["params"]
+    pb = np.load(b_dir / "params_0.npz")["params"]
+    np.testing.assert_array_equal(pa, pb)
+    # and fused ranks agree with each other
+    pb1 = np.load(b_dir / "params_1.npz")["params"]
+    np.testing.assert_array_equal(pb, pb1)
