@@ -31,8 +31,10 @@ def blocks_of(iterator, k: int):
 
     def key(ds):
         return (shapes(ds.features), shapes(ds.labels),
-                shapes(getattr(ds, "features_mask", None)),
-                shapes(getattr(ds, "labels_mask", None)))
+                shapes(getattr(ds, "features_mask", None)
+                       or getattr(ds, "features_masks", None)),
+                shapes(getattr(ds, "labels_mask", None)
+                       or getattr(ds, "labels_masks", None)))
 
     buf, buf_key = [], None
     for ds in iterator:

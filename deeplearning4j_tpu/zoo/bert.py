@@ -218,14 +218,41 @@ class BertModel:
 
 
     # ---- public API ----
-    def fit(self, iterator, epochs: int = 1) -> "BertModel":
+    def fit(self, iterator, epochs: int = 1,
+            fused_steps: int = 1) -> "BertModel":
+        """`fused_steps=k` stacks k consecutive same-shape batches into one
+        `fit_steps` dispatch (tails/shape changes fall back per-step)."""
         for _ in range(epochs):
             if hasattr(iterator, "reset"):
                 iterator.reset()
-            for mds in iterator:
-                self.fit_batch(mds)
+            if fused_steps > 1:
+                self._fit_epoch_fused(iterator, fused_steps)
+            else:
+                for mds in iterator:
+                    self.fit_batch(mds)
             self.epoch += 1
         return self
+
+    def _fit_epoch_fused(self, iterator, k: int):
+        import numpy as np
+
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+        from deeplearning4j_tpu.utils.scan_fit import blocks_of
+        for block in blocks_of(iterator, k):
+            if len(block) == 1:
+                self.fit_batch(block[0])
+                continue
+            n_f = len(block[0].features)
+            n_l = len(block[0].labels)
+            stacked = MultiDataSet(
+                features=[np.stack([np.asarray(b.features[j])
+                                    for b in block]) for j in range(n_f)],
+                labels=[np.stack([np.asarray(b.labels[j]) for b in block])
+                        for j in range(n_l)],
+                labels_masks=None if block[0].labels_masks is None else
+                [np.stack([np.asarray(b.labels_masks[j]) for b in block])
+                 for j in range(len(block[0].labels_masks))])
+            self.fit_steps(stacked)
 
     def fit_batch(self, mds):
         from deeplearning4j_tpu.utils.counters import advance, device_counters

@@ -134,3 +134,21 @@ def test_bert_fit_steps_matches_sequential():
                       jax.tree_util.tree_leaves(b_.params_)):
         np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
     assert a.iteration == b_.iteration == k
+
+
+def test_bert_fit_iterator_fused_matches_sequential():
+    """BertModel.fit(iterator, fused_steps=2) == plain fit(iterator)."""
+    import jax
+
+    def run(fused):
+        model = BertModel(BertConfig.tiny(), seed=0, updater=Adam(1e-3))
+        it = BertIterator(_tok(), _sentences(), batch_size=8, max_length=16,
+                          task=BertIterator.TASK_UNSUPERVISED, seed=1)
+        model.fit(it, epochs=2, fused_steps=2 if fused else 1)
+        return model
+
+    a, b = run(False), run(True)
+    assert a.iteration == b.iteration
+    for la, lb in zip(jax.tree_util.tree_leaves(a.params_),
+                      jax.tree_util.tree_leaves(b.params_)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
