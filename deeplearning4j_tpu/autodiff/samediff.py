@@ -818,9 +818,12 @@ class SameDiff:
         return make_scan_step(tick)
 
     def fit(self, data=None, labels=None, *, iterator=None, epochs: int = 1,
-            feeds: Optional[Dict[str, Any]] = None) -> "SameDiff":
+            feeds: Optional[Dict[str, Any]] = None,
+            fused_steps: int = 1) -> "SameDiff":
         """fit(features, labels) / fit(feeds={...}) for one batch, or
-        fit(iterator=multi_data_set_iterator, epochs=N)."""
+        fit(iterator=multi_data_set_iterator, epochs=N).  `fused_steps=k`
+        fuses blocks of k consecutive same-shape batches from the
+        iterator into one `fit_steps` dispatch (tails fall back)."""
         if self.training_config is None:
             raise ValueError("set_training_config(...) first (reference "
                              "throws the same)")
@@ -833,11 +836,22 @@ class SameDiff:
             self._train_step = self._build_train_step()
 
         if iterator is not None:
+            from deeplearning4j_tpu.utils.scan_fit import blocks_of
             for _ in range(epochs):
                 if hasattr(iterator, "reset"):
                     iterator.reset()
-                for ds in iterator:
-                    self._fit_feeds(self._map_dataset(ds))
+                if fused_steps > 1:
+                    for block in blocks_of(iterator, fused_steps):
+                        if len(block) == 1:
+                            self._fit_feeds(self._map_dataset(block[0]))
+                        else:
+                            fl = [self._map_dataset(ds) for ds in block]
+                            self.fit_steps(
+                                {k: np.stack([np.asarray(f[k]) for f in fl])
+                                 for k in fl[0]})
+                else:
+                    for ds in iterator:
+                        self._fit_feeds(self._map_dataset(ds))
                 self.epoch += 1
             return self
         if feeds is None:

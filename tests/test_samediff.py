@@ -538,3 +538,31 @@ def test_sd_fit_steps_rng_path_matches_sequential():
     for la, lb in zip(jax.tree_util.tree_leaves(a.variables_),
                       jax.tree_util.tree_leaves(b.variables_)):
         np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_sd_fit_iterator_fused_matches_sequential():
+    """sd.fit(iterator=..., fused_steps=2) == plain iterator fit."""
+    import jax
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+
+    rng = np.random.RandomState(5)
+    batches = [DataSet(rng.rand(8, 4).astype(np.float32),
+                       np.eye(3, dtype=np.float32)[rng.randint(0, 3, 8)])
+               for _ in range(5)]          # 5 batches -> 2 blocks + tail
+
+    def build():
+        sd = _mlp_sd()
+        sd.set_training_config(TrainingConfig(
+            updater=Adam(1e-2),
+            data_set_feature_mapping=["input"],
+            data_set_label_mapping=["label"]))
+        return sd
+
+    a, b = build(), build()
+    a.fit(iterator=ListDataSetIterator(batches), epochs=2)
+    b.fit(iterator=ListDataSetIterator(batches), epochs=2, fused_steps=2)
+    assert a.iteration == b.iteration == 10
+    for la, lb in zip(jax.tree_util.tree_leaves(a.variables_),
+                      jax.tree_util.tree_leaves(b.variables_)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
