@@ -411,7 +411,8 @@ def stage_fused_dispatch(quick):
                            "samples_per_sec": round(64 / dt, 1)}
     del net
 
-    for tag, k, b, blocks in [("fused_k10_b64", 10, 64, 2 if quick else 4),
+    for tag, k, b, blocks in [("fused_k5_b64", 5, 64, 2 if quick else 6),
+                              ("fused_k10_b64", 10, 64, 2 if quick else 4),
                               ("fused_k4_b256", 4, 256, 2 if quick else 3)]:
         try:
             net = build()
