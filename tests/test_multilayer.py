@@ -236,3 +236,18 @@ def test_fit_iterator_fused_steps_matches_sequential():
     np.testing.assert_allclose(np.asarray(a.params()),
                                np.asarray(b.params()), atol=0)
     assert a.iteration == b.iteration == 18
+
+
+def test_fit_async_iterator_with_fused_steps():
+    """The canonical hot loop (SURVEY §3.1): async host prefetch feeding
+    the fused k-step dispatch — must equal plain sequential training."""
+    x, y = two_moons(n=64)          # 128 samples -> 8 batches of 16
+    a = MultiLayerNetwork(mlp_conf()).init()
+    b = MultiLayerNetwork(mlp_conf()).init()
+    a.fit(ArrayDataSetIterator(x, y, batch_size=16), epochs=2)
+    b.fit(AsyncDataSetIterator(ArrayDataSetIterator(x, y, batch_size=16),
+                               queue_size=3),
+          epochs=2, fused_steps=4)
+    np.testing.assert_allclose(np.asarray(a.params()),
+                               np.asarray(b.params()), atol=0)
+    assert a.iteration == b.iteration == 16
