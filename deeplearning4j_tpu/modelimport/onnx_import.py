@@ -441,6 +441,180 @@ def _argmax(sd, n, ins):
     return sd.rename(v.name, n.output[0])
 
 
+# -- shape/broadcast ops (torch dynamic-shape export tail) ------------------
+
+def _static_shape(sd, v, ctx: str):
+    """Static shape of an imported variable via abstract eval (the
+    TF-importer Shape pattern, tf_import.py; only statically-shaped
+    graphs import)."""
+    import jax
+    node = sd._nodes[v.name]
+    if node.kind == "variable":
+        return tuple(np.asarray(sd.variables_[v.name]).shape)
+    if node.kind == "constant":
+        return tuple(np.asarray(sd._constants[v.name]).shape)
+    if node.kind == "placeholder" and node.shape is not None \
+            and None not in node.shape:
+        return tuple(node.shape)     # the dominant torch-export pattern
+    phs = {name: nd for name, nd in sd._nodes.items()
+           if nd.kind == "placeholder"}
+    unshaped = [name for name, nd in phs.items() if nd.shape is None]
+    if unshaped:
+        raise UnmappedOnnxOpException(
+            f"{ctx}: placeholders {unshaped} have no static shape — only "
+            "statically-shaped graphs import")
+    specs = {name: jax.ShapeDtypeStruct(tuple(nd.shape),
+                                        np.dtype(nd.dtype))
+             for name, nd in phs.items()}
+    try:
+        abstract = jax.eval_shape(
+            lambda feeds: sd._eval_graph(feeds, dict(sd.variables_),
+                                         [v.name])[v.name], specs)
+    except Exception as e:
+        raise UnmappedOnnxOpException(
+            f"{ctx}: abstract shape inference failed") from e
+    return tuple(abstract.shape)
+
+
+@R("Shape")
+def _shape(sd, n, ins):
+    s = _static_shape(sd, ins[0], f"Shape '{n.name}'")
+    start = _ai(n, "start", 0)
+    end = _ai(n, "end", len(s))
+    return sd.constant(n.output[0], np.asarray(s[start:end], np.int64))
+
+
+@R("Expand")
+def _expand(sd, n, ins):
+    target = _const_ints(ins[1])
+    xs = _static_shape(sd, ins[0], f"Expand '{n.name}'")
+    out = np.broadcast_shapes(tuple(xs), tuple(target))
+    return sd.op("broadcast_to", ins[0], shape=list(out), name=n.output[0])
+
+
+@R("Tile")
+def _tile(sd, n, ins):
+    return sd.op("tile", ins[0], reps=_const_ints(ins[1]), name=n.output[0])
+
+
+@R("ConstantOfShape")
+def _constant_of_shape(sd, n, ins):
+    shape = _const_ints(ins[0])
+    a = _attrs(n).get("value")
+    fill = a.t.to_array().reshape(()) if a is not None else np.float32(0)
+    return sd.constant(n.output[0], np.full(shape, fill))
+
+
+@R("Range")
+def _range(sd, n, ins):
+    start, limit, delta = (np.asarray(v.get_arr()).reshape(()) for v in ins)
+    return sd.constant(n.output[0], np.arange(start, limit, delta))
+
+
+# -- normalization / activations (opset tail) -------------------------------
+
+@R("InstanceNormalization")
+def _instance_norm(sd, n, ins):
+    eps = _af(n, "epsilon", 1e-5)
+    x, scale, bias = ins
+    mu = sd.op("mean", x, axis=[2, 3], keepdims=True)
+    d = sd.op("sub", x, mu)
+    var = sd.op("mean", sd.op("mul", d, d), axis=[2, 3], keepdims=True)
+    inv = sd.op("rsqrt", var + eps)
+    s4 = sd.op("reshape", scale, shape=[1, -1, 1, 1])
+    b4 = sd.op("reshape", bias, shape=[1, -1, 1, 1])
+    return sd.op("add", sd.op("mul", sd.op("mul", d, inv), s4), b4,
+                 name=n.output[0])
+
+
+@R("PRelu")
+def _prelu_onnx(sd, n, ins):
+    return sd.op("prelu", ins[0], ins[1], name=n.output[0])
+
+
+@R("HardSigmoid")
+def _hard_sigmoid(sd, n, ins):
+    alpha = _af(n, "alpha", 0.2)
+    beta = _af(n, "beta", 0.5)
+    y = ins[0] * alpha + beta
+    return sd.op("clip_by_value", y, lo=0.0, hi=1.0, name=n.output[0])
+
+
+@R("HardSwish")
+def _hard_swish(sd, n, ins):
+    # onnx HardSwish == jax.nn.hard_swish == x*relu6(x+3)/6
+    return sd.op("hard_swish", ins[0], name=n.output[0])
+
+
+# -- misc tensor ops --------------------------------------------------------
+
+@R("CumSum")
+def _cumsum(sd, n, ins):
+    axis = int(np.asarray(ins[1].get_arr()).reshape(()))
+    return sd.op("cumsum_ext", ins[0], axis=axis,
+                 exclusive=bool(_ai(n, "exclusive", 0)),
+                 reverse=bool(_ai(n, "reverse", 0)), name=n.output[0])
+
+
+@R("TopK")
+def _topk(sd, n, ins):
+    k = int(_const_ints(ins[1])[0])
+    axis = _ai(n, "axis", -1)
+    if axis not in (-1, None):
+        xs = _static_shape(sd, ins[0], f"TopK '{n.name}'")
+        if axis != len(xs) - 1:
+            raise UnmappedOnnxOpException(
+                f"TopK '{n.name}': only last-axis supported (got {axis})")
+    if _ai(n, "largest", 1) != 1:
+        raise UnmappedOnnxOpException(
+            f"TopK '{n.name}': largest=0 not supported")
+    packed = sd.op("top_k", ins[0], k=k, name=f"{n.output[0]}__packed")
+    vals = sd.op("tuple_get", packed, index=0, name=n.output[0])
+    idx32 = sd.op("tuple_get", packed, index=1)
+    idx = sd.op("cast", idx32, dtype="int64", name=n.output[1])  # onnx I
+    return vals, idx
+
+
+@R("Trilu")
+def _trilu(sd, n, ins):
+    k = 0 if len(ins) < 2 or ins[1] is None else \
+        int(np.asarray(ins[1].get_arr()).reshape(()))
+    op = "triu" if _ai(n, "upper", 1) else "tril"
+    return sd.op(op, ins[0], k=k, name=n.output[0])
+
+
+@R("Mod")
+def _mod(sd, n, ins):
+    op = "fmod" if _ai(n, "fmod", 0) else "mod"
+    return sd.op(op, ins[0], ins[1], name=n.output[0])
+
+
+@R("ReduceL2")
+def _reduce_l2(sd, n, ins):
+    axes = _aints(n, "axes")
+    if len(ins) > 1 and ins[1] is not None:
+        axes = _const_ints(ins[1])
+    return sd.op("norm2", ins[0], axis=axes,
+                 keepdims=bool(_ai(n, "keepdims", 1)), name=n.output[0])
+
+
+@R("OneHot")
+def _one_hot(sd, n, ins):
+    depth = int(np.asarray(ins[1].get_arr()).reshape(()))
+    values = np.asarray(ins[2].get_arr())
+    axis = _ai(n, "axis", -1)
+    if axis != -1:
+        raise UnmappedOnnxOpException(
+            f"OneHot '{n.name}': only axis=-1 supported")
+    on, off = float(values[1]), float(values[0])
+    idx = ins[0]
+    # onnx: i < 0 means depth + i (jax.nn.one_hot would emit all-off)
+    neg = sd.op("less", idx, idx._coerce(0))
+    idx = sd.op("where", neg, idx + depth, idx)
+    oh = sd.op("one_hot", idx, depth=depth)
+    return sd.rename((oh * (on - off) + off).name, n.output[0])
+
+
 # -- import driver ----------------------------------------------------------
 
 def import_onnx_model(src, trainable: bool = True) -> SameDiff:

@@ -13,7 +13,8 @@ import torch.nn.functional as TTF
 from deeplearning4j_tpu.modelimport.onnx_import import import_onnx_model
 from tests.test_onnx_import import _N, _model, _vi
 from deeplearning4j_tpu.modelimport.onnx_proto import (attr_f, attr_i,
-                                                       attr_ints, attr_s)
+                                                       attr_ints, attr_s,
+                                                       attr_t)
 
 rs = np.random.RandomState(7)
 
@@ -251,6 +252,93 @@ case("pow-sqrt-reciprocal",
      {"x": F(3, 4, lo=0.5, hi=2.0)},
      {"e": np.full((), 2.0, np.float32)},
      lambda x: 1.0 / np.sqrt(x ** 2), tol=1e-4)
+
+
+
+# ---- round-5 opset tail: shape/broadcast/norm/activation/misc ----
+_x_sm = F(2, 3, 4, 5)
+case("shape-expand",
+     [_N("Shape", ["x"], ["s"]),
+      _N("Expand", ["x2", "tgt"], ["y"])],
+     {"x": _x_sm, "x2": F(3, 1, 5)},
+     {"tgt": np.asarray([2, 3, 4, 5], np.int64)},
+     lambda x, x2: (np.asarray(x.shape, np.int64),
+                    np.broadcast_to(x2, (2, 3, 4, 5)))[1])
+
+case("tile",
+     [_N("Tile", ["x", "reps"], ["y"])],
+     {"x": F(2, 3)}, {"reps": np.asarray([2, 3], np.int64)},
+     lambda x: np.tile(x, (2, 3)))
+
+case("constantofshape-range",
+     [_N("ConstantOfShape", ["shp"], ["c"],
+         attr_t("value", np.asarray([2.5], np.float32))),
+      _N("Range", ["r0", "r1", "r2"], ["r"]),
+      _N("Mul", ["c", "r"], ["y"])],
+     {}, {"shp": np.asarray([4], np.int64),
+          "r0": np.asarray(0, np.float32),
+          "r1": np.asarray(4, np.float32),
+          "r2": np.asarray(1, np.float32)},
+     lambda: 2.5 * np.arange(0, 4, 1, dtype=np.float32))
+
+_x_in = F(2, 4, 6, 6)
+_sc_in, _b_in = F(4, lo=0.5, hi=1.5), F(4)
+case("instancenorm",
+     [_N("InstanceNormalization", ["x", "s", "b"], ["y"],
+         attr_f("epsilon", 1e-5))],
+     {"x": _x_in}, {"s": _sc_in, "b": _b_in},
+     lambda x: TTF.instance_norm(_t(x), weight=_t(_sc_in), bias=_t(_b_in),
+                                 eps=1e-5).numpy(), tol=1e-4)
+
+_slope = F(3, 1, 1, lo=0.05, hi=0.4)
+case("prelu",
+     [_N("PRelu", ["x", "a"], ["y"])],
+     {"x": _x_img}, {"a": _slope},
+     lambda x: np.where(x > 0, x, _slope[None] * x).astype(np.float32))
+
+case("hardsigmoid-hardswish",
+     [_N("HardSigmoid", ["x"], ["h"], attr_f("alpha", 1.0 / 6.0),
+         attr_f("beta", 0.5)),
+      _N("HardSwish", ["x"], ["w"]),
+      _N("Mul", ["h", "w"], ["y"])],
+     {"x": F(3, 7)}, {},
+     lambda x: (TTF.hardsigmoid(_t(x)) * TTF.hardswish(_t(x))).numpy(),
+     tol=1e-5)
+
+case("cumsum-reverse-exclusive",
+     [_N("CumSum", ["x", "ax"], ["y"], attr_i("exclusive", 1),
+         attr_i("reverse", 1))],
+     {"x": F(3, 5)}, {"ax": np.asarray(1, np.int64)},
+     lambda x: np.flip(np.concatenate(
+         [np.zeros((3, 1), np.float32),
+          np.cumsum(np.flip(x, 1), 1)[:, :-1]], 1), 1))
+
+case("topk",
+     [_N("TopK", ["x", "k"], ["v", "i"]),
+      _N("Identity", ["v"], ["y"])],
+     {"x": F(4, 9)}, {"k": np.asarray([3], np.int64)},
+     lambda x: torch.topk(_t(x), 3, dim=-1).values.numpy())
+
+case("trilu-mod",
+     [_N("Trilu", ["x", "k"], ["t"], attr_i("upper", 0)),
+      _N("Mod", ["t", "d"], ["y"], attr_i("fmod", 1))],
+     {"x": F(5, 5)}, {"k": np.asarray(1, np.int64),
+                      "d": np.asarray([1.3], np.float32)},
+     lambda x: np.fmod(np.tril(x, 1), np.float32(1.3)))
+
+case("reducel2",
+     [_N("ReduceL2", ["x"], ["y"], attr_ints("axes", [1]),
+         attr_i("keepdims", 0))],
+     {"x": F(4, 6)}, {},
+     lambda x: np.sqrt((x * x).sum(1)))
+
+case("onehot-negative-index",
+     [_N("OneHot", ["i", "d", "v"], ["y"])],
+     {}, {"i": np.asarray([0, 2, -1], np.int64),
+          "d": np.asarray(4, np.int64),
+          "v": np.asarray([-1.0, 2.0], np.float32)},
+     # onnx: index -1 means depth-1
+     lambda: (np.eye(4, dtype=np.float32)[[0, 2, 3]] * 3.0 - 1.0))
 
 
 @pytest.mark.parametrize(
