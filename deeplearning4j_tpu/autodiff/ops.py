@@ -145,6 +145,8 @@ register_op("one_hot", lambda idx, depth, dtype="float32":
 register_op("cast", lambda a, dtype: a.astype(jnp.dtype(dtype)))
 register_op("shape_of", lambda a: jnp.asarray(a.shape, jnp.int32))
 register_op("zeros_like", jnp.zeros_like)
+register_op("zeros_rows_like", lambda a, n: jnp.zeros((a.shape[0], int(n)),
+                                                      a.dtype))
 register_op("ones_like", jnp.ones_like)
 register_op("pad", lambda a, paddings, value=0.0:
             jnp.pad(a, tuple(tuple(p) for p in paddings),

@@ -615,6 +615,117 @@ def _one_hot(sd, n, ins):
     return sd.rename((oh * (on - off) + off).name, n.output[0])
 
 
+# -- recurrent layers (torch nn.LSTM / nn.GRU exports) ----------------------
+
+def _rnn_weights(sd, n, W, R, B, n_gates, perm, hidden):
+    """Split ONNX packed RNN weights into our cell layout.
+
+    ONNX packs W:[1, G*H, I], R:[1, G*H, H], B:[1, 2*G*H] with its own
+    gate order; `perm` reorders gate blocks into the registry cells'
+    order.  Transformed tensors re-enter the graph as trainable variables
+    (imported initializers are trainable, module docstring)."""
+    w = np.asarray(W.get_arr())[0]
+    r = np.asarray(R.get_arr())[0]
+    H = hidden
+
+    def reorder(m):
+        blocks = [m[g * H:(g + 1) * H] for g in range(n_gates)]
+        return np.concatenate([blocks[g] for g in perm], 0)
+
+    w_ih = reorder(w).T.copy()              # [I, G*H]
+    w_hh = reorder(r).T.copy()              # [H, G*H]
+    if B is not None:
+        b = np.asarray(B.get_arr())[0]
+        wb = reorder(b[:n_gates * H])
+        rb = reorder(b[n_gates * H:])
+    else:
+        wb = rb = np.zeros(n_gates * H, w.dtype)
+    mk = lambda tag, arr: sd.var(f"{n.output[0]}__{tag}", np.asarray(arr))
+    return mk("w_ih", w_ih), mk("w_hh", w_hh), wb, rb
+
+
+def _rnn_common(sd, n, ins, n_gates):
+    if _astr(n, "direction", "forward") != "forward":
+        raise UnmappedOnnxOpException(
+            f"{n.op_type} '{n.name}': only direction=forward supported")
+    if _ai(n, "layout", 0) != 0:
+        raise UnmappedOnnxOpException(
+            f"{n.op_type} '{n.name}': only layout=0 ([T,B,*]) supported")
+    if len(ins) > 4 and ins[4] is not None:
+        raise UnmappedOnnxOpException(
+            f"{n.op_type} '{n.name}': sequence_lens unsupported — export "
+            "fixed-length sequences")
+    hidden = _ai(n, "hidden_size")
+    B = ins[3] if len(ins) > 3 else None
+    xbtf = sd.op("transpose", ins[0], perm=[1, 0, 2])   # [T,B,I]->[B,T,I]
+    return hidden, B, xbtf
+
+
+def _squeeze0(sd, v):
+    return None if v is None else sd.op("squeeze", v, axis=(0,))
+
+
+@R("LSTM")
+def _lstm_onnx(sd, n, ins):
+    """ONNX LSTM (iofc gate order) -> lstm_layer_full (IFCO)."""
+    if len(ins) > 7 and ins[7] is not None:
+        raise UnmappedOnnxOpException(
+            f"LSTM '{n.name}': peephole weights unsupported")
+    hidden, B, xbtf = _rnn_common(sd, n, ins, 4)
+    w_ih, w_hh, wb, rb = _rnn_weights(sd, n, ins[1], ins[2], B, 4,
+                                      perm=[0, 2, 3, 1], hidden=hidden)
+    bias = sd.var(f"{n.output[0]}__b", np.asarray(wb + rb))
+    h0 = _squeeze0(sd, ins[5] if len(ins) > 5 else None)
+    c0 = _squeeze0(sd, ins[6] if len(ins) > 6 else None)
+    if c0 is not None and h0 is None:     # onnx allows either alone
+        h0 = sd.op("zeros_like", c0)
+    args = [xbtf, w_ih, w_hh, bias] + ([h0] if h0 is not None else []) \
+        + ([c0] if c0 is not None else [])
+    packed = sd.op("lstm_layer_full", *args,
+                   name=f"{n.output[0]}__packed")
+    seq = sd.op("tuple_get", packed, index=0)         # [B,T,H]
+    h_n = sd.op("tuple_get", packed, index=1)         # [B,H]
+    c_n = sd.op("tuple_get", packed, index=2)
+    y = sd.op("expand_dims", sd.op("transpose", seq, perm=[1, 0, 2]),
+              axis=1, name=n.output[0])               # [T,1,B,H]
+    outs = [y]
+    if len(n.output) > 1 and n.output[1]:
+        outs.append(sd.op("expand_dims", h_n, axis=0, name=n.output[1]))
+    if len(n.output) > 2 and n.output[2]:
+        outs.append(sd.op("expand_dims", c_n, axis=0, name=n.output[2]))
+    return tuple(outs)
+
+
+@R("GRU")
+def _gru_onnx(sd, n, ins):
+    """ONNX GRU (zrh gate order) -> gru_layer ([r,z,n] order).
+
+    Only linear_before_reset=1 (the torch export form — and exactly the
+    registry gru_cell's semantics: r gates the already-linear W_hn·h+b)."""
+    if not _ai(n, "linear_before_reset", 0):
+        raise UnmappedOnnxOpException(
+            f"GRU '{n.name}': linear_before_reset=0 unsupported (torch "
+            "exports 1; the registry cell implements that form)")
+    hidden, B, xbtf = _rnn_common(sd, n, ins, 3)
+    w_ih, w_hh, wb, rb = _rnn_weights(sd, n, ins[1], ins[2], B, 3,
+                                      perm=[1, 0, 2], hidden=hidden)
+    b_ih = sd.var(f"{n.output[0]}__b_ih", np.asarray(wb))
+    b_hh = sd.var(f"{n.output[0]}__b_hh", np.asarray(rb))
+    h0 = _squeeze0(sd, ins[5] if len(ins) > 5 else None)
+    if h0 is None:                        # batch/dtype-generic zeros
+        h0 = sd.op("zeros_rows_like", xbtf, n=hidden)
+    seq = sd.op("gru_layer", xbtf, h0, w_ih, w_hh, b_ih, b_hh,
+                name=f"{n.output[0]}__seq")           # [B,T,H]
+    y = sd.op("expand_dims", sd.op("transpose", seq, perm=[1, 0, 2]),
+              axis=1, name=n.output[0])               # [T,1,B,H]
+    outs = [y]
+    if len(n.output) > 1 and n.output[1]:
+        last = sd.op("gather", seq, sd.constant(None, np.int64(-1)),
+                     axis=1)                          # [B,H] final step
+        outs.append(sd.op("expand_dims", last, axis=0, name=n.output[1]))
+    return tuple(outs)
+
+
 # -- import driver ----------------------------------------------------------
 
 def import_onnx_model(src, trainable: bool = True) -> SameDiff:

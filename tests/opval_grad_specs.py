@@ -145,6 +145,8 @@ def _nd(ops, reason):
 _nd(["sign", "floor", "ceil", "round", "rint", "trunc", "zero_fraction",
      "relu_derivative"],
     "piecewise-constant output: gradient is zero a.e., FD checks nothing")
+_nd(["zeros_rows_like"],
+    "constant-zero output regardless of input: gradient identically zero")
 _nd(["mod", "fmod", "remainder", "reverse_mod", "truncate_div",
      "floor_div"],
     "discontinuous at quotient boundaries; central FD straddles jumps")

@@ -348,6 +348,8 @@ CASES += [
     C("size_at", _t3, jit=False, kw={"dim": 1},
       check=lambda out: np.testing.assert_array_equal(out[0], 3)),
     C("zeros_like", _m, g=np.zeros_like),
+    C("zeros_rows_like", _m, kw={"n": 5},
+      g=lambda a, n: np.zeros((a.shape[0], n), a.dtype)),
     C("ones_like", _m, g=np.ones_like),
     C("fill_like", _m, g=lambda a, value: np.full_like(a, value),
       kw={"value": 2.5}),

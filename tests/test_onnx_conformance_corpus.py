@@ -341,6 +341,80 @@ case("onehot-negative-index",
      lambda: (np.eye(4, dtype=np.float32)[[0, 2, 3]] * 3.0 - 1.0))
 
 
+
+# ---- recurrent: ONNX LSTM / GRU vs torch.nn reference ----
+_T, _B, _I, _H = 5, 2, 3, 4
+_x_seq = F(_T, _B, _I)                           # onnx layout 0: [T,B,I]
+_rs_lstm = np.random.RandomState(11)
+
+
+def _g(*s):
+    return _rs_lstm.uniform(-0.4, 0.4, s).astype(np.float32)
+
+
+# torch packs gates ifgo; onnx wants iofc
+_tw_ih, _tw_hh = _g(4 * _H, _I), _g(4 * _H, _H)
+_tb_ih, _tb_hh = _g(4 * _H), _g(4 * _H)
+
+
+def _ifgo_to_iofc(m):
+    i, f, g, o = np.split(m, 4, 0)
+    return np.concatenate([i, o, f, g], 0)
+
+
+def _lstm_golden(x):
+    lstm = torch.nn.LSTM(_I, _H, 1)
+    sd_ = lstm.state_dict()
+    sd_["weight_ih_l0"] = _t(_tw_ih); sd_["weight_hh_l0"] = _t(_tw_hh)
+    sd_["bias_ih_l0"] = _t(_tb_ih); sd_["bias_hh_l0"] = _t(_tb_hh)
+    lstm.load_state_dict(sd_)
+    with torch.no_grad():
+        y, _ = lstm(_t(x))
+    return y.numpy()[:, None]                    # [T,1,B,H]
+
+
+case("lstm",
+     [_N("LSTM", ["x", "W", "R", "Bb"], ["y", "yh", "yc"],
+         attr_i("hidden_size", _H))],
+     {"x": _x_seq},
+     {"W": _ifgo_to_iofc(_tw_ih)[None],
+      "R": _ifgo_to_iofc(_tw_hh)[None],
+      "Bb": np.concatenate([_ifgo_to_iofc(_tb_ih),
+                            _ifgo_to_iofc(_tb_hh)])[None]},
+     _lstm_golden, tol=1e-5)
+
+# torch GRU packs gates rzn; onnx wants zrh; linear_before_reset=1
+_gw_ih, _gw_hh = _g(3 * _H, _I), _g(3 * _H, _H)
+_gb_ih, _gb_hh = _g(3 * _H), _g(3 * _H)
+
+
+def _rzn_to_zrh(m):
+    r, z, nn_ = np.split(m, 3, 0)
+    return np.concatenate([z, r, nn_], 0)
+
+
+def _gru_golden(x):
+    gru = torch.nn.GRU(_I, _H, 1)
+    sd_ = gru.state_dict()
+    sd_["weight_ih_l0"] = _t(_gw_ih); sd_["weight_hh_l0"] = _t(_gw_hh)
+    sd_["bias_ih_l0"] = _t(_gb_ih); sd_["bias_hh_l0"] = _t(_gb_hh)
+    gru.load_state_dict(sd_)
+    with torch.no_grad():
+        y, _ = gru(_t(x))
+    return y.numpy()[:, None]
+
+
+case("gru",
+     [_N("GRU", ["x", "W", "R", "Bb"], ["y"],
+         attr_i("hidden_size", _H), attr_i("linear_before_reset", 1))],
+     {"x": _x_seq},
+     {"W": _rzn_to_zrh(_gw_ih)[None],
+      "R": _rzn_to_zrh(_gw_hh)[None],
+      "Bb": np.concatenate([_rzn_to_zrh(_gb_ih),
+                            _rzn_to_zrh(_gb_hh)])[None]},
+     _gru_golden, tol=1e-5)
+
+
 @pytest.mark.parametrize(
     "name,nodes,inputs,inits,golden,tol", CORPUS,
     ids=[c[0] for c in CORPUS])

@@ -344,3 +344,98 @@ def test_unmapped_op_named_error():
                [_vi("y", (2,))], {})
     with pytest.raises(UnmappedOnnxOpException, match="FancyNewOp"):
         import_onnx_model(m)
+
+
+def test_onnx_lstm_gru_state_outputs_and_initial_states():
+    """LSTM Y/Y_h/Y_c and GRU Y/Y_h with initial_h/initial_c vs torch
+    (the state paths the single-output corpus runner cannot cover)."""
+    import torch
+
+    rs = np.random.RandomState(23)
+    T, B, I, H = 4, 3, 5, 6
+
+    def g(*s):
+        return rs.uniform(-0.4, 0.4, s).astype(np.float32)
+
+    x = g(T, B, I)
+    h0 = g(1, B, H)
+    c0 = g(1, B, H)
+
+    # --- LSTM (torch ifgo -> onnx iofc) ---
+    tw_ih, tw_hh, tb_ih, tb_hh = g(4 * H, I), g(4 * H, H), g(4 * H), g(4 * H)
+
+    def iofc(m):
+        i, f, gg, o = np.split(m, 4, 0)
+        return np.concatenate([i, o, f, gg], 0)
+
+    lstm = torch.nn.LSTM(I, H, 1)
+    st = lstm.state_dict()
+    st["weight_ih_l0"] = torch.from_numpy(tw_ih)
+    st["weight_hh_l0"] = torch.from_numpy(tw_hh)
+    st["bias_ih_l0"] = torch.from_numpy(tb_ih)
+    st["bias_hh_l0"] = torch.from_numpy(tb_hh)
+    lstm.load_state_dict(st)
+    with torch.no_grad():
+        ty, (th, tc) = lstm(torch.from_numpy(x),
+                            (torch.from_numpy(h0), torch.from_numpy(c0)))
+
+    nodes = [_N("LSTM", ["x", "W", "R", "Bb", "", "h0", "c0"],
+                ["y", "yh", "yc"], attr_i("hidden_size", H))]
+    model = _model(nodes, [_vi("x", x.shape), _vi("h0", h0.shape),
+                           _vi("c0", c0.shape)],
+                   [_vi("y", ()), _vi("yh", ()), _vi("yc", ())],
+                   {"W": iofc(tw_ih)[None], "R": iofc(tw_hh)[None],
+                    "Bb": np.concatenate([iofc(tb_ih), iofc(tb_hh)])[None]})
+    sd = import_onnx_model(model)
+    got = sd.output({"x": x, "h0": h0, "c0": c0}, "y", "yh", "yc")
+    np.testing.assert_allclose(np.asarray(got["y"]), ty.numpy()[:, None],
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got["yh"]), th.numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got["yc"]), tc.numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+    # --- GRU (torch rzn -> onnx zrh), with initial_h and Y_h output ---
+    gw_ih, gw_hh, gb_ih, gb_hh = g(3 * H, I), g(3 * H, H), g(3 * H), g(3 * H)
+
+    def zrh(m):
+        r, z, nn_ = np.split(m, 3, 0)
+        return np.concatenate([z, r, nn_], 0)
+
+    gru = torch.nn.GRU(I, H, 1)
+    st = gru.state_dict()
+    st["weight_ih_l0"] = torch.from_numpy(gw_ih)
+    st["weight_hh_l0"] = torch.from_numpy(gw_hh)
+    st["bias_ih_l0"] = torch.from_numpy(gb_ih)
+    st["bias_hh_l0"] = torch.from_numpy(gb_hh)
+    gru.load_state_dict(st)
+    with torch.no_grad():
+        gy, gh = gru(torch.from_numpy(x), torch.from_numpy(h0))
+
+    nodes = [_N("GRU", ["x", "W", "R", "Bb", "", "h0"], ["y", "yh"],
+                attr_i("hidden_size", H), attr_i("linear_before_reset", 1))]
+    model = _model(nodes, [_vi("x", x.shape), _vi("h0", h0.shape)],
+                   [_vi("y", ()), _vi("yh", ())],
+                   {"W": zrh(gw_ih)[None], "R": zrh(gw_hh)[None],
+                    "Bb": np.concatenate([zrh(gb_ih), zrh(gb_hh)])[None]})
+    sd = import_onnx_model(model)
+    got = sd.output({"x": x, "h0": h0}, "y", "yh")
+    np.testing.assert_allclose(np.asarray(got["y"]), gy.numpy()[:, None],
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got["yh"]), gh.numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+    # --- LSTM with ONLY initial_c (the silent-drop regression) ---
+    nodes = [_N("LSTM", ["x", "W", "R", "Bb", "", "", "c0"],
+                ["y"], attr_i("hidden_size", H))]
+    model = _model(nodes, [_vi("x", x.shape), _vi("c0", c0.shape)],
+                   [_vi("y", ())],
+                   {"W": iofc(tw_ih)[None], "R": iofc(tw_hh)[None],
+                    "Bb": np.concatenate([iofc(tb_ih), iofc(tb_hh)])[None]})
+    sd = import_onnx_model(model)
+    with torch.no_grad():
+        ty2, _ = lstm(torch.from_numpy(x),
+                      (torch.zeros(1, B, H), torch.from_numpy(c0)))
+    got = sd.output({"x": x, "c0": c0}, "y")
+    np.testing.assert_allclose(np.asarray(got["y"]), ty2.numpy()[:, None],
+                               rtol=1e-5, atol=1e-5)
