@@ -615,6 +615,53 @@ def _one_hot(sd, n, ins):
     return sd.rename((oh * (on - off) + off).name, n.output[0])
 
 
+@R("Resize")
+def _resize(sd, n, ins):
+    """ONNX Resize, the torch Upsample export envelope: mode=nearest with
+    asymmetric/floor (integer upscale — exactly pixel-repeat, which
+    jax.image's half-pixel nearest also produces at integer factors) and
+    mode=linear with half_pixel (= jax.image bilinear).  NCHW in/out."""
+    mode = _astr(n, "mode", "nearest")
+    ct = _astr(n, "coordinate_transformation_mode", "half_pixel")
+    xs = _static_shape(sd, ins[0], f"Resize '{n.name}'")
+    if len(xs) != 4:
+        raise UnmappedOnnxOpException(
+            f"Resize '{n.name}': only 4-D NCHW inputs supported")
+    if len(ins) > 3 and ins[3] is not None:          # sizes
+        sizes = _const_ints(ins[3])
+        oh, ow = sizes[2], sizes[3]
+    elif len(ins) > 2 and ins[2] is not None:        # scales
+        scales = [float(v) for v in
+                  np.atleast_1d(np.asarray(ins[2].get_arr()))]
+        oh = int(round(xs[2] * scales[2]))
+        ow = int(round(xs[3] * scales[3]))
+    else:
+        raise UnmappedOnnxOpException(
+            f"Resize '{n.name}': needs scales or sizes")
+    if mode == "nearest":
+        nm = _astr(n, "nearest_mode", "round_prefer_floor")
+        int_up = oh % xs[2] == 0 and ow % xs[3] == 0
+        if not (ct in ("asymmetric", "half_pixel") and int_up
+                and nm in ("floor", "round_prefer_floor")):
+            raise UnmappedOnnxOpException(
+                f"Resize '{n.name}': nearest supported only for integer "
+                f"upscale with asymmetric/half_pixel + floor modes "
+                f"(got ct={ct}, nearest_mode={nm}, {xs[2:]}→{(oh, ow)})")
+        our = "resize_nearest"
+    elif mode == "linear":
+        if ct != "half_pixel":
+            raise UnmappedOnnxOpException(
+                f"Resize '{n.name}': linear supported only with "
+                f"half_pixel (torch align_corners=False); got {ct}")
+        our = "resize_bilinear"
+    else:
+        raise UnmappedOnnxOpException(
+            f"Resize '{n.name}': mode={mode} unsupported")
+    nhwc = sd.op("transpose", ins[0], perm=[0, 2, 3, 1])
+    y = sd.op(our, nhwc, size=[oh, ow])
+    return sd.op("transpose", y, perm=[0, 3, 1, 2], name=n.output[0])
+
+
 # -- recurrent layers (torch nn.LSTM / nn.GRU exports) ----------------------
 
 def _rnn_weights(sd, n, W, R, B, n_gates, perm, hidden):

@@ -415,6 +415,25 @@ case("gru",
      _gru_golden, tol=1e-5)
 
 
+
+case("resize-nearest-2x",
+     [_N("Resize", ["x", "", "sc"], ["y"], attr_s("mode", "nearest"),
+         attr_s("coordinate_transformation_mode", "asymmetric"),
+         attr_s("nearest_mode", "floor"))],
+     {"x": F(2, 3, 4, 5)},
+     {"sc": np.asarray([1.0, 1.0, 2.0, 2.0], np.float32)},
+     lambda x: TTF.interpolate(_t(x), scale_factor=2,
+                               mode="nearest").numpy())
+
+case("resize-bilinear-half-pixel",
+     [_N("Resize", ["x", "", "", "sz"], ["y"], attr_s("mode", "linear"),
+         attr_s("coordinate_transformation_mode", "half_pixel"))],
+     {"x": F(1, 2, 5, 5)},
+     {"sz": np.asarray([1, 2, 8, 9], np.int64)},
+     lambda x: TTF.interpolate(_t(x), size=(8, 9), mode="bilinear",
+                               align_corners=False).numpy(), tol=1e-5)
+
+
 @pytest.mark.parametrize(
     "name,nodes,inputs,inits,golden,tol", CORPUS,
     ids=[c[0] for c in CORPUS])
