@@ -615,6 +615,40 @@ def _one_hot(sd, n, ins):
     return sd.rename((oh * (on - off) + off).name, n.output[0])
 
 
+@R("Einsum")
+def _einsum(sd, n, ins):
+    return sd.op("einsum", *ins, equation=_astr(n, "equation"),
+                 name=n.output[0])
+
+
+@R("GatherND")
+def _gather_nd(sd, n, ins):
+    if _ai(n, "batch_dims", 0) != 0:
+        raise UnmappedOnnxOpException(
+            f"GatherND '{n.name}': batch_dims != 0 unsupported")
+    return sd.op("gather_nd", ins[0], ins[1], name=n.output[0])
+
+
+@R("ReduceLogSumExp")
+def _reduce_lse(sd, n, ins):
+    axes = _aints(n, "axes")
+    if len(ins) > 1 and ins[1] is not None:
+        axes = _const_ints(ins[1])
+    return sd.op("logsumexp", ins[0],
+                 axis=None if axes is None else tuple(axes),
+                 keepdims=bool(_ai(n, "keepdims", 1)), name=n.output[0])
+
+
+@R("GreaterOrEqual")
+def _ge(sd, n, ins):
+    return sd.op("greater_equal", ins[0], ins[1], name=n.output[0])
+
+
+@R("LessOrEqual")
+def _le(sd, n, ins):
+    return sd.op("less_equal", ins[0], ins[1], name=n.output[0])
+
+
 @R("Resize")
 def _resize(sd, n, ins):
     """ONNX Resize, the torch Upsample export envelope: mode=nearest with

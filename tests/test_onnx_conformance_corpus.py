@@ -434,6 +434,27 @@ case("resize-bilinear-half-pixel",
                                align_corners=False).numpy(), tol=1e-5)
 
 
+
+case("einsum-gathernd-lse",
+     [_N("Einsum", ["x", "w"], ["e"], attr_s("equation", "bij,bjk->bik")),
+      _N("ReduceLogSumExp", ["e"], ["l"], attr_ints("axes", [2]),
+         attr_i("keepdims", 0)),
+      _N("GatherND", ["l", "gi"], ["y"])],
+     {"x": F(2, 3, 4), "w": F(2, 4, 5)},
+     {"gi": np.asarray([[0, 1], [1, 2]], np.int64)},
+     lambda x, w: np.asarray(
+         [np.log(np.exp((x[0] @ w[0]))[1].sum()),
+          np.log(np.exp((x[1] @ w[1]))[2].sum())], np.float32), tol=1e-5)
+
+case("greater-less-or-equal",
+     [_N("GreaterOrEqual", ["a", "b"], ["g"]),
+      _N("LessOrEqual", ["a", "b"], ["l"]),
+      _N("And", ["g", "l"], ["e"]),
+      _N("Cast", ["e"], ["y"], attr_i("to", 1))],
+     {"a": F(3, 4), "b": F(3, 4)}, {},
+     lambda a, b: ((a >= b) & (a <= b)).astype(np.float32))
+
+
 @pytest.mark.parametrize(
     "name,nodes,inputs,inits,golden,tol", CORPUS,
     ids=[c[0] for c in CORPUS])
