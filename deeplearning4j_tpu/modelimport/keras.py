@@ -27,7 +27,7 @@ from deeplearning4j_tpu.nn import (
     ComputationGraph, Convolution1DLayer, ConvolutionLayer,
     Deconvolution2DLayer, DenseLayer, DepthwiseConvolution2DLayer,
     DropoutLayer, ElementWiseVertex, EmbeddingSequenceLayer,
-    GlobalPoolingLayer, GraphBuilder, InputType, LastTimeStep, Layer,
+    GlobalPoolingLayer, GraphBuilder, GRU, InputType, LastTimeStep, Layer,
     LayerNormalizationLayer, LSTM, MergeVertex, MultiLayerNetwork,
     NeuralNetConfiguration, OutputLayer, PermuteLayer, RepeatVectorLayer,
     FlattenLayer, ReshapeLayer, SeparableConvolution2DLayer, SimpleRnn,
@@ -160,6 +160,20 @@ def _lstm(cfg, is_output):
     return layer
 
 
+def _gru(cfg, is_output):
+    if not cfg.get("reset_after", True):
+        raise UnsupportedKerasConfigurationException(
+            "GRU reset_after=False unsupported (keras default is True; "
+            "the cell here implements the reset_after form)")
+    layer = GRU(n_out=cfg["units"],
+                activation=_act(cfg.get("activation", "tanh")),
+                gate_activation=_act(cfg.get("recurrent_activation",
+                                             "sigmoid")))
+    if not cfg.get("return_sequences", False):
+        return LastTimeStep(underlying=layer)
+    return layer
+
+
 def _simplernn(cfg, is_output):
     layer = SimpleRnn(n_out=cfg["units"],
                       activation=_act(cfg.get("activation", "tanh")))
@@ -174,7 +188,7 @@ def _bidirectional(cfg, is_output):
     return_sequences=False maps to our `return_last` semantics."""
     inner_lc = cfg["layer"]
     inner_cls = inner_lc["class_name"]
-    if inner_cls not in ("LSTM", "SimpleRNN"):
+    if inner_cls not in ("LSTM", "GRU", "SimpleRNN"):
         raise UnsupportedKerasConfigurationException(
             f"Bidirectional over unsupported inner layer '{inner_cls}'")
     inner_cfg = dict(inner_lc["config"])
@@ -378,6 +392,7 @@ LAYER_MAP: Dict[str, Callable] = {
     "PReLU": _prelu,
     "Embedding": _embedding,
     "LSTM": _lstm,
+    "GRU": _gru,
     "SimpleRNN": _simplernn,
     "ZeroPadding2D": _zeropad,
     "Cropping2D": _cropping2d,
@@ -429,9 +444,29 @@ def _reorder_lstm_gates(k: np.ndarray, H: int) -> np.ndarray:
     return np.concatenate([i, f, o, c], axis=-1)
 
 
+def _reorder_gru_gates(k: np.ndarray, H: int) -> np.ndarray:
+    """Keras gate blocks [z, r, h] -> our (r, z, n)."""
+    z, r, h = (k[..., :H], k[..., H:2*H], k[..., 2*H:])
+    return np.concatenate([r, z, h], axis=-1)
+
+
 def _copy_rnn_weights(dst, il, w):
     """Copy one direction's Keras RNN weights into our param dict."""
-    if isinstance(il, LSTM):
+    if isinstance(il, GRU):
+        H = il.n_out
+        dst["W"] = _reorder_gru_gates(w["kernel"], H)
+        dst["RW"] = _reorder_gru_gates(w["recurrent_kernel"], H)
+        if "bias" not in w:                        # use_bias=False
+            dst["b"] = np.zeros(3 * H, np.float32)
+            dst["rb"] = np.zeros(3 * H, np.float32)
+            return
+        bias = w["bias"]
+        if bias.ndim != 2:
+            raise UnsupportedKerasConfigurationException(
+                "GRU bias must be [2, 3H] (reset_after=True)")
+        dst["b"] = _reorder_gru_gates(bias[0], H)
+        dst["rb"] = _reorder_gru_gates(bias[1], H)
+    elif isinstance(il, LSTM):
         H = il.n_out
         dst["W"] = _reorder_lstm_gates(w["kernel"], H)
         dst["RW"] = _reorder_lstm_gates(w["recurrent_kernel"], H)
@@ -477,12 +512,10 @@ def _set_weights(net, name: str, layer: Layer, pw: Dict[str, np.ndarray]):
                 f"groups (paths: {sorted(pw)})")
         _copy_rnn_weights(params["fwd"], il, fw)
         _copy_rnn_weights(params["bwd"], il, bw)
-    elif isinstance(inner, LSTM):
-        H = inner.n_out
-        # LastTimeStep forwards its underlying layer's params un-nested
-        params["W"] = _reorder_lstm_gates(w["kernel"], H)
-        params["RW"] = _reorder_lstm_gates(w["recurrent_kernel"], H)
-        params["b"] = _reorder_lstm_gates(w["bias"], H)
+    elif isinstance(inner, (LSTM, GRU)):
+        # LastTimeStep forwards its underlying layer's params un-nested;
+        # gate reorder + bias split live in _copy_rnn_weights
+        _copy_rnn_weights(params, inner, w)
     elif isinstance(inner, BatchNormalizationLayer):
         if "gamma" in w:
             params["gamma"] = w["gamma"]

@@ -107,7 +107,9 @@ for onnx_op, our in [("Add", "add"), ("Sub", "sub"), ("Mul", "mul"),
                      ("Div", "div"), ("Pow", "pow"),
                      ("Equal", "equal"), ("Greater", "greater"),
                      ("Less", "less"), ("And", "logical_and"),
-                     ("Or", "logical_or")]:
+                     ("Or", "logical_or"),
+                     ("GreaterOrEqual", "greater_equal"),
+                     ("LessOrEqual", "less_equal")]:
     R(onnx_op, (lambda our: lambda sd, n, ins:
                 sd.op(our, ins[0], ins[1], name=n.output[0]))(our))
 
@@ -629,24 +631,7 @@ def _gather_nd(sd, n, ins):
     return sd.op("gather_nd", ins[0], ins[1], name=n.output[0])
 
 
-@R("ReduceLogSumExp")
-def _reduce_lse(sd, n, ins):
-    axes = _aints(n, "axes")
-    if len(ins) > 1 and ins[1] is not None:
-        axes = _const_ints(ins[1])
-    return sd.op("logsumexp", ins[0],
-                 axis=None if axes is None else tuple(axes),
-                 keepdims=bool(_ai(n, "keepdims", 1)), name=n.output[0])
-
-
-@R("GreaterOrEqual")
-def _ge(sd, n, ins):
-    return sd.op("greater_equal", ins[0], ins[1], name=n.output[0])
-
-
-@R("LessOrEqual")
-def _le(sd, n, ins):
-    return sd.op("less_equal", ins[0], ins[1], name=n.output[0])
+R("ReduceLogSumExp", _reduce("logsumexp"))
 
 
 @R("Resize")

@@ -9,7 +9,7 @@ from deeplearning4j_tpu.nn.layers import (  # noqa: F401
     OutputLayer, SeparableConvolution2DLayer, SubsamplingLayer,
     Upsampling2DLayer, ZeroPaddingLayer)
 from deeplearning4j_tpu.nn.recurrent import (  # noqa: F401
-    Bidirectional, GravesLSTM, LastTimeStep, LSTM, RnnLossLayer,
+    Bidirectional, GravesLSTM, GRU, LastTimeStep, LSTM, RnnLossLayer,
     RnnOutputLayer, SimpleRnn)
 from deeplearning4j_tpu.nn.attention import (  # noqa: F401
     LearnedSelfAttentionLayer, RecurrentAttentionLayer, SelfAttentionLayer)
@@ -42,7 +42,7 @@ _LAYER_CLASSES = [
     LayerNormalizationLayer, LocalResponseNormalizationLayer, LossLayer,
     OutputLayer, SeparableConvolution2DLayer, SubsamplingLayer,
     Upsampling2DLayer, ZeroPaddingLayer,
-    Bidirectional, GravesLSTM, LastTimeStep, LSTM, RnnLossLayer,
+    Bidirectional, GravesLSTM, GRU, LastTimeStep, LSTM, RnnLossLayer,
     RnnOutputLayer, SimpleRnn,
     LearnedSelfAttentionLayer, RecurrentAttentionLayer, SelfAttentionLayer,
     SpaceToDepthLayer, Yolo2OutputLayer,

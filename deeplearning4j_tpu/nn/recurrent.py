@@ -182,6 +182,57 @@ class LSTM(BaseRecurrentLayer):
 
 
 @dataclasses.dataclass(kw_only=True)
+class GRU(BaseRecurrentLayer):
+    """GRU, keras `reset_after=True` form (r gates the already-linear
+    recurrent term) — the same cell semantics as the registry `gru_cell`
+    and ONNX `linear_before_reset=1`.  Gate blocks ordered (r, z, n);
+    separate input/recurrent biases preserve exact keras numerics.
+    (Upstream DL4J has no GRU layer — this exceeds the reference.)"""
+
+    gate_activation: Any = "sigmoid"
+    REGULARIZABLE: Tuple[str, ...] = ("W", "RW")
+
+    def initialize(self, rng, input_type, dtype=jnp.float32):
+        n_in, H = self._in_size(input_type), self.n_out
+        k1, k2 = jax.random.split(rng)
+        params = {
+            "W": init_weights(k1, (n_in, 3 * H), self.winit(), dtype),
+            "RW": init_weights(k2, (H, 3 * H), self.winit(), dtype),
+            "b": jnp.full((3 * H,), self.bias_init, dtype),
+            "rb": jnp.zeros((3 * H,), dtype),
+        }
+        return params, {}, self._out_type(input_type)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_input_dropout(x, train, rng)
+        H = self.n_out
+        act = self.act_fn("tanh")
+        gate = get_activation(self.gate_activation)
+        xp = x @ params["W"] + params["b"]          # [B,T,3H] one matmul
+        m = _mask_bt(mask, x[..., :1])
+
+        def cell(h, inp):
+            xt, mt = inp
+            gh = h @ params["RW"] + params["rb"]
+            r = gate(xt[..., :H] + gh[..., :H])
+            z = gate(xt[..., H:2 * H] + gh[..., H:2 * H])
+            n = act(xt[..., 2 * H:] + r * gh[..., 2 * H:])
+            h_new = (1 - z) * n + z * h
+            if mt is not None:
+                h_new = jnp.where(mt, h_new, h)
+            return h_new, h_new
+
+        h0 = jnp.zeros((x.shape[0], H), xp.dtype)
+        xs = (jnp.swapaxes(xp, 0, 1),
+              None if m is None else jnp.swapaxes(m, 0, 1))
+        _, hs = lax.scan(cell, h0, xs)
+        out = jnp.swapaxes(hs, 0, 1)
+        if m is not None:
+            out = out * m.astype(out.dtype)
+        return out, state
+
+
+@dataclasses.dataclass(kw_only=True)
 class GravesLSTM(LSTM):
     """LSTM with peephole connections (reference `GravesLSTM.java`, Graves
     2013 formulation)."""

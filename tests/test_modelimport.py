@@ -843,3 +843,35 @@ def test_tf_saved_model_multi_output_op_signature(tmp_path):
     for key, val in want.items():
         assert any(v.shape == val.shape and np.allclose(v, val, atol=1e-6)
                    for v in got_vals), f"signature output {key} not matched"
+
+
+def test_sequential_gru_import(tmp_path):
+    """Keras GRU (reset_after=True default) -> our GRU layer; stacked
+    seq->seq then seq->last, predictions must match keras.  (Upstream
+    DL4J has no GRU layer — exceeds-reference coverage.)"""
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((7, 5)),
+        tf.keras.layers.GRU(12, return_sequences=True),
+        tf.keras.layers.GRU(6),                     # last step only
+        tf.keras.layers.Dense(2, activation="softmax")])
+    p = _save(km, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    x = np.random.RandomState(3).randn(4, 7, 5).astype(np.float32)
+    expected = km.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-4)
+
+
+def test_keras_bidirectional_gru_import(tmp_path):
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((6, 4)),
+        tf.keras.layers.Bidirectional(
+            tf.keras.layers.GRU(5, return_sequences=True)),
+        tf.keras.layers.GlobalAveragePooling1D(),
+        tf.keras.layers.Dense(3, activation="softmax")])
+    p = _save(km, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    x = np.random.RandomState(8).randn(5, 6, 4).astype(np.float32)
+    expected = km.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-4)
