@@ -125,11 +125,14 @@ def test_functional_concat_import(tmp_path):
 
 
 def test_unsupported_layer_named_error(tmp_path):
+    # ConvLSTM2D has no converter; the error must NAME the layer class
+    # (GRU formerly played this role — it imports now)
     km = tf.keras.Sequential([
-        tf.keras.layers.Input((4, 4)),
-        tf.keras.layers.GRU(3)])
+        tf.keras.layers.Input((4, 6, 6, 2)),
+        tf.keras.layers.ConvLSTM2D(3, kernel_size=3)])
     p = _save(km, tmp_path)
-    with pytest.raises(UnsupportedKerasConfigurationException, match="GRU"):
+    with pytest.raises(UnsupportedKerasConfigurationException,
+                       match="ConvLSTM2D"):
         KerasModelImport.import_keras_sequential_model_and_weights(p)
 
 
