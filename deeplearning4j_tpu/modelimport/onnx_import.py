@@ -99,7 +99,9 @@ for onnx_op, our in [("Relu", "relu"), ("Sigmoid", "sigmoid"),
                      ("Round", "round"), ("Sign", "sign"),
                      ("Softplus", "softplus"), ("Softsign", "softsign"),
                      ("Identity", "identity"), ("Sin", "sin"),
-                     ("Cos", "cos"), ("Not", "logical_not")]:
+                     ("Cos", "cos"), ("Tan", "tan"), ("Asin", "asin"),
+                     ("Acos", "acos"), ("Atan", "atan"), ("Sinh", "sinh"),
+                     ("Cosh", "cosh"), ("Not", "logical_not")]:
     R(onnx_op, (lambda our: lambda sd, n, ins:
                 sd.op(our, ins[0], name=n.output[0]))(our))
 
@@ -615,6 +617,34 @@ def _one_hot(sd, n, ins):
     idx = sd.op("where", neg, idx + depth, idx)
     oh = sd.op("one_hot", idx, depth=depth)
     return sd.rename((oh * (on - off) + off).name, n.output[0])
+
+
+@R("ScatterND")
+def _scatter_nd(sd, n, ins):
+    red = _astr(n, "reduction", "none")
+    op = {"none": "scatter_nd_update", "add": "scatter_nd_add"}.get(red)
+    if op is None:
+        raise UnmappedOnnxOpException(
+            f"ScatterND '{n.name}': reduction={red} unsupported")
+    return sd.op(op, ins[0], ins[1], ins[2], name=n.output[0])
+
+
+@R("ArgMin")
+def _argmin(sd, n, ins):
+    v = sd.op("argmin", ins[0], axis=_ai(n, "axis", 0))
+    if _ai(n, "keepdims", 1):
+        v = sd.op("expand_dims", v, axis=_ai(n, "axis", 0))
+    return sd.rename(v.name, n.output[0])
+
+
+@R("ReduceSumSquare")
+def _reduce_ss(sd, n, ins):
+    axes = _aints(n, "axes")
+    if len(ins) > 1 and ins[1] is not None:
+        axes = _const_ints(ins[1])
+    sq = sd.op("mul", ins[0], ins[0])
+    return sd.op("sum", sq, axis=None if axes is None else tuple(axes),
+                 keepdims=bool(_ai(n, "keepdims", 1)), name=n.output[0])
 
 
 @R("Einsum")

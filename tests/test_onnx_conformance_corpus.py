@@ -455,6 +455,31 @@ case("greater-less-or-equal",
      lambda a, b: ((a >= b) & (a <= b)).astype(np.float32))
 
 
+
+case("scatternd-argmin-trig",
+     [_N("ScatterND", ["x", "si", "u"], ["s"]),
+      _N("Atan", ["s"], ["t"]),
+      _N("ReduceSumSquare", ["t"], ["r"], attr_ints("axes", [1]),
+         attr_i("keepdims", 0)),
+      _N("ArgMin", ["r"], ["am"], attr_i("axis", 0), attr_i("keepdims", 0)),
+      _N("Cast", ["am"], ["y"], attr_i("to", 1))],
+     {"x": F(4, 6)},
+     {"si": np.asarray([[1], [3]], np.int64), "u": F(2, 6)},
+     None)  # golden computed below
+
+
+def _scatternd_golden(x):
+    s = x.copy()
+    u = CORPUS[-1][3]["u"]
+    s[1], s[3] = u[0], u[1]
+    t = np.arctan(s)
+    r = (t * t).sum(1)
+    return np.float32(np.argmin(r))
+
+
+CORPUS[-1] = CORPUS[-1][:4] + (_scatternd_golden, CORPUS[-1][5])
+
+
 @pytest.mark.parametrize(
     "name,nodes,inputs,inits,golden,tol", CORPUS,
     ids=[c[0] for c in CORPUS])
