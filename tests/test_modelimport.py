@@ -878,3 +878,20 @@ def test_keras_bidirectional_gru_import(tmp_path):
     expected = km.predict(x, verbose=0)
     got = np.asarray(net.output(x))
     np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-4)
+
+
+def test_keras3_container_gru_import(tmp_path):
+    """GRU through the Keras 3 `.keras` zip path (positional-vars weight
+    resolution), stacked + Bidirectional."""
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((7, 5)),
+        tf.keras.layers.GRU(6, return_sequences=True),
+        tf.keras.layers.Bidirectional(tf.keras.layers.GRU(4)),
+        tf.keras.layers.Dense(2, activation="softmax")])
+    p = str(tmp_path / "m.keras")
+    km.save(p)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    x = np.random.RandomState(1).randn(3, 7, 5).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               km.predict(x, verbose=0), rtol=1e-3,
+                               atol=1e-4)
