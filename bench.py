@@ -8,6 +8,12 @@ nd4j-cuda/V100-class ResNet-50 training throughput of ~400 samples/sec/GPU
 
 Extra per-config results (LeNet, LSTM char-LM) go to stderr so the stdout
 contract stays one line.  Run: `python bench.py [--quick]`.
+
+`python bench.py --serving [--quick]` instead benchmarks the
+`deeplearning4j_tpu.serving` runtime (closed-loop concurrent clients
+against a warmed ModelServer): p50/p99 latency, throughput and batch
+occupancy go to stderr; stdout still carries exactly one JSON line (the
+serving headline).
 """
 import json
 import sys
@@ -319,6 +325,87 @@ def bench_lstm_charlm(batch=64, steps=10, t=64, vocab=77, fused_steps=5):
     return batch * t * steps / dt
 
 
+def bench_serving(duration_s=3.0, n_clients=16, max_batch=64,
+                  batch_timeout_ms=2.0):
+    """Closed-loop serving benchmark: `n_clients` threads drive mixed-size
+    requests through a warmed `serving.ModelServer` (zoo LeNet) for
+    `duration_s`.  Returns the SLO summary: requests/sec, rows/sec,
+    latency percentiles, batch occupancy, compile-cache stats."""
+    from concurrent.futures import ThreadPoolExecutor
+    from deeplearning4j_tpu.serving import ModelServer
+
+    srv = ModelServer(max_batch=max_batch, batch_timeout_ms=batch_timeout_ms,
+                      max_queue=4096)
+    srv.deploy("lenet", zoo="LeNet", warmup=True)
+    sizes = (1, 2, 3, 4, 8)
+
+    def client(i):
+        rs = np.random.RandomState(i)
+        reqs = rows = 0
+        end = time.monotonic() + duration_s
+        while time.monotonic() < end:
+            n = sizes[reqs % len(sizes)]
+            x = rs.rand(n, 28, 28, 1).astype(np.float32)
+            srv.output("lenet", x, timeout=60)
+            reqs += 1
+            rows += n
+        return reqs, rows
+
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(n_clients) as ex:
+        totals = list(ex.map(client, range(n_clients)))
+    dt = time.perf_counter() - t0
+    snap = srv.stats()
+    srv.shutdown()
+    reqs = sum(r for r, _ in totals)
+    rows = sum(r for _, r in totals)
+    lat = snap["latency_ms"]
+    return {
+        "requests_per_sec": reqs / dt,
+        "rows_per_sec": rows / dt,
+        "p50_ms": lat["p50"], "p95_ms": lat["p95"], "p99_ms": lat["p99"],
+        "batch_occupancy": snap["batch_occupancy"],
+        "padding_fraction": snap["padding_fraction"],
+        "compile_cache": snap["compile_cache"],
+        "dispatches": snap["dispatches"],
+        "clients": n_clients, "duration_s": dt,
+    }
+
+
+def main_serving(quick: bool):
+    """`--serving` mode: serving metrics to stderr, ONE stdout JSON line."""
+    import os
+    if not os.environ.get("JAX_PLATFORMS"):
+        # probe the TPU backend once (it can hang, not raise — see
+        # _wait_for_backend); fall back to CPU rather than block: the
+        # serving runtime is backend-agnostic
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from __graft_entry__ import _probe_backend_device_count
+        if _probe_backend_device_count() < 1:
+            print("[bench] TPU backend unreachable; serving bench on CPU",
+                  file=sys.stderr, flush=True)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = bench_serving(duration_s=1.0 if quick else 3.0,
+                          n_clients=8 if quick else 16)
+    except Exception as e:
+        print(json.dumps({"metric": "serving_lenet_requests_per_sec",
+                          "value": None, "unit": "requests/sec",
+                          "error": repr(e)[:300]}))
+        sys.exit(1)
+    for k, v in r.items():      # detail to stderr: stdout stays one line
+        print(f"[serving] {k} = {v}", file=sys.stderr, flush=True)
+    print(json.dumps({
+        "metric": "serving_lenet_requests_per_sec",
+        "value": round(r["requests_per_sec"], 1),
+        "unit": "requests/sec",
+        "p50_ms": round(r["p50_ms"], 2),
+        "p99_ms": round(r["p99_ms"], 2),
+        "rows_per_sec": round(r["rows_per_sec"], 1),
+        "batch_occupancy": round(r["batch_occupancy"], 2),
+    }))
+
+
 def _wait_for_backend(max_wait_s=1800.0, retry_every_s=120.0):
     """Bounded probe-retry for the TPU backend.
 
@@ -396,6 +483,9 @@ def _wait_for_backend(max_wait_s=1800.0, retry_every_s=120.0):
 
 def main():
     quick = "--quick" in sys.argv
+    if "--serving" in sys.argv:
+        main_serving(quick)
+        return
     n_chips = _wait_for_backend()
     if n_chips == 0:
         sys.exit(1)

@@ -310,8 +310,15 @@ class ParallelInference:
 
     def _run(self, x: np.ndarray):
         n = self.mesh.shape[self.data_axis]
-        pad = (-x.shape[0]) % n
-        padded = np.concatenate([x, np.repeat(x[-1:], pad, 0)]) if pad else x
+        if x.shape[0] == 0:
+            # zero-row request: repeat-padding from x[-1:] has no row to
+            # repeat, so pad with zeros up to one full DP round and slice
+            # everything off (still yields the correct trailing dims)
+            padded = np.zeros((n,) + x.shape[1:], x.dtype)
+        else:
+            pad = (-x.shape[0]) % n
+            padded = np.concatenate([x, np.repeat(x[-1:], pad, 0)]) \
+                if pad else x
         xs = _shard_batch(padded, self.mesh, self.data_axis)
         with self.mesh:
             out = self.model.output(xs)
@@ -320,6 +327,18 @@ class ParallelInference:
         return out[: x.shape[0]]
 
     def _output_batched(self, requests: List[np.ndarray]) -> List[np.ndarray]:
+        if not requests:
+            return []
+        requests = [np.asarray(r) for r in requests]
+        trailing = requests[0].shape[1:]
+        for i, r in enumerate(requests[1:], 1):
+            if r.shape[1:] != trailing:
+                raise ValueError(
+                    f"heterogeneous request shapes: request 0 has trailing "
+                    f"dims {trailing} but request {i} has {r.shape[1:]}; "
+                    "ParallelInference batches same-shape requests only — "
+                    "serving.ModelServer routes mixed shapes to per-shape "
+                    "buckets")
         sizes = [r.shape[0] for r in requests]
         merged = np.concatenate(requests, axis=0)
         out = np.asarray(self._run(merged))
@@ -331,93 +350,42 @@ class ParallelInference:
 
 
 class DynamicBatchingInference:
-    """Concurrent-request dynamic batching over `ParallelInference`
-    (reference `ParallelInference.ObservablesProvider`: requests queue up
-    and are dispatched together once `max_batch` examples accumulate or
-    `timeout_ms` elapses — amortizing dispatch overhead for many small
-    concurrent clients).
+    """DEPRECATED — use `deeplearning4j_tpu.serving.ModelServer`, which
+    adds shape buckets with an AOT compile cache, per-request deadlines,
+    priority, bounded-queue load shedding and SLO metrics.
 
+    Kept as a thin compatibility wrapper over the serving runtime's
+    `ContinuousBatcher` (ONE batching implementation in the codebase):
     `submit(x)` returns a `concurrent.futures.Future`; `output(x)` is the
-    blocking convenience form.  One daemon worker thread aggregates and
-    runs the sharded forward; results are split back per request."""
+    blocking convenience form.  Requests are grouped by trailing dims, so
+    mixed-shape traffic no longer crashes the concatenate."""
 
     def __init__(self, inference: "ParallelInference", max_batch: int = 32,
                  timeout_ms: float = 10.0):
-        import queue
-        import threading
+        import warnings
+        warnings.warn(
+            "DynamicBatchingInference is deprecated; use "
+            "deeplearning4j_tpu.serving.ModelServer (bucketed AOT compile "
+            "cache, deadlines, backpressure, SLO metrics)",
+            DeprecationWarning, stacklevel=2)
+        # local import: serving composes on top of parallel, so the
+        # top-level serving package must not be imported at wrapper
+        # import time
+        from deeplearning4j_tpu.serving.batcher import ContinuousBatcher
         self.inference = inference
         self.max_batch = int(max_batch)
-        self.timeout = float(timeout_ms) / 1000.0
-        self._q: "queue.Queue" = queue.Queue()
-        self._stop = False
-        self._worker = threading.Thread(target=self._loop, daemon=True)
-        self._worker.start()
+        self._batcher = ContinuousBatcher(
+            lambda group, xs: inference._output_batched(xs),
+            max_batch=max_batch, batch_timeout_ms=timeout_ms)
 
     def submit(self, x: np.ndarray):
-        from concurrent.futures import Future
-        if self._stop:
-            raise RuntimeError("DynamicBatchingInference is shut down")
-        fut: Future = Future()
-        self._q.put((np.asarray(x), fut))
-        return fut
+        x = np.asarray(x)
+        return self._batcher.submit(
+            x, group=(tuple(x.shape[1:]), str(x.dtype)))
 
     def output(self, x: np.ndarray) -> np.ndarray:
         return self.submit(x).result()
 
     def shutdown(self):
-        import queue
-        self._stop = True
-        self._q.put(None)                     # wake the worker
-        self._worker.join(timeout=5.0)
-        # fail anything still queued so no caller blocks forever on a
-        # Future the worker will never resolve
-        while True:
-            try:
-                item = self._q.get_nowait()
-            except queue.Empty:
-                break
-            if item is not None:
-                item[1].set_exception(
-                    RuntimeError("DynamicBatchingInference shut down "
-                                 "before this request was dispatched"))
-
-    def _collect(self) -> List:
-        """Block for the first request, then keep aggregating until the
-        batch budget is met or the timeout window closes."""
-        import queue
-        import time
-        first = self._q.get()
-        if first is None:
-            return []
-        batch = [first]
-        total = first[0].shape[0]
-        deadline = time.monotonic() + self.timeout
-        while total < self.max_batch:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                break
-            try:
-                item = self._q.get(timeout=remaining)
-            except queue.Empty:
-                break
-            if item is None:
-                break
-            batch.append(item)
-            total += item[0].shape[0]
-        return batch
-
-    def _loop(self):
-        while not self._stop:
-            batch = self._collect()
-            if not batch:
-                continue
-            xs = [x for x, _ in batch]
-            futs = [f for _, f in batch]
-            try:
-                outs = self.inference._output_batched(xs)
-            except Exception as e:            # propagate to every waiter
-                for f in futs:
-                    f.set_exception(e)
-                continue
-            for f, o in zip(futs, outs):
-                f.set_result(o)
+        """Graceful and idempotent: drains queued requests, then stops."""
+        self._batcher.shutdown(drain=True, timeout=10.0)

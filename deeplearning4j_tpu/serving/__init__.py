@@ -1,0 +1,24 @@
+"""Production model-serving runtime (docs/serving.md).
+
+Reference analog: `ParallelInference` + ObservablesProvider and the
+model-server deployments around it; compile-amortization design per TVM's
+AOT compiled-executable serving model (PAPERS.md).
+
+    registry        — named, versioned models (direct / zoo / Keras / ONNX)
+    compile_cache   — power-of-two shape buckets, one AOT-compiled
+                      executable per (model, bucket), warmed up front
+    batcher         — continuous batching with deadlines, priority and
+                      bounded-queue load shedding
+    server          — ModelServer front door (submit/output/output_async,
+                      graceful draining shutdown)
+    metrics         — p50/p95/p99 latency, queue depth, batch occupancy,
+                      compile-cache hit rate (UI: /serving endpoint)
+"""
+from deeplearning4j_tpu.serving.batcher import (  # noqa: F401
+    ContinuousBatcher, DeadlineExceededError, RejectedError)
+from deeplearning4j_tpu.serving.compile_cache import (  # noqa: F401
+    BucketedCompileCache, bucket_for, bucket_sizes)
+from deeplearning4j_tpu.serving.metrics import ServingMetrics  # noqa: F401
+from deeplearning4j_tpu.serving.registry import (  # noqa: F401
+    ModelEntry, ModelRegistry)
+from deeplearning4j_tpu.serving.server import ModelServer  # noqa: F401

@@ -1,0 +1,208 @@
+"""Shape-bucketed ahead-of-time compile cache for serving.
+
+XLA compiles one executable per input shape.  A serving workload sees an
+unbounded set of request batch sizes, so compiling per exact size would
+turn every new size into a multi-second compile stall — the worst possible
+tail-latency event.  Instead each dispatch is padded up to a power-of-two
+**bucket** and one executable is AOT-compiled per (model, bucket,
+trailing-shape, dtype) via `jax.jit(...).lower(...).compile()` — the
+TVM-style compiled-artifact serving model (PAPERS.md, arXiv 1802.04799):
+the whole forward pass is one pre-compiled artifact, never a tracing JIT
+on the request path.  With `max_batch` B there are only
+`log2(B) - log2(min_bucket) + 1` executables per model ever, all of which
+the registry can warm before traffic arrives.
+
+Padding rows are zeros and are sliced off after the forward — transparent
+to callers because inference forwards are row-independent.  Hit/miss
+counters (`utils.counters.HitMissCounters`) make the compile behaviour
+observable and testable.
+
+With a `Mesh`, inputs are sharded over the data axis before execution
+(SPMD sharded serving, same data path as `ParallelInference`); the
+minimum bucket is then clamped to the data-parallel degree so every
+bucket divides evenly across devices.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.utils.counters import HitMissCounters
+
+
+def bucket_sizes(max_batch: int, min_bucket: int = 1) -> List[int]:
+    """The power-of-two bucket ladder [min_bucket, ..., >= max_batch]."""
+    if min_bucket < 1 or max_batch < 1:
+        raise ValueError("min_bucket and max_batch must be >= 1")
+    b, out = 1, []
+    while b < min_bucket:
+        b *= 2
+    while True:
+        out.append(b)
+        if b >= max_batch:
+            return out
+        b *= 2
+
+
+def bucket_for(n: int, max_batch: int, min_bucket: int = 1) -> int:
+    """Smallest power-of-two bucket >= n (>= min_bucket).  n above the
+    top bucket is the caller's bug — the batcher caps dispatches at
+    max_batch rows."""
+    if n < 1:
+        raise ValueError(f"cannot bucket a {n}-row dispatch")
+    b = min_bucket if min_bucket >= 1 else 1
+    while b & (b - 1):           # round min_bucket itself up to a pow2
+        b += 1
+    while b < n:
+        b *= 2
+    return b
+
+
+def _forward_fn(model) -> Callable:
+    """Pure (params, state, x) -> output forward for the model kinds the
+    registry serves.  MultiLayerNetwork returns its head output;
+    single-input ComputationGraph returns its first network output."""
+    if hasattr(model, "_as_input_dict"):          # ComputationGraph
+        names = list(model.conf.network_inputs)
+        if len(names) != 1:
+            raise ValueError(
+                f"serving compile cache handles single-input graphs; "
+                f"this one has inputs {names}")
+        out = model.conf.network_outputs[0]
+
+        def fwd(p, s, xv):
+            acts, _ = model._forward(p, s, {names[0]: xv}, train=False,
+                                     rng=None)
+            return acts[out]
+        return fwd
+
+    def fwd(p, s, xv):
+        return model._forward(p, s, xv, train=False, rng=None)[0]
+    return fwd
+
+
+class BucketedCompileCache:
+    """One AOT-compiled executable per (model, bucket, trailing dims,
+    dtype); `run(entry, x)` pads x to its bucket, executes, slices back."""
+
+    def __init__(self, max_batch: int = 64, min_bucket: int = 1,
+                 mesh=None, data_axis: str = "data",
+                 counters: Optional[HitMissCounters] = None):
+        import jax  # local: keep module import light
+
+        self._jax = jax
+        self.mesh = mesh
+        self.data_axis = data_axis
+        if mesh is not None:
+            min_bucket = max(min_bucket, mesh.shape[data_axis])
+        self.max_batch = int(max_batch)
+        self.min_bucket = int(min_bucket)
+        self.buckets = bucket_sizes(self.max_batch, self.min_bucket)
+        self.counters = counters if counters is not None \
+            else HitMissCounters("compile_cache")
+        self._compiled: Dict[Tuple, Callable] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.buckets)
+
+    def bucket_for(self, n: int) -> int:
+        return bucket_for(n, self.max_batch, self.min_bucket)
+
+    # ---- placement ----
+    def _x_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P(self.data_axis))
+
+    def _place_model(self, model) -> None:
+        """Replicate params/state over the mesh once (idempotent — device_put
+        of an already-placed array is a no-op placement-wise)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        repl = NamedSharding(self.mesh, P())
+        model.params_ = self._jax.device_put(model.params_, repl)
+        model.state_ = self._jax.device_put(model.state_, repl)
+
+    def _place_input(self, x: np.ndarray):
+        import jax.numpy as jnp
+        if self.mesh is None:
+            return jnp.asarray(x)
+        return self._jax.device_put(x, self._x_sharding())
+
+    # ---- compile ----
+    def _compile(self, model, bucket: int, trailing: Tuple[int, ...],
+                 dtype) -> Callable:
+        """AOT path: lower the jitted forward against a concrete example of
+        the bucket's exact shape (carrying its sharding), compile once, and
+        return the bare executable — no tracing ever happens on the request
+        path again for this bucket."""
+        if self.mesh is not None:
+            self._place_model(model)
+        fwd = _forward_fn(model)
+        example = self._place_input(
+            np.zeros((bucket,) + tuple(trailing), dtype))
+        return self._jax.jit(fwd).lower(
+            model.params_, model.state_, example).compile()
+
+    def executable(self, key: str, model, bucket: int,
+                   trailing: Tuple[int, ...], dtype) -> Callable:
+        """The compiled executable for (key, bucket, trailing, dtype),
+        compiling on first use.  `key` identifies the model+version (params
+        identity is the caller's contract: hot-swapping weights in place
+        requires a new key or an `invalidate`)."""
+        ck = (key, int(bucket), tuple(trailing), np.dtype(dtype).str)
+        with self._lock:
+            fn = self._compiled.get(ck)
+            if fn is not None:
+                self.counters.hit()
+                return fn
+            # compile under the lock: two racing requests for the same new
+            # bucket must cost ONE compile, not two
+            self.counters.miss()
+            fn = self._compile(model, bucket, trailing, dtype)
+            self._compiled[ck] = fn
+            return fn
+
+    def invalidate(self, key: Optional[str] = None) -> None:
+        """Drop cached executables (all, or one model's)."""
+        with self._lock:
+            if key is None:
+                self._compiled.clear()
+            else:
+                self._compiled = {k: v for k, v in self._compiled.items()
+                                  if k[0] != key}
+
+    # ---- execute ----
+    def run(self, key: str, model, x: np.ndarray) -> np.ndarray:
+        """Pad `x` up to its bucket, run the (possibly freshly compiled)
+        executable, slice the real rows back."""
+        x = np.asarray(x)
+        n = x.shape[0]
+        if n == 0:
+            raise ValueError("cannot dispatch an empty batch")
+        if n > self.max_batch:
+            raise ValueError(
+                f"dispatch of {n} rows exceeds max_batch={self.max_batch}")
+        bucket = self.bucket_for(n)
+        fn = self.executable(key, model, bucket, x.shape[1:], x.dtype)
+        if bucket != n:
+            pad = np.zeros((bucket - n,) + x.shape[1:], x.dtype)
+            x = np.concatenate([x, pad], axis=0)
+        out = fn(model.params_, model.state_, self._place_input(x))
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        return np.asarray(out)[:n]
+
+    def warmup(self, key: str, model, trailing: Tuple[int, ...],
+               dtype=np.float32,
+               buckets: Optional[List[int]] = None) -> List[int]:
+        """Pre-compile (and execute once, forcing any lazy backend init)
+        every bucket for a model — pay all compile stalls before traffic.
+        Returns the warmed bucket list."""
+        warmed = []
+        for b in (buckets if buckets is not None else self.buckets):
+            self.run(key, model, np.zeros((b,) + tuple(trailing), dtype))
+            warmed.append(b)
+        return warmed

@@ -1,0 +1,164 @@
+"""SLO metrics for the serving runtime.
+
+What an operator needs to hold a latency SLO on a batched-inference
+service: end-to-end request latency percentiles (p50/p95/p99 — the queue
+wait is part of the product, so latency is measured enqueue→result, not
+just device time), queue depth (is admission control about to engage?),
+batch occupancy (is the continuous batcher actually amortizing dispatches,
+or serving one request per XLA call?), padding overhead (bucket waste),
+and compile-cache hit/miss (a miss is a multi-second XLA compile — the
+single worst tail-latency event in the system, which is why the registry
+warms buckets up front).
+
+Everything is host-side and thread-safe; recording is O(1) per event so
+the batcher's dispatch loop never blocks on metrics.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu.utils.counters import HitMissCounters, StatCounter
+
+
+def _percentile(sorted_vals: List[float], p: float) -> float:
+    """Nearest-rank percentile over an already-sorted sample list."""
+    if not sorted_vals:
+        return float("nan")
+    k = max(0, min(len(sorted_vals) - 1,
+                   int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+class LatencyWindow:
+    """Sliding-window latency sample (last `maxlen` requests) plus
+    lifetime count/total.  A bounded window keeps percentile cost and
+    memory flat under sustained traffic; lifetime aggregates survive the
+    window for throughput accounting."""
+
+    def __init__(self, maxlen: int = 4096):
+        self._samples: deque = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+        self.count = 0
+        self.total_ms = 0.0
+        self.max_ms = 0.0
+
+    def record(self, ms: float) -> None:
+        with self._lock:
+            self._samples.append(ms)
+            self.count += 1
+            self.total_ms += ms
+            if ms > self.max_ms:
+                self.max_ms = ms
+
+    def percentiles(self, ps=(50, 95, 99)) -> Dict[str, float]:
+        with self._lock:
+            s = sorted(self._samples)
+        return {f"p{p}": _percentile(s, p) for p in ps}
+
+    def snapshot(self) -> Dict[str, float]:
+        out = self.percentiles()
+        with self._lock:
+            out["count"] = self.count
+            out["mean"] = self.total_ms / self.count if self.count else 0.0
+            out["max"] = self.max_ms
+        return out
+
+
+class ServingMetrics:
+    """One metrics hub shared by batcher + compile cache + server.
+
+    Exposed through `snapshot()` (a plain JSON-able dict), the UI server's
+    `/serving` endpoint, and `ui.stats.render_serving_html`.
+    """
+
+    def __init__(self, window: int = 4096):
+        self._lock = threading.Lock()
+        self.latency = LatencyWindow(window)          # enqueue -> result, ms
+        self.dispatch_latency = LatencyWindow(window)  # device dispatch, ms
+        self.cache = HitMissCounters("compile_cache")
+        self.submitted = StatCounter("submitted")
+        self.rejected = StatCounter("rejected")        # load-shed (queue full)
+        self.expired = StatCounter("expired")          # deadline passed
+        self.failed = StatCounter("failed")            # dispatch raised
+        self.completed = StatCounter("completed")
+        self.dispatches = StatCounter("dispatches")
+        # dispatch-shape aggregates (occupancy / padding accounting)
+        self._requests_dispatched = 0
+        self._rows_dispatched = 0
+        self._rows_padded = 0
+        self._queue_depth = 0
+        self._queue_depth_peak = 0
+
+    # ---- recording hooks (called by batcher / cache / server) ----
+    def record_submit(self, queue_depth: int) -> None:
+        self.submitted.inc()
+        with self._lock:
+            self._queue_depth = queue_depth
+            if queue_depth > self._queue_depth_peak:
+                self._queue_depth_peak = queue_depth
+
+    def record_queue_depth(self, queue_depth: int) -> None:
+        with self._lock:
+            self._queue_depth = queue_depth
+
+    def record_dispatch(self, n_requests: int, rows: int,
+                        padded_rows: int = 0,
+                        dispatch_ms: Optional[float] = None) -> None:
+        self.dispatches.inc()
+        self.completed.inc(n_requests)
+        with self._lock:
+            self._requests_dispatched += n_requests
+            self._rows_dispatched += rows
+            self._rows_padded += padded_rows
+        if dispatch_ms is not None:
+            self.dispatch_latency.record(dispatch_ms)
+
+    def record_latency(self, ms: float) -> None:
+        self.latency.record(ms)
+
+    def record_padding(self, rows: int) -> None:
+        with self._lock:
+            self._rows_padded += rows
+
+    # ---- derived views ----
+    @property
+    def mean_batch_occupancy(self) -> float:
+        """Requests per device dispatch — > 1 means batching is working."""
+        with self._lock:
+            d = self.dispatches.value
+            return self._requests_dispatched / d if d else 0.0
+
+    @property
+    def padding_fraction(self) -> float:
+        """Fraction of dispatched rows that were bucket padding."""
+        with self._lock:
+            total = self._rows_dispatched + self._rows_padded
+            return self._rows_padded / total if total else 0.0
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            requests_dispatched = self._requests_dispatched
+            rows = self._rows_dispatched
+            padded = self._rows_padded
+            depth = self._queue_depth
+            peak = self._queue_depth_peak
+        d = self.dispatches.value
+        return {
+            "latency_ms": self.latency.snapshot(),
+            "dispatch_ms": self.dispatch_latency.snapshot(),
+            "queue_depth": depth,
+            "queue_depth_peak": peak,
+            "submitted": self.submitted.value,
+            "completed": self.completed.value,
+            "rejected": self.rejected.value,
+            "expired": self.expired.value,
+            "failed": self.failed.value,
+            "dispatches": d,
+            "batch_occupancy": requests_dispatched / d if d else 0.0,
+            "rows_dispatched": rows,
+            "padding_fraction": (padded / (rows + padded)
+                                 if rows + padded else 0.0),
+            "compile_cache": self.cache.snapshot(),
+        }

@@ -1,0 +1,180 @@
+"""Model registry: named, versioned model instances for serving.
+
+Reference analog: the DL4J model-server deployments around
+`ParallelInference` keep a catalog of loaded models and route requests by
+name; `ZooModel.initPretrained` is the load path.  Here the registry is
+the single place a `ModelServer` resolves (name, version) → model, with
+loaders for every import surface the framework has:
+
+* `register(name, model)`         — an already-built MultiLayerNetwork /
+                                    ComputationGraph (or anything with
+                                    `params_`/`state_`/`_forward`)
+* `register_zoo(name, "LeNet")`   — build from the zoo catalog
+* `register_keras(name, path)`    — Keras H5 / .keras import
+* `register_onnx(name, path)`     — ONNX import (SameDiff-backed)
+
+Versions are integers; `get(name)` returns the highest version, so a
+re-registration under the same name is a zero-downtime model roll:
+in-flight requests finish on the old version (their entry is resolved at
+submit time), new submits pick up the new one.  Per-model warmup drives
+the bucketed compile cache through every bucket before traffic arrives.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class ModelEntry:
+    """One (name, version) deployment unit."""
+
+    name: str
+    version: int
+    model: Any
+    source: str = "direct"              # direct | zoo | keras | onnx
+    input_shape: Optional[Tuple[int, ...]] = None   # trailing dims (no batch)
+    input_dtype: str = "float32"
+    registered_at: float = 0.0
+    warmed_buckets: List[int] = dataclasses.field(default_factory=list)
+
+    @property
+    def key(self) -> str:
+        """Stable cache/grouping key for this deployment unit."""
+        return f"{self.name}:v{self.version}"
+
+
+def infer_input_shape(model) -> Optional[Tuple[int, ...]]:
+    """Trailing input dims (without batch) from the model's configured
+    InputType, for warmup.  None when unknown (dynamic seq length,
+    multi-input graph, imported graph without a recorded input type)."""
+    conf = getattr(model, "conf", None)
+    it = getattr(conf, "input_type", None)
+    if it is None:
+        its = getattr(conf, "input_types", None)   # graph: {name: InputType}
+        if its and len(its) == 1:
+            it = next(iter(its.values())) if isinstance(its, dict) \
+                else its[0]
+    if it is None or any(s is None for s in it.shape):
+        return None
+    return tuple(int(s) for s in it.shape)
+
+
+class ModelRegistry:
+    """Thread-safe name → {version → ModelEntry} catalog."""
+
+    def __init__(self):
+        self._models: Dict[str, Dict[int, ModelEntry]] = {}
+        self._lock = threading.Lock()
+
+    # ---- registration ----
+    def register(self, name: str, model, version: Optional[int] = None,
+                 source: str = "direct",
+                 input_shape: Optional[Tuple[int, ...]] = None,
+                 input_dtype: str = "float32") -> ModelEntry:
+        with self._lock:
+            versions = self._models.setdefault(name, {})
+            if version is None:
+                version = max(versions) + 1 if versions else 1
+            elif version in versions:
+                raise ValueError(
+                    f"model '{name}' version {version} already registered; "
+                    "omit version to auto-increment")
+            entry = ModelEntry(
+                name=name, version=int(version), model=model, source=source,
+                input_shape=(tuple(input_shape) if input_shape is not None
+                             else infer_input_shape(model)),
+                input_dtype=input_dtype, registered_at=time.time())
+            versions[entry.version] = entry
+            return entry
+
+    def register_zoo(self, name: str, zoo_name: Optional[str] = None,
+                     version: Optional[int] = None,
+                     **zoo_kwargs) -> ModelEntry:
+        """Build a zoo architecture (`zoo.ZOO_REGISTRY`) and register it."""
+        from deeplearning4j_tpu.zoo import ZOO_REGISTRY
+        zn = zoo_name or name
+        if zn not in ZOO_REGISTRY:
+            raise KeyError(
+                f"unknown zoo model '{zn}'; available: "
+                f"{sorted(ZOO_REGISTRY)}")
+        z = ZOO_REGISTRY[zn](**zoo_kwargs)
+        return self.register(name, z.init_model(), version=version,
+                             source="zoo")
+
+    def register_keras(self, name: str, path: str,
+                       version: Optional[int] = None,
+                       functional: bool = False) -> ModelEntry:
+        """Import a Keras model file and register the result."""
+        from deeplearning4j_tpu.modelimport import KerasModelImport
+        if functional:
+            model = KerasModelImport.import_keras_model_and_weights(path)
+        else:
+            model = KerasModelImport.\
+                import_keras_sequential_model_and_weights(path)
+        return self.register(name, model, version=version, source="keras")
+
+    def register_onnx(self, name: str, src,
+                      version: Optional[int] = None) -> ModelEntry:
+        """Import an ONNX model and register the SameDiff graph."""
+        from deeplearning4j_tpu.modelimport import import_onnx_model
+        model = import_onnx_model(src, trainable=False)
+        return self.register(name, model, version=version, source="onnx")
+
+    # ---- resolution ----
+    def get(self, name: str, version: Optional[int] = None) -> ModelEntry:
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise KeyError(
+                    f"no model '{name}' registered; have {sorted(self._models)}")
+            if version is None:
+                return versions[max(versions)]
+            if version not in versions:
+                raise KeyError(
+                    f"model '{name}' has versions {sorted(versions)}, "
+                    f"not {version}")
+            return versions[version]
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def versions(self, name: str) -> List[int]:
+        with self._lock:
+            return sorted(self._models.get(name, {}))
+
+    def unregister(self, name: str, version: Optional[int] = None) -> None:
+        """Remove one version (or the whole name)."""
+        with self._lock:
+            if name not in self._models:
+                raise KeyError(f"no model '{name}' registered")
+            if version is None:
+                del self._models[name]
+            else:
+                del self._models[name][version]
+                if not self._models[name]:
+                    del self._models[name]
+
+    # ---- warmup ----
+    def warmup(self, name: str, cache,
+               version: Optional[int] = None,
+               input_shape: Optional[Tuple[int, ...]] = None) -> List[int]:
+        """Drive `cache` (a BucketedCompileCache) through every bucket for
+        this model so no request ever waits on an XLA compile.  Needs the
+        trailing input shape — inferred from the model config when
+        possible, otherwise pass `input_shape`."""
+        import numpy as np
+        entry = self.get(name, version)
+        shape = tuple(input_shape) if input_shape is not None \
+            else entry.input_shape
+        if shape is None:
+            raise ValueError(
+                f"cannot warm '{entry.key}': input shape unknown — pass "
+                "input_shape=(trailing, dims)")
+        warmed = cache.warmup(entry.key, entry.model, shape,
+                              np.dtype(entry.input_dtype))
+        entry.warmed_buckets = warmed
+        return warmed

@@ -1,0 +1,178 @@
+"""ModelServer — the serving front door.
+
+Composes the pieces into the runtime the ROADMAP's "heavy traffic" north
+star needs on one host:
+
+    registry  (name, version) -> model          [serving.registry]
+    batcher   concurrent submits -> dispatches   [serving.batcher]
+    cache     dispatch -> AOT bucket executable  [serving.compile_cache]
+    metrics   SLO observability                  [serving.metrics]
+
+Request path: `submit(name, x)` resolves the model entry (so a version
+roll never reroutes an in-flight request), groups by (model, trailing
+dims, dtype) in the continuous batcher, which concatenates compatible
+requests and hands the merged batch to the compile cache; the cache pads
+to the power-of-two bucket and runs the pre-compiled executable; rows are
+split back per request and each Future resolves.
+
+With a `Mesh` the executable runs SPMD with the batch sharded over the
+data axis — the same sharded-inference data path as
+`parallel.ParallelInference`, now behind admission control.
+
+Example:
+
+    srv = ModelServer(max_batch=64, batch_timeout_ms=3.0)
+    srv.deploy("lenet", zoo="LeNet", warmup=True)
+    fut = srv.submit("lenet", x, deadline_ms=50.0)   # -> Future
+    y = fut.result()
+    srv.shutdown()           # graceful: drains in-flight futures
+"""
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.batcher import (ContinuousBatcher,
+                                                RejectedError)
+from deeplearning4j_tpu.serving.compile_cache import BucketedCompileCache
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.registry import ModelEntry, ModelRegistry
+
+
+class ModelServer:
+    """Multi-model, continuously-batched, AOT-compiled inference server."""
+
+    def __init__(self, registry: Optional[ModelRegistry] = None,
+                 mesh=None, data_axis: str = "data",
+                 max_batch: int = 64, batch_timeout_ms: float = 5.0,
+                 max_queue: int = 256, min_bucket: int = 1,
+                 metrics: Optional[ServingMetrics] = None):
+        self.registry = registry if registry is not None else ModelRegistry()
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.cache = BucketedCompileCache(
+            max_batch=max_batch, min_bucket=min_bucket, mesh=mesh,
+            data_axis=data_axis, counters=self.metrics.cache)
+        self.batcher = ContinuousBatcher(
+            self._dispatch, max_batch=max_batch,
+            batch_timeout_ms=batch_timeout_ms, max_queue=max_queue,
+            metrics=self.metrics)
+        self._entries_lock = threading.Lock()
+        self._entries = {}          # key -> ModelEntry (dispatch lookup)
+        self._closed = False
+
+    # ---- deployment ----
+    def _track(self, entry: ModelEntry, warmup: bool,
+               input_shape=None) -> ModelEntry:
+        with self._entries_lock:
+            self._entries[entry.key] = entry
+        if warmup:
+            self.registry.warmup(entry.name, self.cache,
+                                 version=entry.version,
+                                 input_shape=input_shape)
+        return entry
+
+    def deploy(self, name: str, model=None, *, zoo: Optional[str] = None,
+               keras: Optional[str] = None, onnx=None,
+               version: Optional[int] = None, warmup: bool = False,
+               input_shape: Optional[Tuple[int, ...]] = None,
+               **kwargs) -> ModelEntry:
+        """Register a model under `name` from exactly one source (a built
+        model instance, `zoo=` catalog name, `keras=` file path, or
+        `onnx=` path/bytes) and optionally warm every compile bucket."""
+        sources = [s for s in (model, zoo, keras, onnx) if s is not None]
+        if len(sources) != 1:
+            raise ValueError(
+                "deploy() needs exactly one of: model=, zoo=, keras=, onnx=")
+        if model is not None:
+            entry = self.registry.register(name, model, version=version,
+                                           input_shape=input_shape,
+                                           **kwargs)
+        elif zoo is not None:
+            entry = self.registry.register_zoo(name, zoo, version=version,
+                                               **kwargs)
+        elif keras is not None:
+            entry = self.registry.register_keras(name, keras,
+                                                 version=version, **kwargs)
+        else:
+            entry = self.registry.register_onnx(name, onnx, version=version,
+                                                **kwargs)
+        return self._track(entry, warmup, input_shape)
+
+    # ---- request path ----
+    def submit(self, name: str, x, version: Optional[int] = None,
+               priority: int = 0,
+               deadline_ms: Optional[float] = None) -> Future:
+        """Enqueue one request; returns a Future of the output rows.
+        Raises `KeyError` for an unknown model, `RejectedError` when load
+        is shed; the Future raises `DeadlineExceededError` if the deadline
+        passes in queue."""
+        if self._closed:
+            raise RejectedError("ModelServer is shut down")
+        entry = self.registry.get(name, version)
+        with self._entries_lock:
+            self._entries.setdefault(entry.key, entry)
+        x = np.asarray(x)
+        if x.ndim < 1 or x.shape[0] == 0:
+            raise ValueError(
+                f"request must have >= 1 rows, got shape {x.shape}")
+        if x.shape[0] > self.batcher.max_batch:
+            raise ValueError(
+                f"request of {x.shape[0]} rows exceeds max_batch="
+                f"{self.batcher.max_batch}; split it client-side")
+        group = (entry.key, tuple(x.shape[1:]), np.dtype(x.dtype).str)
+        return self.batcher.submit(x, group=group, priority=priority,
+                                   deadline_ms=deadline_ms)
+
+    def output_async(self, name: str, x, version: Optional[int] = None,
+                     priority: int = 0,
+                     deadline_ms: Optional[float] = None) -> Future:
+        """Alias of `submit` (reference-flavored name)."""
+        return self.submit(name, x, version=version, priority=priority,
+                           deadline_ms=deadline_ms)
+
+    def output(self, name: str, x, version: Optional[int] = None,
+               priority: int = 0, deadline_ms: Optional[float] = None,
+               timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking convenience form of `submit`."""
+        return self.submit(name, x, version=version, priority=priority,
+                           deadline_ms=deadline_ms).result(timeout=timeout)
+
+    def _dispatch(self, group, xs: List[np.ndarray]) -> List[np.ndarray]:
+        """Batcher callback: one merged, bucket-padded, AOT-compiled
+        forward for a group of compatible requests."""
+        key = group[0]
+        with self._entries_lock:
+            entry = self._entries[key]
+        merged = xs[0] if len(xs) == 1 else np.concatenate(xs, axis=0)
+        self.metrics.record_padding(
+            self.cache.bucket_for(merged.shape[0]) - merged.shape[0])
+        out = self.cache.run(entry.key, entry.model, merged)
+        res, off = [], 0
+        for x in xs:
+            res.append(out[off: off + x.shape[0]])
+            off += x.shape[0]
+        return res
+
+    # ---- lifecycle / observability ----
+    def stats(self) -> dict:
+        """SLO snapshot (also exported via ui.server's /serving endpoint)."""
+        snap = self.metrics.snapshot()
+        snap["models"] = {
+            n: self.registry.versions(n) for n in self.registry.names()}
+        snap["buckets"] = list(self.cache.buckets)
+        return snap
+
+    def shutdown(self, drain: bool = True, timeout: float = 10.0) -> None:
+        """Graceful stop: refuse new submits, drain queued requests so
+        every accepted Future resolves, then stop the worker.  Idempotent."""
+        self._closed = True
+        self.batcher.shutdown(drain=drain, timeout=timeout)
+
+    def __enter__(self) -> "ModelServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

@@ -11,13 +11,15 @@ plus a `<meta http-equiv=refresh>` interval replaces the websocket push —
 same live-monitoring capability, zero dependencies."""
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
 from deeplearning4j_tpu.ui.stats import (FileStatsStorage,
                                           InMemoryStatsStorage,
-                                          render_html)
+                                          render_html,
+                                          render_serving_html)
 
 
 class UIServer:
@@ -29,6 +31,7 @@ class UIServer:
     def __init__(self):
         self._storages: List[InMemoryStatsStorage] = []
         self._paths: List[str] = []
+        self._serving: List = []          # serving.ServingMetrics sources
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.refresh_seconds = 5
@@ -58,6 +61,28 @@ class UIServer:
         self._paths = [p for p in self._paths if p != path]
         return self
 
+    def attach_serving(self, source) -> "UIServer":
+        """Monitor a serving metrics source — anything with a `stats()` or
+        `snapshot()` dict method (`serving.ModelServer` /
+        `serving.ServingMetrics`).  Rendered as a section on the dashboard
+        and exported as JSON at the `/serving` endpoint."""
+        self._serving.append(source)
+        return self
+
+    def detach_serving(self, source) -> "UIServer":
+        self._serving = [s for s in self._serving if s is not source]
+        return self
+
+    def _serving_snapshots(self) -> List[dict]:
+        out = []
+        for s in list(self._serving):
+            try:
+                fn = getattr(s, "stats", None) or getattr(s, "snapshot")
+                out.append(fn())
+            except Exception as e:          # a dead source must not 500 the UI
+                out.append({"error": repr(e)})
+        return out
+
     def _render(self) -> str:
         storages = list(self._storages)
         for p in self._paths:
@@ -65,10 +90,22 @@ class UIServer:
                 storages.append(FileStatsStorage.load(p))
             except (FileNotFoundError, OSError):
                 pass                     # run not started yet
+        serving = "\n<hr/>\n".join(
+            render_serving_html(s) for s in self._serving_snapshots())
         if not storages:
-            return ("<html><body><h1>deeplearning4j_tpu UI</h1>"
-                    "<p>No StatsStorage attached.</p></body></html>")
-        html = "\n<hr/>\n".join(render_html(s) for s in storages)
+            if not serving:
+                return ("<html><body><h1>deeplearning4j_tpu UI</h1>"
+                        "<p>No StatsStorage attached.</p></body></html>")
+            html = ("<html><head><title>deeplearning4j_tpu serving</title>"
+                    "<style>body{font-family:sans-serif;margin:24px}"
+                    "</style></head><body>" + serving + "</body></html>")
+        else:
+            html = "\n<hr/>\n".join(render_html(s) for s in storages)
+            if serving:
+                # inject before the LAST closing tag (each attached storage
+                # renders a full document)
+                i = html.rfind("</body></html>")
+                html = html[:i] + "<hr/>\n" + serving + "\n" + html[i:]
         tag = (f'<meta http-equiv="refresh" '
                f'content="{self.refresh_seconds}">')
         return html.replace("<head>", "<head>" + tag, 1)
@@ -81,10 +118,15 @@ class UIServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):          # noqa: N802 (stdlib API)
-                body = ui._render().encode()
+                if self.path.rstrip("/") == "/serving":
+                    # machine-readable SLO metrics (scrape endpoint)
+                    body = json.dumps(ui._serving_snapshots()).encode()
+                    ctype = "application/json"
+                else:
+                    body = ui._render().encode()
+                    ctype = "text/html; charset=utf-8"
                 self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/html; charset=utf-8")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

@@ -330,3 +330,52 @@ def render_html(storage: InMemoryStatsStorage, path: Optional[str] = None
         with open(path, "w") as f:
             f.write(html)
     return html
+
+
+def render_serving_html(snapshot: Dict) -> str:
+    """One HTML section for a `serving.ServingMetrics.snapshot()` /
+    `ModelServer.stats()` dict: SLO latency percentiles, queue/admission
+    counters, batch occupancy and compile-cache hit rate — the serving-side
+    complement to the training charts above (served live by
+    `ui.server.UIServer.attach_serving`)."""
+    lat = snapshot.get("latency_ms", {})
+    cache = snapshot.get("compile_cache", {})
+
+    def row(k, v):
+        return (f'<tr><td style="padding:2px 12px 2px 0">{k}</td>'
+                f'<td><b>{v}</b></td></tr>')
+
+    def ms(key):
+        v = lat.get(key)
+        return f"{v:.2f} ms" if isinstance(v, (int, float)) \
+            and math.isfinite(v) else "–"
+
+    parts = ["<h2>Serving</h2>", "<table>"]
+    parts.append(row("requests (submitted / completed)",
+                     f"{snapshot.get('submitted', 0)} / "
+                     f"{snapshot.get('completed', 0)}"))
+    parts.append(row("latency p50 / p95 / p99",
+                     f"{ms('p50')} / {ms('p95')} / {ms('p99')}"))
+    parts.append(row("queue depth (now / peak)",
+                     f"{snapshot.get('queue_depth', 0)} / "
+                     f"{snapshot.get('queue_depth_peak', 0)}"))
+    parts.append(row("rejected (load shed) / expired (deadline) / failed",
+                     f"{snapshot.get('rejected', 0)} / "
+                     f"{snapshot.get('expired', 0)} / "
+                     f"{snapshot.get('failed', 0)}"))
+    parts.append(row("dispatches", snapshot.get("dispatches", 0)))
+    parts.append(row("batch occupancy (requests/dispatch)",
+                     f"{snapshot.get('batch_occupancy', 0.0):.2f}"))
+    parts.append(row("bucket padding fraction",
+                     f"{snapshot.get('padding_fraction', 0.0):.3f}"))
+    parts.append(row("compile cache hits / misses (hit rate)",
+                     f"{cache.get('hits', 0)} / {cache.get('misses', 0)} "
+                     f"({cache.get('hit_rate', 0.0):.2%})"))
+    if snapshot.get("models"):
+        parts.append(row("models", ", ".join(
+            f"{n} v{max(vs)}" for n, vs in
+            sorted(snapshot["models"].items()))))
+    if snapshot.get("buckets"):
+        parts.append(row("buckets", str(snapshot["buckets"])))
+    parts.append("</table>")
+    return "\n".join(parts)

@@ -11,6 +11,9 @@ BertModel.
 """
 from __future__ import annotations
 
+import threading
+from typing import Dict
+
 import jax.numpy as jnp
 
 
@@ -35,3 +38,65 @@ def advance(model, new_iter_dev, steps: int = 1) -> None:
     model._iter_dev = new_iter_dev
     model.iteration += steps
     model._iter_sync = model.iteration
+
+
+# ---------------------------------------------------------------------------
+# Host-side event counters (serving / cache instrumentation)
+# ---------------------------------------------------------------------------
+
+class StatCounter:
+    """Thread-safe monotonically increasing host counter.  Unlike the
+    device counters above these never touch the accelerator — they count
+    host-side events (cache hits, rejected requests, dispatches) read by
+    the metrics/UI layer from arbitrary threads."""
+
+    def __init__(self, name: str = "counter"):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> int:
+        with self._lock:
+            self._value += n
+            return self._value
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
+    def __repr__(self) -> str:   # pragma: no cover - debug aid
+        return f"StatCounter({self.name}={self.value})"
+
+
+class HitMissCounters:
+    """Paired hit/miss counters for a cache (serving compile cache &c.)."""
+
+    def __init__(self, name: str = "cache"):
+        self.name = name
+        self.hits = StatCounter(f"{name}.hits")
+        self.misses = StatCounter(f"{name}.misses")
+
+    def hit(self) -> None:
+        self.hits.inc()
+
+    def miss(self) -> None:
+        self.misses.inc()
+
+    @property
+    def hit_rate(self) -> float:
+        h, m = self.hits.value, self.misses.value
+        return h / (h + m) if h + m else 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        h, m = self.hits.value, self.misses.value
+        return {"hits": h, "misses": m,
+                "hit_rate": h / (h + m) if h + m else 0.0}
+
+    def reset(self) -> None:
+        self.hits.reset()
+        self.misses.reset()
