@@ -912,10 +912,10 @@ class SameDiff:
             self._scan_step = self._build_scan_step()
         it_dev, ep_dev = device_counters(self)
         ((self.variables_, self.opt_state_, self._key, new_it),
-         losses) = self._scan_step(
+         losses, last_loss) = self._scan_step(
             (self.variables_, self.opt_state_, self._key, it_dev),
             ep_dev, feeds)
-        self._score = losses[-1]
+        self._score = last_loss
         advance(self, new_it, steps=int(k))
         return losses
 

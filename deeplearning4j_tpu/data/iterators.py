@@ -126,36 +126,80 @@ class AsyncDataSetIterator(DataSetIterator):
     """Background-prefetch wrapper (reference `AsyncDataSetIterator`,
     `deeplearning4j-core/.../datasets/iterator/AsyncDataSetIterator.java`):
     a daemon thread pulls from the underlying iterator into a bounded queue
-    so host-side ETL overlaps device compute."""
+    so host-side ETL overlaps device compute.
+
+    A consumer that stops early (``break``, exception, GC of the generator)
+    must not strand the producer blocked on ``q.put`` forever: every put is
+    a bounded-wait retry loop against a per-iteration stop event, set by the
+    generator's ``finally`` and by :meth:`close`.
+    """
 
     _END = object()
+    _POLL_S = 0.05          # producer stop-event poll while queue is full
 
     def __init__(self, underlying: DataSetIterator, queue_size: int = 2):
         self.underlying = underlying
         self.queue_size = queue_size
+        self._producers: List[tuple] = []    # live (stop_event, thread)
+
+    def _put_or_stop(self, q, stop, item) -> bool:
+        """Bounded-wait put honoring `stop`; True if the item was enqueued."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=self._POLL_S)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def __iter__(self):
         q: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
+        stop = threading.Event()
         err: List[BaseException] = []
 
         def producer():
             try:
                 for ds in self.underlying:
-                    q.put(ds)
+                    if not self._put_or_stop(q, stop, ds):
+                        return               # consumer went away
             except BaseException as e:  # propagate to consumer
                 err.append(e)
             finally:
-                q.put(self._END)
+                self._put_or_stop(q, stop, self._END)
 
         t = threading.Thread(target=producer, daemon=True)
+        self._producers.append((stop, t))
         t.start()
-        while True:
-            item = q.get()
-            if item is self._END:
-                if err:
-                    raise err[0]
-                return
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is self._END:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            # early break / exception / GC: release the producer (it may be
+            # blocked on a full queue) and let the daemon thread exit
+            stop.set()
+            self._producers = [(s, th) for s, th in self._producers
+                               if th.is_alive() and th is not t]
+
+    def close(self, timeout: float = 2.0) -> None:
+        """Stop all live producer threads (idempotent).  Consumers that
+        exhaust or break out of the iterator clean up automatically; this
+        is for owners that never started / never finished iterating."""
+        producers, self._producers = self._producers, []
+        for stop, _ in producers:
+            stop.set()
+        for _, t in producers:
+            t.join(timeout)
+
+    def active_producers(self) -> int:
+        """Live producer-thread count (diagnostics / leak tests)."""
+        self._producers = [(s, t) for s, t in self._producers
+                           if t.is_alive()]
+        return len(self._producers)
 
     def reset(self):
         self.underlying.reset()

@@ -143,18 +143,36 @@ class NormalizerMinMaxScaler(Normalizer):
         self.data_max = hi.astype(np.float32)
         return self
 
+    def affine_stats(self):
+        """Canonical `(shift, scale)` f32 stats: `transform` is exactly
+        `(x - shift) / scale`.  Computed in float64 then rounded once, and
+        shared with `DeviceNormalizer` so the on-device prologue is bitwise
+        identical to this host path — sub-then-div is the one affine form
+        XLA cannot re-associate (mul+add contracts to FMA, div-by-constant
+        becomes multiply-by-reciprocal)."""
+        span = float(self.max_range) - float(self.min_range)
+        rng = np.maximum(self.data_max.astype(np.float64)
+                         - self.data_min.astype(np.float64), 1e-12)
+        if span == 0.0:                      # degenerate [a, a] range
+            return None, None
+        scale = rng / span
+        shift = self.data_min.astype(np.float64) - float(self.min_range) * scale
+        return shift.astype(np.float32), scale.astype(np.float32)
+
     def transform(self, ds):
-        rng = np.maximum(self.data_max - self.data_min, 1e-12)
-        z = (np.asarray(ds.features, np.float32) - self.data_min) / rng
-        ds.features = z * (self.max_range - self.min_range) + self.min_range
+        shift, scale = self.affine_stats()
+        x = np.asarray(ds.features, np.float32)
+        ds.features = np.full_like(x, self.min_range) if scale is None \
+            else (x - shift) / scale
         return ds
 
     pre_process = transform
 
     def revert_features(self, f):
-        rng = np.maximum(self.data_max - self.data_min, 1e-12)
-        return ((f - self.min_range) / (self.max_range - self.min_range)
-                * rng + self.data_min)
+        shift, scale = self.affine_stats()
+        if scale is None:
+            raise ValueError("degenerate range: revert is undefined")
+        return f * scale + shift
 
     def to_bytes(self) -> bytes:
         buf = io.BytesIO()
@@ -182,15 +200,33 @@ class ImagePreProcessingScaler(Normalizer):
     def fit(self, iterator):
         return self
 
+    def affine_stats(self):
+        """Canonical `(shift, scale)` f32 stats, same contract as
+        `NormalizerMinMaxScaler.affine_stats` (shared with the on-device
+        prologue for bitwise parity): `transform` is `(x - shift) / scale`.
+        For the defaults ([0,255] -> [0,1]) this degenerates to the
+        familiar `x / 255`."""
+        span = float(self.b) - float(self.a)
+        if span == 0.0:
+            return None, None
+        scale = float(self.max_pixel) / span
+        shift = -float(self.a) * scale
+        return np.float32(shift), np.float32(scale)
+
     def transform(self, ds):
-        x = np.asarray(ds.features, np.float32) / self.max_pixel
-        ds.features = x * (self.b - self.a) + self.a
+        shift, scale = self.affine_stats()
+        x = np.asarray(ds.features, np.float32)
+        ds.features = np.full_like(x, self.a) if scale is None \
+            else (x - shift) / scale
         return ds
 
     pre_process = transform
 
     def revert_features(self, f):
-        return (f - self.a) / (self.b - self.a) * self.max_pixel
+        shift, scale = self.affine_stats()
+        if scale is None:
+            raise ValueError("degenerate range: revert is undefined")
+        return f * scale + shift
 
     def to_bytes(self) -> bytes:
         return json.dumps({"kind": "image", "a": self.a, "b": self.b,

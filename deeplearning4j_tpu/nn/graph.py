@@ -463,6 +463,7 @@ class ComputationGraph:
         self._scan_step = None
         self._output_fn = None
         self._vertex_types: Dict[str, InputType] = {}
+        self._device_norm: Dict[str, Any] = {}  # input name -> DeviceNormalizer
 
     def _layer_of(self, name: str) -> Optional[Layer]:
         v = self.conf.vertices[name]
@@ -592,6 +593,7 @@ class ComputationGraph:
                  iteration, epoch):
             # split inside the compiled step (see MultiLayerNetwork._fit_batch:
             # device-resident rng/iteration carries, no per-step H2D)
+            inputs = self._apply_device_norm(inputs)
             rng, srng = jax.random.split(rng)
 
             def loss_fn(p):
@@ -677,10 +679,10 @@ class ComputationGraph:
         step = self._get_scan_step()
         it_dev, ep_dev = device_counters(self)
         ((self.params_, self.state_, self.opt_state_, self._rng, new_it),
-         losses) = step((self.params_, self.state_, self.opt_state_,
-                         self._rng, it_dev), ep_dev,
-                        (inputs, labels, lmasks))
-        self._score = losses[-1]
+         losses, last_loss) = step((self.params_, self.state_,
+                                    self.opt_state_, self._rng, it_dev),
+                                   ep_dev, (inputs, labels, lmasks))
+        self._score = last_loss
         self._last_batch_size = int(next(iter(inputs.values())).shape[1])
         advance(self, new_it, steps=int(k))
         for lst in self.listeners:
@@ -743,6 +745,10 @@ class ComputationGraph:
                         [jnp.asarray(m) for m in lmasks])
 
     def _fit_epoch_fused(self, iterator, k: int):
+        # blocks stack ON DEVICE (jnp.stack over staged per-batch arrays):
+        # no per-block host np.stack copy, and prefetched batches fuse
+        # without touching the host again (data.pipeline).
+        from deeplearning4j_tpu.data.pipeline import _stack_staged
         from deeplearning4j_tpu.utils.scan_fit import blocks_of
         for block in blocks_of(iterator, k):
             if len(block) == 1:
@@ -756,12 +762,16 @@ class ComputationGraph:
                 if lm is not None and not isinstance(lm, (list, tuple)):
                     lm = [lm]
                 lms.append(lm)
-            stacked_feats = {n: np.stack([np.asarray(f[n]) for f in feats])
+            if any(m is None for m in lms) and not all(m is None for m in lms):
+                for ds in block:            # mixed-mask block: not fusable
+                    self._fit_dataset(ds)
+                continue
+            stacked_feats = {n: _stack_staged([f[n] for f in feats])
                              for n in feats[0]}
-            stacked_labs = [np.stack([np.asarray(l[i]) for l in labs])
+            stacked_labs = [_stack_staged([l[i] for l in labs])
                             for i in range(len(labs[0]))]
             stacked_lms = (None if lms[0] is None else
-                           [np.stack([np.asarray(m[i]) for m in lms])
+                           [_stack_staged([m[i] for m in lms])
                             for i in range(len(lms[0]))])
             self.fit_steps(stacked_feats, stacked_labs, stacked_lms)
 
@@ -781,12 +791,50 @@ class ComputationGraph:
             lst.iteration_done(self, self.iteration, self.epoch)
 
     def score(self) -> float:
+        """Blocking read of the most recent minibatch loss; steady-state
+        loops should prefer `score_array()` (no host sync)."""
         s = getattr(self, "_score", None)
         return float(s) if s is not None else float("nan")
 
+    def score_array(self):
+        """Most recent minibatch loss as a (possibly in-flight) device
+        array, or None before the first step.  Never forces a host sync."""
+        return getattr(self, "_score", None)
+
+    def _apply_device_norm(self, inputs: Dict[str, Any]) -> Dict[str, Any]:
+        if not self._device_norm:
+            return inputs
+        return {n: (self._device_norm[n].apply_features(a)
+                    if n in self._device_norm else a)
+                for n, a in inputs.items()}
+
+    def set_normalizer(self, normalizers) -> "ComputationGraph":
+        """Fold fitted normalizers into the compiled step/output as an
+        on-device prologue.  `normalizers` is `{input_name: normalizer}`
+        (a bare normalizer is applied to every network input), or None to
+        clear.  Labels pass through untouched (the MultiNormalizer
+        features-only contract)."""
+        from deeplearning4j_tpu.data.pipeline import DeviceNormalizer
+        if normalizers is None:
+            self._device_norm = {}
+        else:
+            if not isinstance(normalizers, dict):
+                normalizers = {n: normalizers
+                               for n in self.conf.network_inputs}
+            unknown = set(normalizers) - set(self.conf.network_inputs)
+            if unknown:
+                raise ValueError(f"unknown network inputs: {sorted(unknown)}")
+            self._device_norm = {n: DeviceNormalizer.from_host(nz)
+                                 for n, nz in normalizers.items()}
+        self._train_step = None
+        self._scan_step = None
+        self._output_fn = None
+        return self
+
     def score_for(self, features, labels) -> float:
         loss, _ = self._loss(self.params_, self.state_,
-                             self._as_input_dict(features),
+                             self._apply_device_norm(
+                                 self._as_input_dict(features)),
                              self._as_list(labels), None, train=False)
         return float(loss)
 
@@ -803,6 +851,7 @@ class ComputationGraph:
                 # train=True runs stochastic layers deterministically off
                 # (no rng at inference — matches reference output(train) which
                 # only toggles BN/eval-mode semantics, not dropout sampling)
+                ins = self._apply_device_norm(ins)
                 acts, _ = self._forward(p, s, ins, train=train, rng=None)
                 return [acts[n] for n in self.conf.network_outputs]
             self._output_fn = jax.jit(fwd, static_argnums=(3,))

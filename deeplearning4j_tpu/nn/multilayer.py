@@ -239,6 +239,7 @@ class MultiLayerNetwork:
         self._scan_step = None
         self._output_fn = None
         self._layer_types: List[InputType] = []
+        self._device_norm = None   # on-device normalizer prologue (pipeline)
 
     # ---- init ----
     def init(self) -> "MultiLayerNetwork":
@@ -378,6 +379,12 @@ class MultiLayerNetwork:
             # split inside the compiled step: keeps the per-step host work at
             # zero device round-trips (the carry key + iteration counter live
             # on device and flow step→step without fresh H2D transfers)
+            if self._device_norm is not None:
+                # on-device normalizer prologue: stats are executable
+                # constants, the apply fuses into the forward — raw batches
+                # stream to device with zero host ETL (data.pipeline)
+                x = self._device_norm.apply_features(x)
+                y = self._device_norm.apply_labels(y)
             rng, srng = jax.random.split(rng)
 
             def loss_fn(p):
@@ -444,30 +451,54 @@ class MultiLayerNetwork:
         return self._scan_step
 
     def fit_steps(self, xs, ys, features_masks=None, labels_masks=None):
-        """Run `k = xs.shape[0]` training steps in one device dispatch.
+        """Run `k` training steps in one device dispatch.
 
-        `xs`/`ys` (and optional masks) carry a leading steps axis:
-        `[k, batch, ...]`.  Equivalent to `k` sequential `fit(x, y)`
-        calls (same math, same updater/iteration semantics) but compiled
-        as a single `lax.scan`, eliminating per-step host→device dispatch
-        latency.  Listeners fire once per block with the final loss;
-        per-step losses are returned as a length-k array."""
+        Two input forms: stacked `[k, batch, ...]` arrays with a leading
+        steps axis, or lists of `k` per-step `[batch, ...]` arrays (the
+        streaming prefetch path) — the latter are stacked *inside* the
+        compiled dispatch, so pre-staged device batches fuse into the scan
+        without an eager host- or device-side stack copy.  Equivalent to
+        `k` sequential `fit(x, y)` calls (same math, same updater/iteration
+        semantics) but compiled as a single `lax.scan`, eliminating
+        per-step host→device dispatch latency.  Listeners fire once per
+        block with the final loss; per-step losses are returned as a
+        length-k array."""
         from deeplearning4j_tpu.utils.counters import advance, device_counters
         from deeplearning4j_tpu.utils.scan_fit import check_steps_axes
-        xs = jnp.asarray(xs)
-        ys = jnp.asarray(ys)
-        fm = None if features_masks is None else jnp.asarray(features_masks)
-        lm = None if labels_masks is None else jnp.asarray(labels_masks)
-        check_steps_axes([("xs", xs), ("ys", ys), ("features_masks", fm),
-                          ("labels_masks", lm)])
+        if isinstance(xs, (list, tuple)):
+            k = len(xs)
+            if not (isinstance(ys, (list, tuple)) and len(ys) == k):
+                raise ValueError("list-form fit_steps needs xs and ys as "
+                                 f"equal-length lists, got {k} xs / "
+                                 f"{'non-list' if not isinstance(ys, (list, tuple)) else len(ys)} ys")
+            fms = features_masks if features_masks is not None else [None] * k
+            lms = labels_masks if labels_masks is not None else [None] * k
+            batches = tuple(
+                (jnp.asarray(xs[i]), jnp.asarray(ys[i]),
+                 None if fms[i] is None else jnp.asarray(fms[i]),
+                 None if lms[i] is None else jnp.asarray(lms[i]))
+                for i in range(k))
+            batch_n = int(batches[0][0].shape[0])
+        else:
+            xs = jnp.asarray(xs)
+            ys = jnp.asarray(ys)
+            fm = None if features_masks is None else \
+                jnp.asarray(features_masks)
+            lm = None if labels_masks is None else jnp.asarray(labels_masks)
+            check_steps_axes([("xs", xs), ("ys", ys), ("features_masks", fm),
+                              ("labels_masks", lm)])
+            batches = (xs, ys, fm, lm)
+            k = int(xs.shape[0])
+            batch_n = int(xs.shape[1])
         step = self._get_scan_step()
         it_dev, ep_dev = device_counters(self)
         ((self.params_, self.state_, self.opt_state_, self._rng, new_it),
-         losses) = step((self.params_, self.state_, self.opt_state_,
-                         self._rng, it_dev), ep_dev, (xs, ys, fm, lm))
-        self._score = losses[-1]
-        self._last_batch_size = int(xs.shape[1])
-        advance(self, new_it, steps=int(xs.shape[0]))
+         losses, last_loss) = step((self.params_, self.state_,
+                                    self.opt_state_, self._rng, it_dev),
+                                   ep_dev, batches)
+        self._score = last_loss
+        self._last_batch_size = batch_n
+        advance(self, new_it, steps=k)
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
         return losses
@@ -513,18 +544,18 @@ class MultiLayerNetwork:
                         None if lm is None else jnp.asarray(lm))
 
     def _fit_epoch_fused(self, iterator, k: int):
-        from deeplearning4j_tpu.utils.scan_fit import blocks_of
-        for block in blocks_of(iterator, k):
-            if len(block) == 1:
-                self._fit_dataset(block[0])
+        # streaming fused epoch: device_blocks yields per-step staged
+        # arrays and fit_steps stacks them INSIDE the compiled dispatch —
+        # no per-block host np.stack copy and no eager device stack;
+        # prefetched (already-device) batches fuse without any H2D.
+        # Mixed-mask blocks degrade to the per-step path instead of
+        # silently dropping later batches' masks.
+        from deeplearning4j_tpu.data.pipeline import device_blocks
+        for kind, payload in device_blocks(iterator, k):
+            if kind == "single":
+                self._fit_dataset(payload)
             else:
-                fms = [getattr(ds, "features_mask", None) for ds in block]
-                lms = [getattr(ds, "labels_mask", None) for ds in block]
-                self.fit_steps(
-                    np.stack([np.asarray(ds.features) for ds in block]),
-                    np.stack([np.asarray(ds.labels) for ds in block]),
-                    None if fms[0] is None else np.stack(fms),
-                    None if lms[0] is None else np.stack(lms))
+                self.fit_steps(*payload)
 
     def _fit_batch(self, x, y, fmask=None, lmask=None):
         from deeplearning4j_tpu.utils.counters import advance, device_counters
@@ -541,23 +572,56 @@ class MultiLayerNetwork:
             lst.iteration_done(self, self.iteration, self.epoch)
 
     def score(self) -> float:
-        """Loss of the most recent minibatch (reference `score()`)."""
+        """Loss of the most recent minibatch (reference `score()`).  This
+        is the BLOCKING read: coercing to float waits for the step to
+        complete.  Steady-state loops should prefer `score_array()`."""
         s = getattr(self, "_score", None)
         return float(s) if s is not None else float("nan")
+
+    def score_array(self):
+        """Loss of the most recent minibatch as a device array (or None
+        before the first step).  Never syncs: the array may still be in
+        flight — the async-dispatch window stays open until the caller
+        coerces it (float/np.asarray), so listeners can record scores
+        without stalling the step pipeline."""
+        return getattr(self, "_score", None)
+
+    def set_normalizer(self, normalizer) -> "MultiLayerNetwork":
+        """Fold a fitted normalizer (NormalizerStandardize / MinMaxScaler /
+        ImagePreProcessingScaler, or a DeviceNormalizer) into the compiled
+        train step and output fn as an on-device prologue, replacing
+        host-side `set_pre_processor` ETL.  Pass None to clear.  Triggers
+        a re-trace on the next step (stats are executable constants)."""
+        from deeplearning4j_tpu.data.pipeline import DeviceNormalizer
+        self._device_norm = (None if normalizer is None
+                             else DeviceNormalizer.from_host(normalizer))
+        self._train_step = None
+        self._scan_step = None
+        self._output_fn = None
+        return self
 
     def score_for(self, x, y, features_mask=None, labels_mask=None) -> float:
         """Score on given data without updating (reference `score(DataSet)`):
         eval mode — no dropout, BN uses running statistics."""
-        loss, _ = self._loss(self.params_, self.state_, jnp.asarray(x),
-                             jnp.asarray(y), None, features_mask, labels_mask,
+        x, y = jnp.asarray(x), jnp.asarray(y)
+        if self._device_norm is not None:
+            x = self._device_norm.apply_features(x)
+            y = self._device_norm.apply_labels(y)
+        loss, _ = self._loss(self.params_, self.state_, x,
+                             y, None, features_mask, labels_mask,
                              train=False)
         return float(loss)
 
     def output(self, x, train: bool = False) -> jnp.ndarray:
-        """Inference forward pass (reference `output(INDArray)`), jitted."""
+        """Inference forward pass (reference `output(INDArray)`), jitted.
+        An attached on-device normalizer (`set_normalizer`) applies here
+        too, so inference sees the same prologue as training."""
         if self._output_fn is None:
-            self._output_fn = jax.jit(
-                lambda p, s, x_: self._forward(p, s, x_, train=False, rng=None)[0])
+            def fwd(p, s, x_):
+                if self._device_norm is not None:
+                    x_ = self._device_norm.apply_features(x_)
+                return self._forward(p, s, x_, train=False, rng=None)[0]
+            self._output_fn = jax.jit(fwd)
         return self._output_fn(self.params_, self.state_, jnp.asarray(x))
 
     def feed_forward(self, x, train: bool = False) -> List[jnp.ndarray]:
@@ -613,8 +677,13 @@ class MultiLayerNetwork:
         `computeGradientAndScore` half used by GradientCheckUtil.  Eval mode,
         consistent with `score_for` finite differences (BN running stats,
         no dropout)."""
+        x, y = jnp.asarray(x), jnp.asarray(y)
+        if self._device_norm is not None:   # same prologue as score_for
+            x = self._device_norm.apply_features(x)
+            y = self._device_norm.apply_labels(y)
+
         def loss_fn(p):
-            return self._loss(p, self.state_, jnp.asarray(x), jnp.asarray(y),
+            return self._loss(p, self.state_, x, y,
                               None, features_mask, labels_mask,
                               train=False)[0]
         return jax.grad(loss_fn)(self.params_)

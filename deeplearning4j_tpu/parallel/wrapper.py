@@ -235,6 +235,33 @@ class ParallelWrapper:
             m.epoch += 1
         return self
 
+    def sharded_placement(self, batch_dim: int = 0):
+        """Placement callable for `data.pipeline.DevicePrefetchIterator`:
+        stages each array split over the mesh's data axis, so prefetched
+        batches land pre-sharded and the SPMD step consumes them with zero
+        resharding."""
+        return lambda leaf: _shard_batch(leaf, self.mesh, self.data_axis,
+                                         batch_dim=batch_dim)
+
+    def fit_prefetched(self, iterator, *, epochs: int = 1,
+                       fused_steps: int = 1, prefetch_depth: int = 2):
+        """Async end-to-end SPMD training from a host iterator: batches are
+        ETL'd in a producer thread, staged onto the mesh pre-sharded
+        (`sharded_placement`) `prefetch_depth` batches ahead, and consumed
+        by the model's fused `fit_steps` scan — the SPMD composition of the
+        pipeline's three latency hiders (prefetch, on-device normalize via
+        `model.set_normalizer`, fused dispatch)."""
+        from deeplearning4j_tpu.data.pipeline import DevicePrefetchIterator
+        self._place_model()
+        pf = DevicePrefetchIterator(iterator, depth=prefetch_depth,
+                                    placement=self.sharded_placement())
+        try:
+            with self.mesh:
+                self.model.fit(pf, epochs=epochs, fused_steps=fused_steps)
+        finally:
+            pf.close()
+        return self
+
     def fit_steps(self, xs, ys):
         """SPMD fused dispatch: a `[k, batch, ...]` block trains as k data-
         parallel steps in ONE compiled dispatch — the model's `fit_steps`

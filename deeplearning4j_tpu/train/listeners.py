@@ -4,15 +4,19 @@ CheckpointListener,TimeIterationListener}.java`).
 
 Listeners receive `iteration_done(model, iteration, epoch)` after each fit
 step and optionally `on_epoch_end(model)`.  They are host-side only — the
-compiled step is never interrupted (the reference pays a sync per listener
-call; here `model.score()` already has the loss on host).
+compiled step is never interrupted, and none of the stock listeners forces
+a per-iteration device sync: `model.score()` (a blocking float read) is
+only called when a log line is actually emitted, and score collection goes
+through `model.score_array()` (a lazy device array) with coercion deferred
+to the consumer.  The async-dispatch pipeline therefore stays full through
+listener callbacks (asserted by tests/test_input_pipeline.py).
 """
 from __future__ import annotations
 
 import logging
 import os
 import time
-from typing import List, Optional
+from typing import Any, List, Optional
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -32,7 +36,11 @@ class ScoreIterationListener(TrainingListener):
         self.print_every = max(1, print_every)
 
     def iteration_done(self, model, iteration, epoch):
-        if iteration % self.print_every == 0:
+        # model.score() is the blocking read — only pay it when the record
+        # will actually be emitted (level check first), so a muted logger
+        # costs zero device syncs per iteration
+        if iteration % self.print_every == 0 \
+                and log.isEnabledFor(logging.INFO):
             log.info("Score at iteration %d is %.6f", iteration,
                      model.score())
 
@@ -165,14 +173,26 @@ class TimeIterationListener(TrainingListener):
 
 class CollectScoresListener(TrainingListener):
     """Score history collector (reference `CollectScoresIterationListener`),
-    the metrics-storage hook the training UI consumes."""
+    the metrics-storage hook the training UI consumes.
+
+    Collection is sync-free: each callback appends the model's lazy score
+    array (`score_array()`, a device array that may still be in flight) and
+    the `scores` property coerces to floats only when the history is read —
+    so collecting every iteration does not drain the dispatch pipeline."""
 
     def __init__(self, frequency: int = 1):
         self.frequency = max(1, frequency)
-        self.scores: List[float] = []
+        self._raw: List[Any] = []          # device arrays until read
         self.iterations: List[int] = []
 
     def iteration_done(self, model, iteration, epoch):
         if iteration % self.frequency == 0:
-            self.scores.append(model.score())
+            raw = getattr(model, "score_array", None)
+            self._raw.append(raw() if raw is not None else model.score())
             self.iterations.append(iteration)
+
+    @property
+    def scores(self) -> List[float]:
+        """Collected scores as floats (the read is the sync point)."""
+        return [float(s) if s is not None else float("nan")
+                for s in self._raw]

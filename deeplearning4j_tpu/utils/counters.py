@@ -20,21 +20,28 @@ import jax.numpy as jnp
 def device_counters(model):
     """Return (iteration_dev, epoch_dev) int32 scalars for `model`, cached
     against its host `iteration`/`epoch` attributes.  After the step, the
-    caller assigns the step's returned counter via `advance(model, it)`."""
+    caller assigns the step's returned counter via `advance(model, it)` —
+    in the steady-state loop this function performs ZERO transfers (the
+    cached device scalar flows step→step; `counter_uploads` below counts
+    the fresh H2D uploads so the no-round-trip invariant is testable)."""
     if getattr(model, "_iter_dev", None) is None \
             or getattr(model, "_iter_sync", None) != model.iteration:
         model._iter_dev = jnp.asarray(model.iteration, jnp.int32)
         model._iter_sync = model.iteration
+        counter_uploads.inc()
     if getattr(model, "_epoch_sync", None) != model.epoch:
         model._epoch_dev = jnp.asarray(model.epoch, jnp.int32)
         model._epoch_sync = model.epoch
+        counter_uploads.inc()
     return model._iter_dev, model._epoch_dev
 
 
 def advance(model, new_iter_dev, steps: int = 1) -> None:
     """Record `steps` completed steps: store the device-side counter
-    returned by the compiled step and advance the host shadow in lockstep
-    (no sync forced)."""
+    returned by the compiled step and advance the host shadow in lockstep.
+    Never blocks and never transfers — the returned counter is a device
+    array (possibly still being computed) and the host shadow is plain int
+    arithmetic, so per-iteration bookkeeping costs no device round-trip."""
     model._iter_dev = new_iter_dev
     model.iteration += steps
     model._iter_sync = model.iteration
@@ -100,3 +107,9 @@ class HitMissCounters:
     def reset(self) -> None:
         self.hits.reset()
         self.misses.reset()
+
+
+# Process-wide diagnostic: fresh H2D schedule-counter uploads.  A sync-free
+# steady-state loop uploads once per model (+ once per epoch bump) and then
+# stays flat — tests/test_input_pipeline.py pins this invariant.
+counter_uploads = StatCounter("device_counter_uploads")

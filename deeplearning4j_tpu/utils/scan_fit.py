@@ -12,6 +12,7 @@ flowing step-to-step as scan carries.
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 
 def blocks_of(iterator, k: int):
@@ -29,12 +30,18 @@ def blocks_of(iterator, k: int):
             return tuple(sorted((k, np.shape(v)) for k, v in x.items()))
         return np.shape(x)
 
+    def first_attr(ds, *names):
+        # NOT `a or b`: truthiness of a multi-element ndarray mask raises
+        for n in names:
+            v = getattr(ds, n, None)
+            if v is not None:
+                return v
+        return None
+
     def key(ds):
         return (shapes(ds.features), shapes(ds.labels),
-                shapes(getattr(ds, "features_mask", None)
-                       or getattr(ds, "features_masks", None)),
-                shapes(getattr(ds, "labels_mask", None)
-                       or getattr(ds, "labels_masks", None)))
+                shapes(first_attr(ds, "features_mask", "features_masks")),
+                shapes(first_attr(ds, "labels_mask", "labels_masks")))
 
     buf, buf_key = [], None
     for ds in iterator:
@@ -86,8 +93,21 @@ def make_scan_step(tick):
     `advance()` for the counter, attribute reassignment for the rest).
     `epoch` is NOT donated: `device_counters` caches it across calls."""
     def many(carry, epoch, batches):
+        if (isinstance(batches, (list, tuple)) and len(batches)
+                and isinstance(batches[0], (list, tuple))):
+            # streaming form: k per-step batch tuples (the device-staged
+            # prefetch path).  Stack INSIDE the compiled region — one
+            # dispatch instead of one eager jnp.stack per leaf, and XLA
+            # folds the concatenate into the scan's per-step slicing
+            # rather than materializing a second copy of the block.
+            batches = jax.tree.map(lambda *ls: jnp.stack(ls), *batches)
         carry, losses = jax.lax.scan(
             lambda c, b: tick(c, epoch, b), carry, batches)
-        return carry, losses
+        # the final-step loss is sliced INSIDE the compiled program: an
+        # eager `losses[-1]` after the call would upload a fresh gather
+        # index every dispatch (a per-block H2D the sync-free loop bans —
+        # tests/test_input_pipeline.py runs fit_steps under
+        # transfer_guard("disallow"))
+        return carry, losses, losses[-1]
 
     return jax.jit(many, donate_argnums=(0,))

@@ -293,15 +293,15 @@ class BertModel:
         if mds.labels_masks is not None:                 # masked LM
             lmask = lm0
             step = self._scan_step("mlm")
-            (self.params_, self.opt_state_, new_it), losses = step(
+            (self.params_, self.opt_state_, new_it), losses, last_loss = step(
                 (self.params_, self.opt_state_, it), ep,
                 (ids.astype(jnp.int32), input_mask, labels, lmask))
         else:                                            # classification
             step = self._scan_step("cls")
-            (self.params_, self.opt_state_, new_it), losses = step(
+            (self.params_, self.opt_state_, new_it), losses, last_loss = step(
                 (self.params_, self.opt_state_, it), ep,
                 (ids.astype(jnp.int32), input_mask, labels))
-        self._score = losses[-1]
+        self._score = last_loss
         advance(self, new_it, steps=int(k))
         return losses
 
