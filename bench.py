@@ -19,6 +19,11 @@ serving headline).
 (device prefetch + on-device normalization + fused dispatch, no per-step
 sync) against the old synchronous per-batch loop; detail to stderr, one
 stdout JSON line.
+
+`python bench.py --obs [--quick]` A/Bs the telemetry instrumentation
+(monitor registry + spans) enabled vs disabled on that same pipeline loop
+and asserts the overhead stays under 2%; detail to stderr, one stdout JSON
+line.
 """
 import json
 import sys
@@ -377,32 +382,12 @@ def bench_serving(duration_s=3.0, n_clients=16, max_batch=64,
     }
 
 
-def bench_pipeline(n_batches=128, batch=64, fused_steps=16, depth=2,
-                   n_in=784):
-    """A/B the async input pipeline against the old synchronous loop on the
-    SAME ETL-bearing iterator + model (an MLP — dense layers time
-    identically inside and outside `lax.scan` on every backend, so the A/B
-    isolates the pipeline; conv models hit an XLA:CPU while-loop slow path
-    that would swamp it).  Each batch is materialized on demand from raw
-    float64 rows (cast + affine + one-hot), the record-reader shape:
-
-    A (sync): host ETL, host normalization, one dispatch per step, and a
-      blocking `float(score())` read every iteration — host work and
-      device compute strictly serialized, the pre-pipeline loop.
-    B (pipeline): the SAME ETL runs in the `DevicePrefetchIterator`
-      producer thread overlapped with compute (numpy and XLA both release
-      the GIL), staged on device `depth` batches ahead; normalization is
-      folded into the jitted step; fused k-step dispatch; one sync at the
-      end.
-
-    Default config uses small batches: the pipeline's structural win is
-    amortizing per-step host dispatch, which dominates when step compute
-    is short (the TPU regime it targets).  At large CPU batches both
-    sides are compute-bound on the same single core and the A/B reads
-    ~1.0x either way.
-    """
+def _pipeline_fixture(n_batches, batch, n_in):
+    """Shared fixture for `--pipeline` and `--obs`: an ETL-bearing iterator
+    factory, an MLP factory, and a fitted normalizer over deterministic raw
+    float64 rows.  Imports stay inside the function so `main()` can decide
+    JAX_PLATFORMS before jax loads."""
     from deeplearning4j_tpu.data import (DataSet, DataSetIterator,
-                                         DevicePrefetchIterator,
                                          NormalizerStandardize)
     from deeplearning4j_tpu.nn import (DenseLayer, InputType,
                                        MultiLayerNetwork,
@@ -443,12 +428,46 @@ def bench_pipeline(n_batches=128, batch=64, fused_steps=16, depth=2,
     rng = np.random.RandomState(0)
     raw_x = rng.rand(n_batches * batch, n_in) * 100.0       # float64 rows
     raw_y = rng.randint(0, 10, n_batches * batch)
-    nz = NormalizerStandardize().fit(EtlIterator(raw_x, raw_y, batch))
+
+    def make_it():
+        return EtlIterator(raw_x, raw_y, batch)
+
+    nz = NormalizerStandardize().fit(make_it())
+    return make_it, make_net, nz
+
+
+def bench_pipeline(n_batches=128, batch=64, fused_steps=16, depth=2,
+                   n_in=784):
+    """A/B the async input pipeline against the old synchronous loop on the
+    SAME ETL-bearing iterator + model (an MLP — dense layers time
+    identically inside and outside `lax.scan` on every backend, so the A/B
+    isolates the pipeline; conv models hit an XLA:CPU while-loop slow path
+    that would swamp it).  Each batch is materialized on demand from raw
+    float64 rows (cast + affine + one-hot), the record-reader shape:
+
+    A (sync): host ETL, host normalization, one dispatch per step, and a
+      blocking `float(score())` read every iteration — host work and
+      device compute strictly serialized, the pre-pipeline loop.
+    B (pipeline): the SAME ETL runs in the `DevicePrefetchIterator`
+      producer thread overlapped with compute (numpy and XLA both release
+      the GIL), staged on device `depth` batches ahead; normalization is
+      folded into the jitted step; fused k-step dispatch; one sync at the
+      end.
+
+    Default config uses small batches: the pipeline's structural win is
+    amortizing per-step host dispatch, which dominates when step compute
+    is short (the TPU regime it targets).  At large CPU batches both
+    sides are compute-bound on the same single core and the A/B reads
+    ~1.0x either way.
+    """
+    from deeplearning4j_tpu.data import DevicePrefetchIterator
+
+    make_it, make_net, nz = _pipeline_fixture(n_batches, batch, n_in)
 
     net_a = make_net()
 
     def run_sync():
-        for ds in EtlIterator(raw_x, raw_y, batch):
+        for ds in make_it():
             nz.transform(ds)                      # host-side normalize
             net_a.fit(ds.features, ds.labels)     # one dispatch per step
             float(net_a.score())                  # per-iteration sync
@@ -460,8 +479,7 @@ def bench_pipeline(n_batches=128, batch=64, fused_steps=16, depth=2,
 
     net_b = make_net()
     net_b.set_normalizer(nz)                      # on-device prologue
-    pf = DevicePrefetchIterator(EtlIterator(raw_x, raw_y, batch),
-                                depth=depth)
+    pf = DevicePrefetchIterator(make_it(), depth=depth)
     try:
         def run_pipe():
             net_b.fit(pf, fused_steps=fused_steps)
@@ -478,6 +496,65 @@ def bench_pipeline(n_batches=128, batch=64, fused_steps=16, depth=2,
             "pipeline_samples_per_sec": n / t_pipe,
             "n_batches": n_batches, "batch": batch,
             "fused_steps": fused_steps, "prefetch_depth": depth}
+
+
+def bench_obs(n_batches=96, batch=64, fused_steps=8, depth=2, n_in=784,
+              repeats=3):
+    """A/B the telemetry overhead on the `--pipeline` training loop: the
+    SAME instrumented code runs with the registry enabled vs disabled
+    (`monitor.set_enabled`), so the delta is exactly what the PR's
+    instrumentation costs on the hottest loop in the repo (per-dispatch
+    timing + counters in `_fit_batch`/`fit_steps`, prefetch gauges and
+    producer-wait timing in `DevicePrefetchIterator`, the epoch span).
+
+    Each side gets its own net + prefetch iterator, one warmup epoch
+    (compile), then `repeats` measured epochs interleaved on/off so clock
+    drift and cache effects hit both sides equally; min-of-N per side.
+    """
+    from deeplearning4j_tpu.data import DevicePrefetchIterator
+    from deeplearning4j_tpu.monitor import registry, set_enabled
+
+    make_it, make_net, nz = _pipeline_fixture(n_batches, batch, n_in)
+
+    def make_side():
+        net = make_net()
+        net.set_normalizer(nz)                    # on-device prologue
+        return net, DevicePrefetchIterator(make_it(), depth=depth)
+
+    net_on, pf_on = make_side()
+    net_off, pf_off = make_side()
+
+    def epoch(net, pf):
+        t0 = time.perf_counter()
+        net.fit(pf, fused_steps=fused_steps)
+        float(net.score())                        # one sync at the end
+        return time.perf_counter() - t0
+
+    t_on, t_off = [], []
+    try:
+        set_enabled(True)
+        epoch(net_on, pf_on)                      # warmup + compile
+        set_enabled(False)
+        epoch(net_off, pf_off)
+        for _ in range(repeats):
+            set_enabled(True)
+            t_on.append(epoch(net_on, pf_on))
+            set_enabled(False)
+            t_off.append(epoch(net_off, pf_off))
+    finally:
+        set_enabled(True)
+        pf_on.close()
+        pf_off.close()
+
+    best_on, best_off = min(t_on), min(t_off)
+    steps = registry().get("training_steps_total",
+                           {"model": "MultiLayerNetwork"})
+    return {"wall_on_s": best_on, "wall_off_s": best_off,
+            "overhead_pct": (best_on - best_off) / best_off * 100.0,
+            "steps_recorded": steps.value if steps is not None else 0,
+            "n_batches": n_batches, "batch": batch,
+            "fused_steps": fused_steps, "prefetch_depth": depth,
+            "repeats": repeats}
 
 
 def main_pipeline(quick: bool):
@@ -510,6 +587,42 @@ def main_pipeline(quick: bool):
         "pipeline_wall_s": round(r["pipeline_wall_s"], 3),
         "speedup_vs_sync_loop": round(r["speedup"], 2),
     }))
+
+
+def main_obs(quick: bool):
+    """`--obs` mode: telemetry-overhead A/B detail to stderr, ONE stdout
+    JSON line asserting the enabled-path overhead stays under 2%."""
+    import os
+    if not os.environ.get("JAX_PLATFORMS"):
+        # the instrumented loop is backend-agnostic; fall back to CPU
+        # rather than hang on a dead TPU tunnel
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from __graft_entry__ import _probe_backend_device_count
+        if _probe_backend_device_count() < 1:
+            print("[bench] TPU backend unreachable; obs bench on CPU",
+                  file=sys.stderr, flush=True)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = (bench_obs(n_batches=48, repeats=2) if quick else bench_obs())
+    except Exception as e:
+        print(json.dumps({"metric": "telemetry_overhead_pct", "value": None,
+                          "unit": "%", "error": repr(e)[:300]}))
+        sys.exit(1)
+    for k, v in r.items():      # detail to stderr: stdout stays one line
+        print(f"[obs] {k} = {v}", file=sys.stderr, flush=True)
+    ok = r["overhead_pct"] < 2.0 and r["steps_recorded"] > 0
+    print(json.dumps({
+        "metric": "telemetry_overhead_pct",
+        "value": round(r["overhead_pct"], 3),
+        "unit": "%",
+        "threshold_pct": 2.0,
+        "pass": ok,
+        "wall_on_s": round(r["wall_on_s"], 3),
+        "wall_off_s": round(r["wall_off_s"], 3),
+        "steps_recorded": r["steps_recorded"],
+    }))
+    if not ok:
+        sys.exit(1)
 
 
 def main_serving(quick: bool):
@@ -628,6 +741,9 @@ def main():
         return
     if "--pipeline" in sys.argv:
         main_pipeline(quick)
+        return
+    if "--obs" in sys.argv:
+        main_obs(quick)
         return
     n_chips = _wait_for_backend()
     if n_chips == 0:

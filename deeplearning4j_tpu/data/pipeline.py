@@ -28,6 +28,7 @@ H2D DMA with the previous step's compute.
 from __future__ import annotations
 
 import collections
+import time
 from typing import Callable, Optional
 
 import jax
@@ -195,15 +196,35 @@ class DevicePrefetchIterator(DataSetIterator):
             else self.depth)
 
     def __iter__(self):
+        from deeplearning4j_tpu.monitor.instrument import pipeline_instruments
+        ins = pipeline_instruments()
         buf: collections.deque = collections.deque()
         it = iter(self._async)
+        put = self.placement if self.placement is not None else _default_put
+
+        def counting_put(a):
+            # a host array crossing here is one H2D transfer; device arrays
+            # pass through untransferred (see _default_put)
+            if not isinstance(a, jax.Array):
+                ins.h2d_bytes.inc(getattr(a, "nbytes", 0) or 0)
+            return put(a)
+
         try:
-            for ds in it:
-                buf.append(stage(ds, self.placement))
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    ds = next(it)
+                except StopIteration:
+                    break
+                wait = time.perf_counter() - t0
+                buf.append(stage(ds, counting_put))
+                ins.record_stage(wait, len(buf))
                 if len(buf) >= self.depth:
                     yield buf.popleft()
+                    ins.prefetch_depth.set(len(buf))
             while buf:
                 yield buf.popleft()
+                ins.prefetch_depth.set(len(buf))
         finally:
             it.close()          # releases the producer on early break
 

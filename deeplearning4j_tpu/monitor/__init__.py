@@ -1,0 +1,25 @@
+"""Unified process telemetry (docs/observability.md).
+
+One registry answers "what is this process doing right now" across
+training, the input pipeline, data-parallel dispatch and serving — the
+role the reference spreads over StatsListener/StatsStorage, OpProfiler
+and PerformanceTracker (SURVEY.md §5.1), collapsed into:
+
+    registry    — counters / gauges / ring-buffer histograms with
+                  p50/p95/p99, labeled series, thread-safe, near-zero
+                  cost when idle (`set_enabled(False)` kill-switch)
+    spans       — `span("fit_epoch")` host wall-time regions, nested,
+                  forwarded into `jax.profiler.TraceAnnotation` so host
+                  spans line up with the XLA device trace
+    instrument  — cached hot-path handle bundles (training / pipeline /
+                  parallel) and the metric-name contract
+
+Scrape surface: `GET /metrics` on `ui.server.UIServer` (Prometheus text
+format) and a snapshot block on the HTML dashboard; `serving.ServingMetrics`
+is a view over the same registry.
+"""
+from deeplearning4j_tpu.monitor.registry import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, enabled, registry,
+    set_enabled)
+from deeplearning4j_tpu.monitor.spans import (  # noqa: F401
+    current_span, span, span_stack)

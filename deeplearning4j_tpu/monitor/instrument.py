@@ -1,0 +1,177 @@
+"""Cached metric handles for the framework's hot paths.
+
+The registry's get-or-create is a dict lookup under a lock — fine per
+epoch, wasteful per step.  Each instrumented subsystem grabs one of these
+handle bundles ONCE (lazily, on first dispatch) and then records through
+plain attribute access.  All record methods early-out on the global
+telemetry switch, so an instrumented step costs two `perf_counter` reads
+and a flag check when telemetry is off.
+
+Metric naming (the contract `GET /metrics` exposes, see
+docs/observability.md):
+
+  training_step_ms{model=}           per-step host dispatch time
+  training_steps_total{model=}       optimizer steps (fused steps count k)
+  training_dispatches_total{model=}  host->device dispatches (fused = 1)
+  training_compiles_total{model=}    executable-cache fills (trace+compile)
+  training_donated_bytes{model=}     params+state+opt bytes donated per step
+  training_epochs_total{model=}      completed epochs
+  pipeline_prefetch_depth            batches staged on device right now
+  pipeline_producer_wait_ms          consumer wait on the ETL producer
+  pipeline_h2d_bytes_total           bytes staged host->device
+  pipeline_batches_total             batches staged
+  parallel_replicas                  mesh data-parallel degree
+  parallel_dispatch_ms               SPMD step host dispatch time
+  parallel_replica_skew_ms           per-replica completion skew (opt-in)
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from deeplearning4j_tpu.monitor.registry import (MetricsRegistry, enabled,
+                                                 registry)
+
+
+class TrainingInstruments:
+    """Per-model-instance handle bundle over shared labeled series.
+
+    Two instances of the same model class share series (same labels);
+    compile detection state (`_cache_size`) stays per instance because it
+    tracks that instance's jitted step."""
+
+    def __init__(self, model_kind: str,
+                 registry_: Optional[MetricsRegistry] = None):
+        reg = registry_ if registry_ is not None else registry()
+        lbl = {"model": model_kind}
+        self.step_ms = reg.histogram(
+            "training_step_ms", help="host dispatch wall time per training "
+            "step (ms; async — excludes device completion)", labels=lbl)
+        self.steps = reg.counter(
+            "training_steps_total", help="optimizer steps run", labels=lbl)
+        self.dispatches = reg.counter(
+            "training_dispatches_total",
+            help="host->device step dispatches (a fused k-step scan is 1)",
+            labels=lbl)
+        self.compiles = reg.counter(
+            "training_compiles_total",
+            help="compiled-executable cache fills (trace + XLA compile)",
+            labels=lbl)
+        self.donated_bytes = reg.gauge(
+            "training_donated_bytes",
+            help="bytes of params/state/opt-state donated per step "
+            "(sampled at compile events)", labels=lbl)
+        self.epochs = reg.counter(
+            "training_epochs_total", help="completed epochs", labels=lbl)
+        self._cache_sizes: dict = {}
+
+    def record_dispatch(self, dt_s: float, steps: int = 1) -> None:
+        """One host dispatch of `steps` optimizer steps taking `dt_s`
+        host seconds (dispatch time — the device may still be running)."""
+        if not enabled():
+            return
+        self.steps.inc(steps)
+        self.dispatches.inc()
+        self.step_ms.observe(dt_s * 1000.0 / max(steps, 1))
+
+    def check_compile(self, jit_fn, model=None) -> None:
+        """Detect executable-cache growth on the model's jitted step — each
+        fill is one trace+compile event (a new input shape/dtype or a step
+        rebuild).  On a compile event, sample the donated-buffer footprint
+        (params/state/opt-state leaves) so HBM reuse is visible; walking
+        the tree only on compile events keeps the steady state free of it."""
+        if not enabled() or jit_fn is None:
+            return
+        try:
+            n = jit_fn._cache_size()
+        except Exception:      # non-jit callable (e.g. scan wrapper fn)
+            return
+        key = id(jit_fn)       # a rebuilt step (set_normalizer) is a new fn
+        prev = self._cache_sizes.get(key, 0)
+        if n == prev:
+            return
+        if n > prev:
+            self.compiles.inc(n - prev)
+            if model is not None:
+                self.donated_bytes.set(_donated_nbytes(model))
+        self._cache_sizes[key] = n
+
+    def record_epoch(self) -> None:
+        if not enabled():
+            return
+        self.epochs.inc()
+
+
+def _donated_nbytes(model) -> int:
+    import jax
+    total = 0
+    for tree in (getattr(model, "params_", None),
+                 getattr(model, "state_", None),
+                 getattr(model, "opt_state_", None)):
+        if tree is None:
+            continue
+        for leaf in jax.tree_util.tree_leaves(tree):
+            total += getattr(leaf, "nbytes", 0) or 0
+    return total
+
+
+class PipelineInstruments:
+    """Input-pipeline handles (one unlabeled series set per process — the
+    prefetch iterators all feed the same trainer)."""
+
+    def __init__(self, registry_: Optional[MetricsRegistry] = None):
+        reg = registry_ if registry_ is not None else registry()
+        self.prefetch_depth = reg.gauge(
+            "pipeline_prefetch_depth",
+            help="batches currently staged on device ahead of the consumer")
+        self.producer_wait_ms = reg.histogram(
+            "pipeline_producer_wait_ms",
+            help="time the consumer waited on the ETL producer per batch "
+            "(ms); sustained >0 means ETL is the bottleneck")
+        self.h2d_bytes = reg.counter(
+            "pipeline_h2d_bytes_total",
+            help="bytes staged host->device by the input pipeline")
+        self.batches = reg.counter(
+            "pipeline_batches_total", help="batches staged to device")
+
+    def record_stage(self, wait_s: float, depth: int) -> None:
+        if not enabled():
+            return
+        self.producer_wait_ms.observe(wait_s * 1000.0)
+        self.prefetch_depth.set(depth)
+        self.batches.inc()
+
+
+class ParallelInstruments:
+    """Data-parallel wrapper handles."""
+
+    def __init__(self, registry_: Optional[MetricsRegistry] = None):
+        reg = registry_ if registry_ is not None else registry()
+        self.replicas = reg.gauge(
+            "parallel_replicas", help="mesh data-parallel degree")
+        self.dispatch_ms = reg.histogram(
+            "parallel_dispatch_ms",
+            help="SPMD step host dispatch wall time (ms)")
+        self.replica_skew_ms = reg.gauge(
+            "parallel_replica_skew_ms",
+            help="latest measured per-replica completion skew (ms; "
+            "blocking diagnostic, see ParallelWrapper.measure_replica_skew)")
+
+    def record_dispatch(self, dt_s: float) -> None:
+        if not enabled():
+            return
+        self.dispatch_ms.observe(dt_s * 1000.0)
+
+
+_pipeline: Optional[PipelineInstruments] = None
+
+
+def pipeline_instruments() -> PipelineInstruments:
+    """Process-wide pipeline handle bundle (lazy singleton)."""
+    global _pipeline
+    if _pipeline is None:
+        _pipeline = PipelineInstruments()
+    return _pipeline
+
+
+perf_counter = time.perf_counter   # re-export: hot paths import one name

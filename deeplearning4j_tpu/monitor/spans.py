@@ -1,0 +1,99 @@
+"""Host-side span tracing aligned with the XLA device trace.
+
+`span("fit_epoch")` times a host region into the registry's `span_ms`
+histogram (one labeled series per span path, nesting encoded as
+`"fit_epoch/fit_step"`) AND forwards the same name into
+`jax.profiler.TraceAnnotation`, so when an XProf/TensorBoard device trace
+is being captured (`utils.profiling.trace`) the host span shows up as a
+named region on the host timeline directly above the XLA device ops it
+enqueued — the correlation the reference's OpProfiler could never do
+because it only saw per-op host timings.
+
+Nesting is thread-local: concurrent threads (trainer, prefetch producer,
+serving worker) each carry their own span stack, and a child records under
+`parent/child` so the registry distinguishes "compile inside the first
+epoch" from "compile at serving warmup".
+
+Cost when telemetry is off (`monitor.set_enabled(False)`): one flag check —
+no clock read, no TraceAnnotation, no allocation beyond the context-manager
+object itself.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from deeplearning4j_tpu.monitor.registry import (MetricsRegistry, enabled,
+                                                 registry)
+
+try:                                # jax is a hard dep of the package, but
+    from jax.profiler import TraceAnnotation as _TraceAnnotation
+except Exception:                   # pragma: no cover - keep monitor usable
+    _TraceAnnotation = None         # in stripped-down environments
+
+_local = threading.local()
+
+
+def span_stack() -> List[str]:
+    """This thread's active span paths, outermost first."""
+    st = getattr(_local, "stack", None)
+    if st is None:
+        st = _local.stack = []
+    return st
+
+
+def current_span() -> Optional[str]:
+    st = getattr(_local, "stack", None)
+    return st[-1] if st else None
+
+
+class span:
+    """Context manager: `with span("fit_epoch"):` records host wall time of
+    the region into `span_ms{span="<path>"}` and annotates the device
+    trace.  Extra labels ride along (`span("dispatch", model="lenet")`).
+
+    Re-entrant per instance is NOT supported (construct per use); nesting
+    different instances is the point."""
+
+    __slots__ = ("name", "_labels", "_registry", "_t0", "_path", "_ann")
+
+    def __init__(self, name: str, registry_: Optional[MetricsRegistry] = None,
+                 **labels):
+        self.name = name
+        self._labels = labels
+        self._registry = registry_
+        self._t0 = None
+        self._path = None
+        self._ann = None
+
+    def __enter__(self) -> "span":
+        if not enabled():
+            return self
+        st = span_stack()
+        self._path = f"{st[-1]}/{self.name}" if st else self.name
+        st.append(self._path)
+        if _TraceAnnotation is not None:
+            self._ann = _TraceAnnotation(self.name)
+            self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._t0 is None:
+            return False
+        dt_ms = (time.perf_counter() - self._t0) * 1000.0
+        if self._ann is not None:
+            self._ann.__exit__(exc_type, exc, tb)
+            self._ann = None
+        st = span_stack()
+        if st and st[-1] == self._path:
+            st.pop()
+        reg = self._registry if self._registry is not None else registry()
+        labels = {"span": self._path}
+        if self._labels:
+            labels.update(self._labels)
+        reg.histogram("span_ms", help="host wall time of traced spans (ms)",
+                      labels=labels).observe(dt_ms)
+        self._t0 = None
+        return False

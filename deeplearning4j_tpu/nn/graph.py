@@ -17,12 +17,15 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.monitor.instrument import TrainingInstruments
+from deeplearning4j_tpu.monitor.spans import span
 from deeplearning4j_tpu.nn.core import InputType, Layer, PyTree
 from deeplearning4j_tpu.nn.multilayer import _add_scaled_where, _masked_leaves
 from deeplearning4j_tpu.train.updaters import (
@@ -464,6 +467,13 @@ class ComputationGraph:
         self._output_fn = None
         self._vertex_types: Dict[str, InputType] = {}
         self._device_norm: Dict[str, Any] = {}  # input name -> DeviceNormalizer
+        self._instr: Optional[TrainingInstruments] = None
+
+    def _instruments(self) -> TrainingInstruments:
+        """Lazy telemetry handles shared via the monitor registry."""
+        if self._instr is None:
+            self._instr = TrainingInstruments(type(self).__name__)
+        return self._instr
 
     def _layer_of(self, name: str) -> Optional[Layer]:
         v = self.conf.vertices[name]
@@ -678,10 +688,14 @@ class ComputationGraph:
             + [(f"labels_mask {i}", m) for i, m in enumerate(lmasks or [])])
         step = self._get_scan_step()
         it_dev, ep_dev = device_counters(self)
+        t0 = time.perf_counter()
         ((self.params_, self.state_, self.opt_state_, self._rng, new_it),
          losses, last_loss) = step((self.params_, self.state_,
                                     self.opt_state_, self._rng, it_dev),
                                    ep_dev, (inputs, labels, lmasks))
+        ins = self._instruments()
+        ins.record_dispatch(time.perf_counter() - t0, steps=int(k))
+        ins.check_compile(step, self)
         self._score = last_loss
         self._last_batch_size = int(next(iter(inputs.values())).shape[1])
         advance(self, new_it, steps=int(k))
@@ -724,12 +738,14 @@ class ComputationGraph:
         for _ in range(epochs):
             if hasattr(data, "reset"):
                 data.reset()
-            if fused_steps > 1:
-                self._fit_epoch_fused(data, fused_steps)
-            else:
-                for ds in data:
-                    self._fit_dataset(ds)
+            with span("fit_epoch", model=type(self).__name__):
+                if fused_steps > 1:
+                    self._fit_epoch_fused(data, fused_steps)
+                else:
+                    for ds in data:
+                        self._fit_dataset(ds)
             self.epoch += 1
+            self._instruments().record_epoch()
             for lst in self.listeners:
                 if hasattr(lst, "on_epoch_end"):
                     lst.on_epoch_end(self)
@@ -780,10 +796,14 @@ class ComputationGraph:
         from deeplearning4j_tpu.utils.counters import advance, device_counters
         step = self._get_train_step()
         it_dev, ep_dev = device_counters(self)
+        t0 = time.perf_counter()
         (self.params_, self.state_, self.opt_state_, loss, self._rng,
          new_it) = step(
             self.params_, self.state_, self.opt_state_, inputs, labels,
             lmasks, self._rng, it_dev, ep_dev)
+        ins = self._instruments()
+        ins.record_dispatch(time.perf_counter() - t0)
+        ins.check_compile(step, self)
         self._score = loss
         self._last_batch_size = int(next(iter(inputs.values())).shape[0])
         advance(self, new_it)

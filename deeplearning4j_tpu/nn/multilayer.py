@@ -18,12 +18,15 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.monitor.instrument import TrainingInstruments
+from deeplearning4j_tpu.monitor.spans import span
 from deeplearning4j_tpu.nn.core import InputType, Layer, PyTree
 from deeplearning4j_tpu.train.updaters import (
     IUpdater, Sgd, apply_gradient_normalization)
@@ -240,6 +243,14 @@ class MultiLayerNetwork:
         self._output_fn = None
         self._layer_types: List[InputType] = []
         self._device_norm = None   # on-device normalizer prologue (pipeline)
+        self._instr: Optional[TrainingInstruments] = None
+
+    def _instruments(self) -> TrainingInstruments:
+        """Lazy telemetry handles (monitor registry series labeled by
+        model kind) — created on first dispatch, shared series thereafter."""
+        if self._instr is None:
+            self._instr = TrainingInstruments(type(self).__name__)
+        return self._instr
 
     # ---- init ----
     def init(self) -> "MultiLayerNetwork":
@@ -492,10 +503,14 @@ class MultiLayerNetwork:
             batch_n = int(xs.shape[1])
         step = self._get_scan_step()
         it_dev, ep_dev = device_counters(self)
+        t0 = time.perf_counter()
         ((self.params_, self.state_, self.opt_state_, self._rng, new_it),
          losses, last_loss) = step((self.params_, self.state_,
                                     self.opt_state_, self._rng, it_dev),
                                    ep_dev, batches)
+        ins = self._instruments()
+        ins.record_dispatch(time.perf_counter() - t0, steps=k)
+        ins.check_compile(step, self)
         self._score = last_loss
         self._last_batch_size = batch_n
         advance(self, new_it, steps=k)
@@ -525,12 +540,14 @@ class MultiLayerNetwork:
         for _ in range(epochs):
             if hasattr(data, "reset"):
                 data.reset()
-            if fused_steps > 1:
-                self._fit_epoch_fused(data, fused_steps)
-            else:
-                for ds in data:
-                    self._fit_dataset(ds)
+            with span("fit_epoch", model=type(self).__name__):
+                if fused_steps > 1:
+                    self._fit_epoch_fused(data, fused_steps)
+                else:
+                    for ds in data:
+                        self._fit_dataset(ds)
             self.epoch += 1
+            self._instruments().record_epoch()
             for lst in self.listeners:
                 if hasattr(lst, "on_epoch_end"):
                     lst.on_epoch_end(self)
@@ -561,10 +578,14 @@ class MultiLayerNetwork:
         from deeplearning4j_tpu.utils.counters import advance, device_counters
         step = self._get_train_step()
         it_dev, ep_dev = device_counters(self)
+        t0 = time.perf_counter()
         (self.params_, self.state_, self.opt_state_, loss, self._rng,
          new_it) = step(
             self.params_, self.state_, self.opt_state_, x, y, fmask, lmask,
             self._rng, it_dev, ep_dev)
+        ins = self._instruments()
+        ins.record_dispatch(time.perf_counter() - t0)
+        ins.check_compile(step, self)
         self._score = loss
         self._last_batch_size = int(x.shape[0])
         advance(self, new_it)

@@ -16,6 +16,7 @@ async-compressed-delta is deliberate and documented (BASELINE north star).
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -23,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.monitor.instrument import ParallelInstruments
 from deeplearning4j_tpu.parallel.mesh import make_mesh
 from deeplearning4j_tpu.parallel.sharding import ShardingRules, shard_model_params
 
@@ -102,6 +104,12 @@ class ParallelWrapper:
         self.training_mode = training_mode
         self._rules = sharding_rules
         self._placed = False
+        self._instr: Optional[ParallelInstruments] = None
+
+    def _instruments(self) -> ParallelInstruments:
+        if self._instr is None:
+            self._instr = ParallelInstruments()
+        return self._instr
 
     # ---- builder (reference ParallelWrapper.Builder) ----
     class Builder:
@@ -167,6 +175,7 @@ class ParallelWrapper:
             m.opt_state_ = _shard_opt_state_like(m.opt_state_, m.params_,
                                                  self.mesh)
         self._placed = True
+        self._instruments().replicas.set(self.mesh.shape[self.data_axis])
 
     # ---- training ----
     def _fit_ds(self, ds):
@@ -189,11 +198,14 @@ class ParallelWrapper:
             y = [shard(l) for l in ds.labels]
             lm = [shard(mk) for mk in ds.labels_masks] \
                 if ds.labels_masks is not None else None
+            t0 = time.perf_counter()
             with self.mesh:
                 m._fit_batch(m._as_input_dict(x), y, lm)
+            self._instruments().record_dispatch(time.perf_counter() - t0)
         else:
             fm = getattr(ds, "features_mask", None)
             lm = shard(getattr(ds, "labels_mask", None))
+            t0 = time.perf_counter()
             with self.mesh:
                 if hasattr(m, "_as_input_dict"):   # CG fed single-input DS
                     if fm is not None:
@@ -206,6 +218,7 @@ class ParallelWrapper:
                 else:
                     m.fit(shard(ds.features), shard(ds.labels),
                           features_mask=shard(fm), labels_mask=lm)
+            self._instruments().record_dispatch(time.perf_counter() - t0)
 
     def fit(self, data, labels=None, *, epochs: int = 1):
         """fit(x, y), fit(DataSet/MultiDataSet), or fit(iterator, epochs=N):
@@ -271,8 +284,35 @@ class ParallelWrapper:
         self._place_model()
         xs = _shard_batch(xs, self.mesh, self.data_axis, batch_dim=1)
         ys = _shard_batch(ys, self.mesh, self.data_axis, batch_dim=1)
+        t0 = time.perf_counter()
         with self.mesh:
-            return self.model.fit_steps(xs, ys)
+            out = self.model.fit_steps(xs, ys)
+        self._instruments().record_dispatch(time.perf_counter() - t0)
+        return out
+
+    def measure_replica_skew(self) -> float:
+        """Opt-in BLOCKING diagnostic: wait for each addressable shard of
+        the latest step output (falling back to the first param leaf) and
+        report max-min arrival spread in ms, also recorded in the
+        `parallel_replica_skew_ms` gauge.  Shards are polled sequentially,
+        so this under-reports true skew for replicas that finish while an
+        earlier one is being waited on — a cheap imbalance smoke signal,
+        not a profiler.  Never call it inside the hot loop: it closes the
+        async-dispatch window the step loop works to keep open."""
+        arr = getattr(self.model, "_score", None)
+        if arr is None or not hasattr(arr, "addressable_shards"):
+            leaves = jax.tree_util.tree_leaves(self.model.params_)
+            arr = leaves[0] if leaves else None
+        if arr is None or not hasattr(arr, "addressable_shards"):
+            return 0.0
+        waits = []
+        for sh in arr.addressable_shards:
+            t0 = time.perf_counter()
+            jax.block_until_ready(sh.data)
+            waits.append((time.perf_counter() - t0) * 1000.0)
+        skew = max(waits) - min(waits) if waits else 0.0
+        self._instruments().replica_skew_ms.set(skew)
+        return skew
 
     def fit_host_local(self, features, labels):
         """Multi-host fit: every process passes its *local* slice of the

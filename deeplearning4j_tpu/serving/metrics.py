@@ -1,4 +1,4 @@
-"""SLO metrics for the serving runtime.
+"""SLO metrics for the serving runtime — a view over the shared registry.
 
 What an operator needs to hold a latency SLO on a batched-inference
 service: end-to-end request latency percentiles (p50/p95/p99 — the queue
@@ -10,59 +10,59 @@ and compile-cache hit/miss (a miss is a multi-second XLA compile — the
 single worst tail-latency event in the system, which is why the registry
 warms buckets up front).
 
-Everything is host-side and thread-safe; recording is O(1) per event so
-the batcher's dispatch loop never blocks on metrics.
+Since the unified-telemetry refactor this class keeps NO private store:
+every counter/gauge/histogram is a child of the process-wide
+`monitor.MetricsRegistry`, labeled `server="<instance>"` so concurrent
+ModelServers stay distinct while landing in ONE scrape surface
+(`GET /metrics` on ui.server.UIServer).  The recording API and
+`snapshot()` shape are unchanged; recording stays O(1) per event so the
+batcher's dispatch loop never blocks on metrics.
 """
 from __future__ import annotations
 
-import threading
-from collections import deque
-from typing import Dict, List, Optional
+import itertools
+from typing import Dict, Optional
 
-from deeplearning4j_tpu.utils.counters import HitMissCounters, StatCounter
-
-
-def _percentile(sorted_vals: List[float], p: float) -> float:
-    """Nearest-rank percentile over an already-sorted sample list."""
-    if not sorted_vals:
-        return float("nan")
-    k = max(0, min(len(sorted_vals) - 1,
-                   int(round(p / 100.0 * (len(sorted_vals) - 1)))))
-    return sorted_vals[k]
+from deeplearning4j_tpu.monitor.registry import (Histogram, MetricsRegistry,
+                                                 registry)
+from deeplearning4j_tpu.utils.counters import HitMissCounters
 
 
 class LatencyWindow:
     """Sliding-window latency sample (last `maxlen` requests) plus
-    lifetime count/total.  A bounded window keeps percentile cost and
-    memory flat under sustained traffic; lifetime aggregates survive the
-    window for throughput accounting."""
+    lifetime count/total — now a thin view over a registry
+    `monitor.Histogram` (same nearest-rank percentiles, same bounded
+    memory), kept for its serving-flavored API."""
 
-    def __init__(self, maxlen: int = 4096):
-        self._samples: deque = deque(maxlen=maxlen)
-        self._lock = threading.Lock()
-        self.count = 0
-        self.total_ms = 0.0
-        self.max_ms = 0.0
+    def __init__(self, maxlen: int = 4096,
+                 histogram: Optional[Histogram] = None):
+        self._h = histogram if histogram is not None \
+            else Histogram("latency_ms", maxlen=maxlen)
 
     def record(self, ms: float) -> None:
-        with self._lock:
-            self._samples.append(ms)
-            self.count += 1
-            self.total_ms += ms
-            if ms > self.max_ms:
-                self.max_ms = ms
+        self._h.observe(ms)
+
+    @property
+    def count(self) -> int:
+        return self._h.count
+
+    @property
+    def total_ms(self) -> float:
+        return self._h.sum
+
+    @property
+    def max_ms(self) -> float:
+        return self._h.max
 
     def percentiles(self, ps=(50, 95, 99)) -> Dict[str, float]:
-        with self._lock:
-            s = sorted(self._samples)
-        return {f"p{p}": _percentile(s, p) for p in ps}
+        return self._h.percentiles(ps)
 
     def snapshot(self) -> Dict[str, float]:
         out = self.percentiles()
-        with self._lock:
-            out["count"] = self.count
-            out["mean"] = self.total_ms / self.count if self.count else 0.0
-            out["max"] = self.max_ms
+        n = self._h.count
+        out["count"] = n
+        out["mean"] = self._h.sum / n if n else 0.0
+        out["max"] = self._h.max
         return out
 
 
@@ -70,48 +70,84 @@ class ServingMetrics:
     """One metrics hub shared by batcher + compile cache + server.
 
     Exposed through `snapshot()` (a plain JSON-able dict), the UI server's
-    `/serving` endpoint, and `ui.stats.render_serving_html`.
+    `/serving` endpoint, `ui.stats.render_serving_html`, and — as labeled
+    series in the shared registry — the Prometheus `/metrics` endpoint.
     """
 
-    def __init__(self, window: int = 4096):
-        self._lock = threading.Lock()
-        self.latency = LatencyWindow(window)          # enqueue -> result, ms
-        self.dispatch_latency = LatencyWindow(window)  # device dispatch, ms
-        self.cache = HitMissCounters("compile_cache")
-        self.submitted = StatCounter("submitted")
-        self.rejected = StatCounter("rejected")        # load-shed (queue full)
-        self.expired = StatCounter("expired")          # deadline passed
-        self.failed = StatCounter("failed")            # dispatch raised
-        self.completed = StatCounter("completed")
-        self.dispatches = StatCounter("dispatches")
+    _ids = itertools.count()
+
+    def __init__(self, window: int = 4096,
+                 registry_: Optional[MetricsRegistry] = None,
+                 server_label: Optional[str] = None):
+        reg = registry_ if registry_ is not None else registry()
+        self.registry = reg
+        self.server_label = server_label if server_label is not None \
+            else f"s{next(ServingMetrics._ids)}"
+        lbl = {"server": self.server_label}
+        self.latency = LatencyWindow(histogram=reg.histogram(
+            "serving_latency_ms",
+            help="end-to-end request latency, enqueue->result (ms)",
+            labels=lbl, maxlen=window))          # enqueue -> result, ms
+        self.dispatch_latency = LatencyWindow(histogram=reg.histogram(
+            "serving_dispatch_ms", help="device dispatch wall time (ms)",
+            labels=lbl, maxlen=window))           # device dispatch, ms
+        self.cache = HitMissCounters(
+            "compile_cache",
+            hits=reg.counter("serving_compile_cache_hits_total",
+                             help="AOT compile-cache hits", labels=lbl),
+            misses=reg.counter("serving_compile_cache_misses_total",
+                               help="AOT compile-cache misses (one XLA "
+                               "compile each)", labels=lbl))
+        c = reg.counter
+        self.submitted = c("serving_submitted_total",
+                           help="requests admitted to the queue", labels=lbl)
+        self.rejected = c("serving_rejected_total",
+                          help="requests shed at admission (queue full / "
+                          "shutdown)", labels=lbl)
+        self.expired = c("serving_expired_total",
+                         help="requests whose deadline passed in queue",
+                         labels=lbl)
+        self.failed = c("serving_failed_total",
+                        help="requests failed in dispatch", labels=lbl)
+        self.completed = c("serving_completed_total",
+                           help="requests completed", labels=lbl)
+        self.dispatches = c("serving_dispatches_total",
+                            help="device dispatches", labels=lbl)
         # dispatch-shape aggregates (occupancy / padding accounting)
-        self._requests_dispatched = 0
-        self._rows_dispatched = 0
-        self._rows_padded = 0
-        self._queue_depth = 0
-        self._queue_depth_peak = 0
+        self._requests_dispatched = c(
+            "serving_requests_dispatched_total",
+            help="requests that reached a device dispatch", labels=lbl)
+        self._rows_dispatched = c(
+            "serving_rows_dispatched_total",
+            help="real rows dispatched", labels=lbl)
+        self._rows_padded = c(
+            "serving_rows_padded_total",
+            help="bucket padding rows dispatched", labels=lbl)
+        self._queue_depth = reg.gauge(
+            "serving_queue_depth", help="requests waiting in the batcher "
+            "queue", labels=lbl)
+        self._queue_depth_peak = reg.gauge(
+            "serving_queue_depth_peak", help="high-water mark of the "
+            "batcher queue", labels=lbl)
 
     # ---- recording hooks (called by batcher / cache / server) ----
     def record_submit(self, queue_depth: int) -> None:
         self.submitted.inc()
-        with self._lock:
-            self._queue_depth = queue_depth
-            if queue_depth > self._queue_depth_peak:
-                self._queue_depth_peak = queue_depth
+        self._queue_depth.set(queue_depth)
+        self._queue_depth_peak.set_max(queue_depth)
 
     def record_queue_depth(self, queue_depth: int) -> None:
-        with self._lock:
-            self._queue_depth = queue_depth
+        self._queue_depth.set(queue_depth)
 
     def record_dispatch(self, n_requests: int, rows: int,
                         padded_rows: int = 0,
                         dispatch_ms: Optional[float] = None) -> None:
         self.dispatches.inc()
         self.completed.inc(n_requests)
-        with self._lock:
-            self._requests_dispatched += n_requests
-            self._rows_dispatched += rows
-            self._rows_padded += padded_rows
+        self._requests_dispatched.inc(n_requests)
+        self._rows_dispatched.inc(rows)
+        if padded_rows:
+            self._rows_padded.inc(padded_rows)
         if dispatch_ms is not None:
             self.dispatch_latency.record(dispatch_ms)
 
@@ -119,37 +155,32 @@ class ServingMetrics:
         self.latency.record(ms)
 
     def record_padding(self, rows: int) -> None:
-        with self._lock:
-            self._rows_padded += rows
+        if rows:
+            self._rows_padded.inc(rows)
 
     # ---- derived views ----
     @property
     def mean_batch_occupancy(self) -> float:
         """Requests per device dispatch — > 1 means batching is working."""
-        with self._lock:
-            d = self.dispatches.value
-            return self._requests_dispatched / d if d else 0.0
+        d = self.dispatches.value
+        return self._requests_dispatched.value / d if d else 0.0
 
     @property
     def padding_fraction(self) -> float:
         """Fraction of dispatched rows that were bucket padding."""
-        with self._lock:
-            total = self._rows_dispatched + self._rows_padded
-            return self._rows_padded / total if total else 0.0
+        total = self._rows_dispatched.value + self._rows_padded.value
+        return self._rows_padded.value / total if total else 0.0
 
     def snapshot(self) -> Dict[str, object]:
-        with self._lock:
-            requests_dispatched = self._requests_dispatched
-            rows = self._rows_dispatched
-            padded = self._rows_padded
-            depth = self._queue_depth
-            peak = self._queue_depth_peak
+        requests_dispatched = self._requests_dispatched.value
+        rows = self._rows_dispatched.value
+        padded = self._rows_padded.value
         d = self.dispatches.value
         return {
             "latency_ms": self.latency.snapshot(),
             "dispatch_ms": self.dispatch_latency.snapshot(),
-            "queue_depth": depth,
-            "queue_depth_peak": peak,
+            "queue_depth": int(self._queue_depth.value),
+            "queue_depth_peak": int(self._queue_depth_peak.value),
             "submitted": self.submitted.value,
             "completed": self.completed.value,
             "rejected": self.rejected.value,

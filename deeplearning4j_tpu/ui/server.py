@@ -16,9 +16,11 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
+from deeplearning4j_tpu.monitor.registry import registry
 from deeplearning4j_tpu.ui.stats import (FileStatsStorage,
                                           InMemoryStatsStorage,
                                           render_html,
+                                          render_registry_html,
                                           render_serving_html)
 
 
@@ -83,6 +85,12 @@ class UIServer:
                 out.append({"error": repr(e)})
         return out
 
+    def _registry_html(self) -> str:
+        snap = registry().snapshot(bins=24)
+        if not (snap["counters"] or snap["gauges"] or snap["histograms"]):
+            return ""
+        return render_registry_html(snap)
+
     def _render(self) -> str:
         storages = list(self._storages)
         for p in self._paths:
@@ -92,13 +100,21 @@ class UIServer:
                 pass                     # run not started yet
         serving = "\n<hr/>\n".join(
             render_serving_html(s) for s in self._serving_snapshots())
+        reg = self._registry_html()
+        if reg:
+            serving = serving + "\n<hr/>\n" + reg if serving else reg
         if not storages:
-            if not serving:
-                return ("<html><body><h1>deeplearning4j_tpu UI</h1>"
-                        "<p>No StatsStorage attached.</p></body></html>")
+            # nothing attached: keep the notice even when the registry
+            # block has process-wide metrics to show below it
+            notice = ("<h1>deeplearning4j_tpu UI</h1>"
+                      "<p>No StatsStorage attached.</p>"
+                      if not self._serving else "")
+            if not serving and notice:
+                return f"<html><body>{notice}</body></html>"
             html = ("<html><head><title>deeplearning4j_tpu serving</title>"
                     "<style>body{font-family:sans-serif;margin:24px}"
-                    "</style></head><body>" + serving + "</body></html>")
+                    "</style></head><body>" + notice + serving
+                    + "</body></html>")
         else:
             html = "\n<hr/>\n".join(render_html(s) for s in storages)
             if serving:
@@ -118,7 +134,11 @@ class UIServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):          # noqa: N802 (stdlib API)
-                if self.path.rstrip("/") == "/serving":
+                if self.path.rstrip("/") == "/metrics":
+                    # Prometheus text exposition of the process registry
+                    body = registry().render_prometheus().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path.rstrip("/") == "/serving":
                     # machine-readable SLO metrics (scrape endpoint)
                     body = json.dumps(ui._serving_snapshots()).encode()
                     ctype = "application/json"

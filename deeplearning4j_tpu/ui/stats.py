@@ -379,3 +379,42 @@ def render_serving_html(snapshot: Dict) -> str:
         parts.append(row("buckets", str(snapshot["buckets"])))
     parts.append("</table>")
     return "\n".join(parts)
+
+
+def render_registry_html(snapshot: Dict) -> str:
+    """One HTML section for a `monitor.MetricsRegistry.snapshot(bins=N)`
+    dict: counter/gauge tables plus a window-distribution bar chart per
+    histogram series — the human-readable twin of the Prometheus
+    `GET /metrics` endpoint (ui.server.UIServer serves both)."""
+    parts = ["<h2>Telemetry registry</h2>"]
+
+    def table(title: str, data: Dict, fmt) -> None:
+        if not data:
+            return
+        parts.append(f"<h4>{title}</h4><table>")
+        for key, v in sorted(data.items()):
+            parts.append(f'<tr><td style="padding:2px 12px 2px 0">'
+                         f'<code>{key}</code></td><td><b>{fmt(v)}</b>'
+                         f'</td></tr>')
+        parts.append("</table>")
+
+    table("Counters", snapshot.get("counters", {}), lambda v: f"{v:g}")
+    table("Gauges", snapshot.get("gauges", {}), lambda v: f"{v:.6g}")
+    hists = snapshot.get("histograms", {})
+    if hists:
+        parts.append("<h4>Histograms (sliding window)</h4>")
+        for key, h in sorted(hists.items()):
+            parts.append(
+                f"<h5><code>{key}</code> — n={h.get('count', 0)} "
+                f"p50={h.get('p50', 0.0):.3g} p95={h.get('p95', 0.0):.3g} "
+                f"p99={h.get('p99', 0.0):.3g} max={h.get('max', 0.0):.3g}"
+                "</h5>")
+            b = h.get("bins")
+            if b and any(b.get("counts", [])):
+                parts.append(
+                    f'<div style="font-size:12px;color:#666">'
+                    f'[{b["lo"]:.3g}, {b["hi"]:.3g}]</div>')
+                parts.append(_svg_bars(b["counts"], height=60))
+    if len(parts) == 1:
+        parts.append("<p>No metrics recorded yet.</p>")
+    return "\n".join(parts)

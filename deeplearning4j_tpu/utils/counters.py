@@ -11,10 +11,11 @@ BertModel.
 """
 from __future__ import annotations
 
-import threading
-from typing import Dict
+from typing import Dict, Optional
 
 import jax.numpy as jnp
+
+from deeplearning4j_tpu.monitor.registry import Counter, registry
 
 
 def device_counters(model):
@@ -51,42 +52,32 @@ def advance(model, new_iter_dev, steps: int = 1) -> None:
 # Host-side event counters (serving / cache instrumentation)
 # ---------------------------------------------------------------------------
 
-class StatCounter:
+class StatCounter(Counter):
     """Thread-safe monotonically increasing host counter.  Unlike the
     device counters above these never touch the accelerator — they count
     host-side events (cache hits, rejected requests, dispatches) read by
-    the metrics/UI layer from arbitrary threads."""
+    the metrics/UI layer from arbitrary threads.
 
-    def __init__(self, name: str = "counter"):
-        self.name = name
-        self._value = 0
-        self._lock = threading.Lock()
-
-    def inc(self, n: int = 1) -> int:
-        with self._lock:
-            self._value += n
-            return self._value
-
-    @property
-    def value(self) -> int:
-        with self._lock:
-            return self._value
-
-    def reset(self) -> None:
-        with self._lock:
-            self._value = 0
+    Now a thin alias of `monitor.Counter`, so ad-hoc counters and
+    registry-managed series share ONE implementation (and one source of
+    truth: a StatCounter obtained from `monitor.registry()` IS the series
+    `/metrics` exposes)."""
 
     def __repr__(self) -> str:   # pragma: no cover - debug aid
         return f"StatCounter({self.name}={self.value})"
 
 
 class HitMissCounters:
-    """Paired hit/miss counters for a cache (serving compile cache &c.)."""
+    """Paired hit/miss counters for a cache (serving compile cache &c.).
+    Pass pre-built counters (e.g. registry children with a `server` label)
+    to make the pair a view over the shared MetricsRegistry."""
 
-    def __init__(self, name: str = "cache"):
+    def __init__(self, name: str = "cache", hits: Optional[Counter] = None,
+                 misses: Optional[Counter] = None):
         self.name = name
-        self.hits = StatCounter(f"{name}.hits")
-        self.misses = StatCounter(f"{name}.misses")
+        self.hits = hits if hits is not None else StatCounter(f"{name}.hits")
+        self.misses = misses if misses is not None \
+            else StatCounter(f"{name}.misses")
 
     def hit(self) -> None:
         self.hits.inc()
@@ -111,5 +102,9 @@ class HitMissCounters:
 
 # Process-wide diagnostic: fresh H2D schedule-counter uploads.  A sync-free
 # steady-state loop uploads once per model (+ once per epoch bump) and then
-# stays flat — tests/test_input_pipeline.py pins this invariant.
-counter_uploads = StatCounter("device_counter_uploads")
+# stays flat — tests/test_input_pipeline.py pins this invariant.  Lives in
+# the shared MetricsRegistry, so the same count the invariant test reads is
+# what `GET /metrics` exposes (one source of truth).
+counter_uploads = registry().counter(
+    "device_counter_uploads_total",
+    help="fresh H2D schedule-counter uploads (sync-free loops stay flat)")
