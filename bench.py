@@ -24,6 +24,12 @@ stdout JSON line.
 (monitor registry + spans) enabled vs disabled on that same pipeline loop
 and asserts the overhead stays under 2%; detail to stderr, one stdout JSON
 line.
+
+`python bench.py --zero1 [--quick]` A/Bs the ZeRO-1 sharded weight update
+(`ParallelWrapper.optimizer_sharding`, arXiv:2004.13336) against the
+replicated update on the SAME mesh and model: wall time, per-replica
+optimizer-state bytes (the HBM headline) and end-of-run parity; detail to
+stderr + `BENCH_zero1.json`, one stdout JSON line.
 """
 import json
 import sys
@@ -557,6 +563,113 @@ def bench_obs(n_batches=96, batch=64, fused_steps=8, depth=2, n_in=784,
             "repeats": repeats}
 
 
+def bench_zero1(batch=256, steps=48, fused_steps=8, n_in=256, hidden=1024):
+    """A/B the ZeRO-1 sharded weight update against the replicated update
+    on the same data mesh, model and batches (`ParallelWrapper` with and
+    without `optimizer_sharding`): identical math (asserted at the end),
+    different schedule + optimizer-state residency.  The structural win is
+    per-replica optimizer-state HBM (~N×, `opt_bytes_ratio`); on real
+    chips the reduce-scatter/all-gather decomposition also overlaps with
+    backward, on a host-simulated CPU mesh the wall A/B mostly reads
+    collective overhead."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.parallel import (ParallelWrapper, make_mesh,
+                                             zero)
+    from deeplearning4j_tpu.train.updaters import Adam
+
+    devs = jax.devices()
+    n = len(devs)
+
+    def make_net():
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+                .list([DenseLayer(n_out=hidden, activation="relu"),
+                       DenseLayer(n_out=hidden, activation="relu"),
+                       OutputLayer(n_out=10, loss="mcxent",
+                                   activation="softmax")])
+                .set_input_type(InputType.feed_forward(n_in)).build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, n_in).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, batch)]
+    xs = jnp.broadcast_to(jnp.asarray(x), (fused_steps,) + x.shape)
+    ys = jnp.broadcast_to(jnp.asarray(y), (fused_steps,) + y.shape)
+    blocks = max(steps // fused_steps, 1)
+
+    def side(sharded):
+        net = make_net()
+        pw = ParallelWrapper(net, make_mesh({"data": n}, devs),
+                             optimizer_sharding=sharded)
+        dt = _time_steps(lambda: pw.fit_steps(xs, ys), n_warmup=1,
+                         n_steps=blocks, sync_fn=lambda: float(net.score()))
+        return net, dt, zero.opt_state_bytes_per_replica(net.opt_state_)
+
+    net_a, t_repl, bytes_repl = side(False)
+    net_b, t_z1, bytes_z1 = side(True)
+    diffs = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))),
+        net_a.params_, net_b.params_)
+    max_diff = max(jax.tree_util.tree_leaves(diffs))
+    n_samples = batch * fused_steps * blocks
+    return {"devices": n, "batch": batch, "fused_steps": fused_steps,
+            "steps": fused_steps * blocks,
+            "replicated_wall_s": t_repl, "zero1_wall_s": t_z1,
+            "replicated_samples_per_sec": n_samples / t_repl,
+            "zero1_samples_per_sec": n_samples / t_z1,
+            "speedup_vs_replicated": t_repl / t_z1,
+            "opt_bytes_replicated": bytes_repl,
+            "opt_bytes_zero1": bytes_z1,
+            "opt_bytes_ratio": bytes_repl / max(bytes_z1, 1),
+            "max_param_diff": max_diff}
+
+
+def main_zero1(quick: bool):
+    """`--zero1` mode: A/B detail to stderr + BENCH_zero1.json, ONE stdout
+    JSON line.  CPU fallback simulates an 8-device mesh (a 1-device run
+    would make both the sharding and the A/B degenerate)."""
+    import os
+    if not os.environ.get("JAX_PLATFORMS"):
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from __graft_entry__ import _probe_backend_device_count
+        if _probe_backend_device_count() < 1:
+            print("[bench] TPU backend unreachable; zero1 bench on "
+                  "simulated 8-way CPU mesh", file=sys.stderr, flush=True)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+    if os.environ.get("JAX_PLATFORMS") == "cpu" and \
+            "device_count" not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
+    try:
+        r = (bench_zero1(batch=64, steps=16, fused_steps=4, hidden=256)
+             if quick else bench_zero1())
+    except Exception as e:
+        print(json.dumps({"metric": "zero1_train_samples_per_sec",
+                          "value": None, "unit": "samples/sec",
+                          "error": repr(e)[:300]}))
+        sys.exit(1)
+    for k, v in r.items():      # detail to stderr: stdout stays one line
+        print(f"[zero1] {k} = {v}", file=sys.stderr, flush=True)
+    import os
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_zero1.json"), "w") as f:
+        json.dump(r, f, indent=2)
+    print(json.dumps({
+        "metric": "zero1_train_samples_per_sec",
+        "value": round(r["zero1_samples_per_sec"], 1),
+        "unit": "samples/sec",
+        "replicated_samples_per_sec":
+            round(r["replicated_samples_per_sec"], 1),
+        "speedup_vs_replicated": round(r["speedup_vs_replicated"], 3),
+        "opt_bytes_ratio": round(r["opt_bytes_ratio"], 2),
+        "max_param_diff": r["max_param_diff"],
+    }))
+
+
 def main_pipeline(quick: bool):
     """`--pipeline` mode: A/B detail to stderr, ONE stdout JSON line."""
     import os
@@ -744,6 +857,9 @@ def main():
         return
     if "--obs" in sys.argv:
         main_obs(quick)
+        return
+    if "--zero1" in sys.argv:
+        main_zero1(quick)
         return
     n_chips = _wait_for_backend()
     if n_chips == 0:

@@ -483,6 +483,7 @@ class SameDiff:
         self.epoch = 0
         self._train_step = None
         self._scan_step = None
+        self._step_transform = None   # ZeRO-1 weight update (parallel/zero)
         self._output_fns: Dict[Tuple[str, ...], Callable] = {}
         self._key = jax.random.PRNGKey(0)
         self.math = SDMath(self)
@@ -783,20 +784,36 @@ class SameDiff:
         cfg = self.training_config
         has_rng = RNG_FEED in self._nodes   # static at trace time; the step
         # cache is invalidated whenever the graph mutates
+        zt = self._step_transform   # ZeRO-1 sharded weight update, or None
 
         def step(variables, opt_state, feeds, rng, iteration, epoch):
             if has_rng:
                 rng, sub = jax.random.split(rng)
                 feeds = dict(feeds)
                 feeds[RNG_FEED] = sub
+            master = variables
+            if zt is not None:
+                variables = zt.gather_all(variables)
 
             def loss_fn(vs):
                 return self._total_loss(vs, feeds)
             loss, grads = jax.value_and_grad(loss_fn)(variables)
-            upd, new_opt = cfg.updater.apply(opt_state, grads, iteration,
-                                             epoch, params=variables)
-            new_vars = jax.tree_util.tree_map(lambda p, u: p - u,
-                                              variables, upd)
+            if zt is None:
+                upd, new_opt = cfg.updater.apply(opt_state, grads, iteration,
+                                                 epoch, params=variables)
+                new_vars = jax.tree_util.tree_map(lambda p, u: p - u,
+                                                  variables, upd)
+            else:
+                # reduce-scatter grads over the data axis, run the updater
+                # on the local shard, all-gather via restore()
+                grads = zt.scatter(None, grads)
+                p_upd = zt.update_view(None, master)
+                upd, new_opt = cfg.updater.apply(opt_state, grads, iteration,
+                                                 epoch, params=p_upd)
+                new_vars = jax.tree_util.tree_map(lambda p, u: p - u,
+                                                  p_upd, upd)
+                new_vars = zt.restore(None, new_vars)
+                new_opt = zt.constrain_opt(None, new_opt)
             return new_vars, new_opt, loss, rng, iteration + 1
 
         return step

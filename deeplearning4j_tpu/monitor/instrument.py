@@ -23,6 +23,8 @@ docs/observability.md):
   parallel_replicas                  mesh data-parallel degree
   parallel_dispatch_ms               SPMD step host dispatch time
   parallel_replica_skew_ms           per-replica completion skew (opt-in)
+  training_opt_state_bytes{sharded=} per-replica optimizer-state bytes
+                                     (ZeRO-1 sharded=true vs replicated)
 """
 from __future__ import annotations
 
@@ -156,11 +158,25 @@ class ParallelInstruments:
             "parallel_replica_skew_ms",
             help="latest measured per-replica completion skew (ms; "
             "blocking diagnostic, see ParallelWrapper.measure_replica_skew)")
+        self._opt_state_bytes = {
+            flag: reg.gauge(
+                "training_opt_state_bytes",
+                help="optimizer-state bytes resident per replica "
+                "(sharded=true → ZeRO-1 sharded weight update; compare "
+                "against sharded=false for the HBM saving)",
+                labels={"sharded": "true" if flag else "false"})
+            for flag in (True, False)}
 
     def record_dispatch(self, dt_s: float) -> None:
         if not enabled():
             return
         self.dispatch_ms.observe(dt_s * 1000.0)
+
+    def record_opt_state_bytes(self, nbytes: int, sharded: bool) -> None:
+        """Per-replica optimizer-state footprint sampled at placement."""
+        if not enabled():
+            return
+        self._opt_state_bytes[bool(sharded)].set(int(nbytes))
 
 
 _pipeline: Optional[PipelineInstruments] = None

@@ -465,6 +465,7 @@ class ComputationGraph:
         self._train_step = None
         self._scan_step = None
         self._output_fn = None
+        self._step_transform = None   # ZeRO-1 weight update (parallel/zero)
         self._vertex_types: Dict[str, InputType] = {}
         self._device_norm: Dict[str, Any] = {}  # input name -> DeviceNormalizer
         self._instr: Optional[TrainingInstruments] = None
@@ -598,6 +599,7 @@ class ComputationGraph:
     # ---- compiled step ----
     def _build_step_body(self):
         conf = self.conf
+        zt = self._step_transform   # ZeRO-1 sharded weight update, or None
 
         def step(params, state, opt_state, inputs, labels, lmasks, rng,
                  iteration, epoch):
@@ -605,6 +607,11 @@ class ComputationGraph:
             # device-resident rng/iteration carries, no per-step H2D)
             inputs = self._apply_device_norm(inputs)
             rng, srng = jax.random.split(rng)
+            master = params
+            if zt is not None:
+                # all-gather sharded master params once per step; the DAG
+                # forward/backward run on the gathered (or TP) layout
+                params = zt.gather_all(params)
 
             def loss_fn(p):
                 return self._loss(p, state, inputs, labels, srng, lmasks)
@@ -616,10 +623,10 @@ class ComputationGraph:
             for name in self._topo:
                 layer = self._layer_of(name)
                 if not params[name]:
-                    new_params[name], new_opt[name] = params[name], opt_state[name]
+                    new_params[name], new_opt[name] = master[name], opt_state[name]
                     continue
                 if layer is not None and layer.frozen:
-                    new_params[name], new_opt[name] = params[name], opt_state[name]
+                    new_params[name], new_opt[name] = master[name], opt_state[name]
                     continue
                 g = grads[name]
                 gn = (layer.gradient_normalization if layer is not None and
@@ -631,18 +638,29 @@ class ComputationGraph:
                            layer.gradient_normalization is not None
                            else conf.gradient_normalization_threshold)
                     g = apply_gradient_normalization(g, gn, thr)
+                if zt is None:
+                    p_upd = params[name]
+                else:
+                    # reduce-scatter grads; updater touches only this
+                    # device's shard of params/moments
+                    g = zt.scatter(name, g)
+                    p_upd = zt.update_view(name, master[name])
                 upd_cfg = self._updater_for(name)
-                upd, new_opt[name] = upd_cfg.apply(
-                    opt_state[name], g, iteration, epoch, params=params[name])
+                upd, new_o = upd_cfg.apply(
+                    opt_state[name], g, iteration, epoch, params=p_upd)
                 wd = (layer.weight_decay if layer is not None and
                       layer.weight_decay is not None else conf.weight_decay)
                 if wd and layer is not None:
                     lr = upd_cfg.lr_at(iteration, epoch)
                     upd = _add_scaled_where(
-                        upd, params[name],
-                        layer.regularizable_mask(params[name]), lr * wd)
-                new_params[name] = jax.tree_util.tree_map(
-                    lambda p_, u_: p_ - u_, params[name], upd)
+                        upd, p_upd,
+                        layer.regularizable_mask(p_upd), lr * wd)
+                new_p = jax.tree_util.tree_map(
+                    lambda p_, u_: p_ - u_, p_upd, upd)
+                if zt is not None:
+                    new_p = zt.restore(name, new_p)
+                    new_o = zt.constrain_opt(name, new_o)
+                new_params[name], new_opt[name] = new_p, new_o
             return new_params, new_state, new_opt, loss, rng, iteration + 1
 
         return step

@@ -241,6 +241,7 @@ class MultiLayerNetwork:
         self._train_step = None
         self._scan_step = None
         self._output_fn = None
+        self._step_transform = None   # ZeRO-1 weight update (parallel/zero)
         self._layer_types: List[InputType] = []
         self._device_norm = None   # on-device normalizer prologue (pipeline)
         self._instr: Optional[TrainingInstruments] = None
@@ -384,6 +385,7 @@ class MultiLayerNetwork:
 
     def _build_step_body(self):
         conf = self.conf
+        zt = self._step_transform   # ZeRO-1 sharded weight update, or None
 
         def step(params, state, opt_state, x, y, fmask, lmask, rng,
                  iteration, epoch):
@@ -397,6 +399,11 @@ class MultiLayerNetwork:
                 x = self._device_norm.apply_features(x)
                 y = self._device_norm.apply_labels(y)
             rng, srng = jax.random.split(rng)
+            master = params
+            if zt is not None:
+                # all-gather the data-axis-sharded master params once;
+                # forward/backward run on the gathered (or TP) layout
+                params = zt.gather_all(params)
 
             def loss_fn(p):
                 loss, new_state = self._loss(p, state, x, y, srng, fmask,
@@ -412,7 +419,7 @@ class MultiLayerNetwork:
                 if layer.frozen:
                     # FrozenLayer semantics (reference `nn/layers/FrozenLayer`):
                     # no update applied, updater state untouched.
-                    new_params[name] = params[name]
+                    new_params[name] = master[name]
                     new_opt[name] = opt_state[name]
                     continue
                 g = grads[name]
@@ -424,10 +431,17 @@ class MultiLayerNetwork:
                            if layer.gradient_normalization is not None
                            else conf.gradient_normalization_threshold)
                     g = apply_gradient_normalization(g, gn, thr)
+                if zt is None:
+                    p_upd = params[name]
+                else:
+                    # reduce-scatter the (already normalized) grads and run
+                    # the updater on this device's shard of params/moments
+                    g = zt.scatter(name, g)
+                    p_upd = zt.update_view(name, master[name])
                 upd_cfg = self._updater_for(i)
-                upd, new_opt[name] = upd_cfg.apply(opt_state[name], g,
-                                                   iteration, epoch,
-                                                   params=params[name])
+                upd, new_o = upd_cfg.apply(opt_state[name], g,
+                                           iteration, epoch,
+                                           params=p_upd)
                 # decoupled weight decay (reference WeightDecay regularization,
                 # applyLR=true): update += lr * coeff * w for regularizable params
                 wd = (layer.weight_decay if layer.weight_decay is not None
@@ -435,10 +449,15 @@ class MultiLayerNetwork:
                 if wd:
                     lr = upd_cfg.lr_at(iteration, epoch)
                     upd = _add_scaled_where(
-                        upd, params[name],
-                        layer.regularizable_mask(params[name]), lr * wd)
-                new_params[name] = jax.tree_util.tree_map(
-                    lambda p_, u_: p_ - u_, params[name], upd)
+                        upd, p_upd,
+                        layer.regularizable_mask(p_upd), lr * wd)
+                new_p = jax.tree_util.tree_map(
+                    lambda p_, u_: p_ - u_, p_upd, upd)
+                if zt is not None:
+                    new_p = zt.restore(name, new_p)
+                    new_o = zt.constrain_opt(name, new_o)
+                new_params[name] = new_p
+                new_opt[name] = new_o
             return new_params, new_state, new_opt, loss, rng, iteration + 1
 
         return step

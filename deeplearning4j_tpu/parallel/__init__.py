@@ -13,6 +13,9 @@ from deeplearning4j_tpu.parallel.wrapper import (  # noqa: F401
     DynamicBatchingInference, ParallelInference, ParallelWrapper)
 from deeplearning4j_tpu.parallel.sharding import (  # noqa: F401
     ShardingRules, shard_model_params)
+from deeplearning4j_tpu.parallel.zero import (  # noqa: F401
+    Zero1Transform, build_plans, disable_zero1, enable_zero1,
+    opt_state_bytes_per_replica)
 from deeplearning4j_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_apply, sequential_apply, stack_stage_params)
 from deeplearning4j_tpu.parallel.multihost import (  # noqa: F401

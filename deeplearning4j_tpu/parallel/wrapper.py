@@ -16,7 +16,9 @@ async-compressed-delta is deliberate and documented (BASELINE north star).
 """
 from __future__ import annotations
 
+import threading
 import time
+import warnings
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -25,8 +27,10 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.monitor.instrument import ParallelInstruments
+from deeplearning4j_tpu.parallel import zero
 from deeplearning4j_tpu.parallel.mesh import make_mesh
 from deeplearning4j_tpu.parallel.sharding import ShardingRules, shard_model_params
+from deeplearning4j_tpu.train.updaters import tree_map_like_params
 
 
 def _shard_batch(x, mesh: Mesh, axis: str, batch_dim: int = 0):
@@ -52,32 +56,52 @@ def _shard_opt_state_like(opt_state, params, mesh: Mesh):
     buffers, ...) inherit each param's sharding; anything else (step counts,
     scalars, empty states) replicates.  Handles both layouts in the tree:
     `{layer: {"m": layer_params, ...}}` (MultiLayerNetwork/ComputationGraph
-    per-layer updaters) and `{"m": params, "v": params}` (flat updaters) by
-    recursive structural match against the params tree."""
+    per-layer updaters) and `{"m": params, "v": params}` (flat updaters) via
+    the shared structural matcher (`train.updaters.tree_map_like_params`)."""
     repl = NamedSharding(mesh, P())
+    return tree_map_like_params(
+        lambda sub, psub: jax.tree_util.tree_map(
+            lambda s, p: jax.device_put(s, p.sharding), sub, psub),
+        opt_state, params,
+        lambda sub: jax.device_put(sub, repl))
 
-    def try_match(sub, param_sub):
-        s_leaves, s_def = jax.tree_util.tree_flatten(sub)
-        p_leaves, p_def = jax.tree_util.tree_flatten(param_sub)
-        if s_def == p_def and s_leaves and all(
-                np.shape(a) == np.shape(b)
-                for a, b in zip(s_leaves, p_leaves)):
-            return jax.tree_util.tree_map(
-                lambda s, p: jax.device_put(s, p.sharding), sub, param_sub)
-        return None
 
-    def place(sub, param_sub):
-        matched = try_match(sub, param_sub)
-        if matched is not None:
-            return matched
-        if isinstance(sub, dict):
-            return {k: place(v, param_sub[k]
-                             if isinstance(param_sub, dict)
-                             and k in param_sub else param_sub)
-                    for k, v in sub.items()}
-        return jax.device_put(sub, repl)
+def _pad_tail(a, pad: int, mode: str) -> np.ndarray:
+    """Append `pad` rows: repeats of the last row (features/labels — keeps
+    shapes/dtypes and any categorical structure valid) or zeros (masks —
+    padded rows contribute nothing to the masked loss mean)."""
+    a = np.asarray(a)
+    tail = (np.repeat(a[-1:], pad, axis=0) if mode == "repeat"
+            else np.zeros((pad,) + a.shape[1:], a.dtype))
+    return np.concatenate([a, tail], axis=0)
 
-    return place(opt_state, params)
+
+def _pad_partial_lists(feats, labels, lmasks, pad: int):
+    """Pad a partial batch up to a DP-divisible size such that the step is
+    EXACT: features/labels repeat their last row, label masks get zero rows
+    (losses reduce as sum(per*mask)/max(sum(mask),1), so zero-mask rows
+    change neither the loss nor any gradient).  Labels without a mask get a
+    synthesized `[ones(b); zeros(pad)]` vector mask when they are 2-D (the
+    shape every loss reduction accepts); for higher-rank unmasked labels
+    there is no universally-correct mask shape — returns None and the
+    caller drops the remainder with a one-time warning.  Caveat: repeated
+    feature rows still flow through the forward pass, so BatchNorm batch
+    statistics see them (running stats are perturbed by at most pad/batch;
+    the loss/grads are not)."""
+    new_lms = []
+    for i, l in enumerate(labels):
+        m = lmasks[i] if lmasks is not None else None
+        if m is not None:
+            new_lms.append(_pad_tail(m, pad, "zero"))
+        elif np.ndim(l) == 2:
+            b = int(np.shape(l)[0])
+            new_lms.append(np.concatenate(
+                [np.ones(b, np.float32), np.zeros(pad, np.float32)]))
+        else:
+            return None
+    feats = [_pad_tail(f, pad, "repeat") for f in feats]
+    labels = [_pad_tail(l, pad, "repeat") for l in labels]
+    return feats, labels, new_lms
 
 
 class ParallelWrapper:
@@ -97,13 +121,16 @@ class ParallelWrapper:
     def __init__(self, model, mesh: Optional[Mesh] = None,
                  data_axis: str = "data",
                  sharding_rules: Optional[ShardingRules] = None,
-                 training_mode: str = "SHARED_GRADIENTS"):
+                 training_mode: str = "SHARED_GRADIENTS",
+                 optimizer_sharding: bool = False):
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
         self.data_axis = data_axis
         self.training_mode = training_mode
         self._rules = sharding_rules
+        self._zero1 = bool(optimizer_sharding)
         self._placed = False
+        self._warned_drop = False
         self._instr: Optional[ParallelInstruments] = None
 
     def _instruments(self) -> ParallelInstruments:
@@ -119,6 +146,7 @@ class ParallelWrapper:
             self._mesh: Optional[Mesh] = None
             self._mode = "SHARED_GRADIENTS"
             self._rules: Optional[ShardingRules] = None
+            self._zero1 = False
 
         def workers(self, n: int):
             self._workers = int(n); return self
@@ -132,6 +160,13 @@ class ParallelWrapper:
 
         def sharding_rules(self, r: ShardingRules):
             self._rules = r; return self
+
+        def optimizer_sharding(self, on: bool = True):
+            """ZeRO-1 sharded weight update (arXiv:2004.13336): moments and
+            the weight update sharded over the data axis — reduce-scatter
+            grads, per-shard optimizer step, all-gather params.  Same math
+            as the replicated update, ~N× less optimizer-state HBM."""
+            self._zero1 = bool(on); return self
 
         def averaging_frequency(self, n: int):
             return self  # parity no-op: sync all-reduce has no averaging lag
@@ -148,40 +183,81 @@ class ParallelWrapper:
                 mesh = make_mesh({"data": len(devs)}, devs)
             return ParallelWrapper(self._model, mesh,
                                    sharding_rules=self._rules,
-                                   training_mode=self._mode)
+                                   training_mode=self._mode,
+                                   optimizer_sharding=self._zero1)
 
     @staticmethod
     def builder(model) -> "ParallelWrapper.Builder":
         return ParallelWrapper.Builder(model)
 
     # ---- placement ----
+    def optimizer_sharding(self, on: bool = True) -> "ParallelWrapper":
+        """Toggle the ZeRO-1 sharded weight update (arXiv:2004.13336) at
+        runtime; takes effect on the next fit call (the model is re-placed
+        and its compiled step re-traced with the reduce-scatter/all-gather
+        collectives baked in or removed)."""
+        on = bool(on)
+        if on == self._zero1:
+            return self
+        self._zero1 = on
+        if not on:
+            zero.disable_zero1(self.model)
+        self._placed = False
+        return self
+
     def _place_model(self):
         """Replicate (or TP-shard) params/state/opt-state over the mesh once;
         the jitted step keeps shardings on its outputs thereafter.  Optimizer
         moments are param-shaped, so they FOLLOW the param sharding — a
         TP-sharded layer keeps its Adam m/v sharded too (no HBM waste, no
-        per-step reshard)."""
+        per-step reshard).  With `optimizer_sharding(True)` the moments (and
+        the weight update itself) are additionally sharded over the data
+        axis (parallel.zero); TP rules still win per-leaf."""
         if self._placed:
             return
         m = self.model
-        if self._rules is not None:
-            m.params_ = shard_model_params(m.params_, self.mesh, self._rules)
+        if self._zero1:
+            zero.enable_zero1(m, self.mesh, axis=self.data_axis,
+                              rules=self._rules)
         else:
-            repl = NamedSharding(self.mesh, P())
-            m.params_ = jax.device_put(m.params_, repl)
-        repl = NamedSharding(self.mesh, P())
-        m.state_ = jax.device_put(m.state_, repl)
-        if m.opt_state_ is not None:
-            m.opt_state_ = _shard_opt_state_like(m.opt_state_, m.params_,
-                                                 self.mesh)
+            zero.disable_zero1(m)
+            if self._rules is not None:
+                m.params_ = shard_model_params(m.params_, self.mesh,
+                                               self._rules)
+            else:
+                m.params_ = jax.device_put(m.params_,
+                                           NamedSharding(self.mesh, P()))
+            m.state_ = jax.device_put(m.state_, NamedSharding(self.mesh, P()))
+            if m.opt_state_ is not None:
+                m.opt_state_ = _shard_opt_state_like(m.opt_state_, m.params_,
+                                                     self.mesh)
         self._placed = True
-        self._instruments().replicas.set(self.mesh.shape[self.data_axis])
+        ins = self._instruments()
+        ins.replicas.set(self.mesh.shape[self.data_axis])
+        if m.opt_state_ is not None:
+            ins.record_opt_state_bytes(
+                zero.opt_state_bytes_per_replica(m.opt_state_), self._zero1)
 
     # ---- training ----
+    def _warn_drop(self, b: int, n: int):
+        if not self._warned_drop:
+            warnings.warn(
+                f"dropping final partial batch of {b} rows: not divisible "
+                f"by the data-parallel degree {n} and the labels take no "
+                "mask (rank > 2 without an explicit labels_mask), so "
+                "mask-padding cannot express it exactly; pass a labels "
+                "mask or size batches to a multiple of the mesh",
+                stacklevel=3)
+            self._warned_drop = True
+
     def _fit_ds(self, ds):
         """Shard one DataSet/MultiDataSet (features, labels, masks) over the
-        data axis and run the model's compiled step."""
+        data axis and run the model's compiled step.  A final partial batch
+        (batch % DP degree != 0) is padded with repeated rows + a zero
+        labels-mask — exact under the masked loss mean (`_pad_partial_lists`)
+        — or dropped with a one-time warning when no mask can express it."""
         m = self.model
+        n = self.mesh.shape[self.data_axis]
 
         def shard(t):
             return None if t is None else _shard_batch(t, self.mesh,
@@ -194,17 +270,41 @@ class ParallelWrapper:
                     "ComputationGraph training does not consume feature "
                     "masks (same as its compiled step); drop them or mask "
                     "inside the input pipeline")
-            x = [shard(f) for f in ds.features]
-            y = [shard(l) for l in ds.labels]
-            lm = [shard(mk) for mk in ds.labels_masks] \
-                if ds.labels_masks is not None else None
+            feats, labels = list(ds.features), list(ds.labels)
+            lms = list(ds.labels_masks) if ds.labels_masks is not None \
+                else None
+            b = int(np.shape(feats[0])[0])
+            pad = (-b) % n
+            if pad:
+                padded = _pad_partial_lists(feats, labels, lms, pad)
+                if padded is None:
+                    self._warn_drop(b, n)
+                    return
+                feats, labels, lms = padded
+            x = [shard(f) for f in feats]
+            y = [shard(l) for l in labels]
+            lm = [shard(mk) for mk in lms] if lms is not None else None
             t0 = time.perf_counter()
             with self.mesh:
                 m._fit_batch(m._as_input_dict(x), y, lm)
             self._instruments().record_dispatch(time.perf_counter() - t0)
         else:
             fm = getattr(ds, "features_mask", None)
-            lm = shard(getattr(ds, "labels_mask", None))
+            lm_host = getattr(ds, "labels_mask", None)
+            feats, labels = ds.features, ds.labels
+            b = int(np.shape(feats)[0])
+            pad = (-b) % n
+            if pad:
+                padded = _pad_partial_lists(
+                    [feats], [labels],
+                    None if lm_host is None else [lm_host], pad)
+                if padded is None:
+                    self._warn_drop(b, n)
+                    return
+                (feats,), (labels,), (lm_host,) = padded
+                if fm is not None:
+                    fm = _pad_tail(fm, pad, "repeat")
+            lm = shard(lm_host)
             t0 = time.perf_counter()
             with self.mesh:
                 if hasattr(m, "_as_input_dict"):   # CG fed single-input DS
@@ -212,11 +312,11 @@ class ParallelWrapper:
                         raise NotImplementedError(
                             "ComputationGraph training does not consume "
                             "feature masks")
-                    m._fit_batch(m._as_input_dict(shard(ds.features)),
-                                 m._as_list(shard(ds.labels)),
+                    m._fit_batch(m._as_input_dict(shard(feats)),
+                                 m._as_list(shard(labels)),
                                  None if lm is None else [lm])
                 else:
-                    m.fit(shard(ds.features), shard(ds.labels),
+                    m.fit(shard(feats), shard(labels),
                           features_mask=shard(fm), labels_mask=lm)
             self._instruments().record_dispatch(time.perf_counter() - t0)
 
@@ -257,14 +357,19 @@ class ParallelWrapper:
                                          batch_dim=batch_dim)
 
     def fit_prefetched(self, iterator, *, epochs: int = 1,
-                       fused_steps: int = 1, prefetch_depth: int = 2):
+                       fused_steps: int = 1, prefetch_depth: int = 2,
+                       zero1: Optional[bool] = None):
         """Async end-to-end SPMD training from a host iterator: batches are
         ETL'd in a producer thread, staged onto the mesh pre-sharded
         (`sharded_placement`) `prefetch_depth` batches ahead, and consumed
         by the model's fused `fit_steps` scan — the SPMD composition of the
         pipeline's three latency hiders (prefetch, on-device normalize via
-        `model.set_normalizer`, fused dispatch)."""
+        `model.set_normalizer`, fused dispatch).  `zero1=True` turns on the
+        sharded weight update for this and subsequent fits (see
+        `optimizer_sharding`)."""
         from deeplearning4j_tpu.data.pipeline import DevicePrefetchIterator
+        if zero1 is not None:
+            self.optimizer_sharding(zero1)
         self._place_model()
         pf = DevicePrefetchIterator(iterator, depth=prefetch_depth,
                                     placement=self.sharded_placement())
@@ -275,12 +380,16 @@ class ParallelWrapper:
             pf.close()
         return self
 
-    def fit_steps(self, xs, ys):
+    def fit_steps(self, xs, ys, *, zero1: Optional[bool] = None):
         """SPMD fused dispatch: a `[k, batch, ...]` block trains as k data-
         parallel steps in ONE compiled dispatch — the model's `fit_steps`
         scan with the batch axis (axis 1) sharded over the data axis.
         Composes the two latency hiders: per-step all-reduce stays inside
-        the compiled scan, and the host dispatches once per k steps."""
+        the compiled scan, and the host dispatches once per k steps.
+        `zero1=True` turns on the sharded weight update (the reduce-
+        scatter/step/all-gather runs inside the scan body too)."""
+        if zero1 is not None:
+            self.optimizer_sharding(zero1)
         self._place_model()
         xs = _shard_batch(xs, self.mesh, self.data_axis, batch_dim=1)
         ys = _shard_batch(ys, self.mesh, self.data_axis, batch_dim=1)
@@ -294,22 +403,36 @@ class ParallelWrapper:
         """Opt-in BLOCKING diagnostic: wait for each addressable shard of
         the latest step output (falling back to the first param leaf) and
         report max-min arrival spread in ms, also recorded in the
-        `parallel_replica_skew_ms` gauge.  Shards are polled sequentially,
-        so this under-reports true skew for replicas that finish while an
-        earlier one is being waited on — a cheap imbalance smoke signal,
-        not a profiler.  Never call it inside the hot loop: it closes the
-        async-dispatch window the step loop works to keep open."""
+        `parallel_replica_skew_ms` gauge.  Every shard is polled on its OWN
+        thread (all started before any wait completes), so a replica
+        finishing while another is being waited on is no longer credited a
+        near-zero wait — the sequential-poll under-reporting is gone.
+        Remaining caveat: waits are host wall-clock from poll start, not
+        device-side completion timestamps, so thread scheduling and the
+        GIL add a noise floor (~0.1-1 ms on a busy host) — treat this as
+        an imbalance smoke signal, not a profiler.  Never call it inside
+        the hot loop: it closes the async-dispatch window the step loop
+        works to keep open."""
         arr = getattr(self.model, "_score", None)
         if arr is None or not hasattr(arr, "addressable_shards"):
             leaves = jax.tree_util.tree_leaves(self.model.params_)
             arr = leaves[0] if leaves else None
         if arr is None or not hasattr(arr, "addressable_shards"):
             return 0.0
-        waits = []
-        for sh in arr.addressable_shards:
+        shards = list(arr.addressable_shards)
+        waits = [0.0] * len(shards)
+
+        def poll(i, data):
             t0 = time.perf_counter()
-            jax.block_until_ready(sh.data)
-            waits.append((time.perf_counter() - t0) * 1000.0)
+            jax.block_until_ready(data)
+            waits[i] = (time.perf_counter() - t0) * 1000.0
+
+        threads = [threading.Thread(target=poll, args=(i, sh.data))
+                   for i, sh in enumerate(shards)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
         skew = max(waits) - min(waits) if waits else 0.0
         self._instruments().replica_skew_ms.set(skew)
         return skew

@@ -19,10 +19,11 @@ updater transform).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Tuple
+from typing import Any, Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deeplearning4j_tpu.train.schedules import ISchedule, resolve_schedule
 
@@ -31,6 +32,45 @@ PyTree = Any
 
 def _zeros_like_tree(params: PyTree) -> PyTree:
     return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+def tree_map_like_params(fn: Callable[[PyTree, PyTree], PyTree],
+                         state: PyTree, params: PyTree,
+                         fallback: Callable[[PyTree], PyTree],
+                         shape_of: Callable[[Any], Tuple[int, ...]] = np.shape
+                         ) -> PyTree:
+    """Map over the parts of an optimizer-state tree that structurally mirror
+    the params tree.
+
+    Every `IUpdater.init_state` builds its state from param-shaped moment
+    trees, but the nesting varies: per-layer updaters give
+    `{layer: {"m": layer_params, ...}}`, flat updaters `{"m": params, ...}`,
+    and Sgd/NoOp have no state at all.  This walks `state` top-down and, at
+    every subtree whose treedef AND per-leaf shapes match `params` (leaf
+    shapes taken via `shape_of(param_leaf)`), calls `fn(state_sub, param_sub)`
+    — dict levels that don't match recurse (descending `params` by key when
+    present), anything else gets `fallback(sub)` (step counts, scalars,
+    empty states).  Used by the parallel layer to make moments follow /
+    extend param placements without knowing any updater's layout."""
+
+    def matches(sub, psub):
+        s_leaves, s_def = jax.tree_util.tree_flatten(sub)
+        p_leaves, p_def = jax.tree_util.tree_flatten(psub)
+        return (s_def == p_def and bool(s_leaves) and all(
+            np.shape(a) == tuple(shape_of(b))
+            for a, b in zip(s_leaves, p_leaves)))
+
+    def walk(sub, psub):
+        if matches(sub, psub):
+            return fn(sub, psub)
+        if isinstance(sub, dict):
+            return {k: walk(v, psub[k]
+                            if isinstance(psub, dict) and k in psub
+                            else psub)
+                    for k, v in sub.items()}
+        return fallback(sub)
+
+    return walk(state, params)
 
 
 @dataclasses.dataclass

@@ -1,0 +1,450 @@
+"""ZeRO-1 sharded weight update (parallel/zero.py, arXiv:2004.13336).
+
+Parity is the whole game: `with_sharding_constraint` is value-preserving,
+so `optimizer_sharding(True)` must match the replicated update — to float
+tolerance for Adam, bitwise for Sgd — under the plain step, the fused
+`fit_steps` scan, TP rules, and non-divisible (padded) leaves.  Plus the
+observability (`training_opt_state_bytes` gauge) and the sync-free-loop
+invariant (zero per-step host transfers)."""
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deeplearning4j_tpu.monitor.registry import registry
+from deeplearning4j_tpu.nn import (DenseLayer, InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.parallel import (ParallelWrapper, ShardingRules,
+                                         make_mesh, zero)
+from deeplearning4j_tpu.train.updaters import (Adam, NoOp, Sgd,
+                                               tree_map_like_params)
+
+
+def _net(seed=7, n_in=8, updater=None):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(updater or Adam(1e-2))
+            .list([DenseLayer(n_out=16, activation="relu"),
+                   OutputLayer(n_out=3, loss="mcxent", activation="softmax")])
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=32, n_in=8, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, n_in).astype(np.float32)
+    labels = (x[:, 0] > 0).astype(int) + (x[:, 1] > 0).astype(int)
+    y = np.eye(3, dtype=np.float32)[labels]
+    return x, y
+
+
+def _mesh4():
+    return make_mesh({"data": 4}, jax.devices()[:4])
+
+
+def _assert_params_close(a, b, rtol=1e-5, atol=1e-6, exact=False):
+    def cmp(x, y):
+        x, y = np.asarray(x), np.asarray(y)
+        if exact:
+            np.testing.assert_array_equal(x, y)
+        else:
+            np.testing.assert_allclose(x, y, rtol=rtol, atol=atol)
+    jax.tree_util.tree_map(cmp, a.params_, b.params_)
+
+
+# ---------------------------------------------------------------------------
+# Parity (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def test_zero1_adam_parity_10_steps():
+    """4-way mesh, 10 Adam steps: sharded update == replicated update."""
+    x, y = _data()
+    ref = _net()
+    pw_ref = ParallelWrapper(ref, _mesh4())
+    z = _net()
+    pw_z = ParallelWrapper(z, _mesh4(), optimizer_sharding=True)
+    for _ in range(10):
+        pw_ref.fit(x, y)
+        pw_z.fit(x, y)
+    _assert_params_close(ref, z)
+
+
+def test_zero1_sgd_parity_bitwise():
+    """Sgd has no state and an order-preserving update chain — the sharded
+    path must be BITWISE identical to the replicated one."""
+    x, y = _data()
+    ref = _net(updater=Sgd(1e-1))
+    pw_ref = ParallelWrapper(ref, _mesh4())
+    z = _net(updater=Sgd(1e-1))
+    pw_z = ParallelWrapper(z, _mesh4(), optimizer_sharding=True)
+    for _ in range(10):
+        pw_ref.fit(x, y)
+        pw_z.fit(x, y)
+    _assert_params_close(ref, z, exact=True)
+
+
+def test_zero1_fit_steps_fused_scan_parity():
+    """The reduce-scatter/step/all-gather must live INSIDE the scan body:
+    a [k, batch, ...] fused block matches the replicated fused block."""
+    rng = np.random.RandomState(1)
+    xs = rng.randn(6, 32, 8).astype(np.float32)
+    ys = np.eye(3, dtype=np.float32)[rng.randint(0, 3, (6, 32))]
+    ref = _net()
+    pw_ref = ParallelWrapper(ref, _mesh4())
+    z = _net()
+    pw_z = ParallelWrapper(z, _mesh4(), optimizer_sharding=True)
+    l_ref = pw_ref.fit_steps(xs, ys)
+    l_z = pw_z.fit_steps(xs, ys)
+    np.testing.assert_allclose(np.asarray(l_ref), np.asarray(l_z),
+                               rtol=1e-5, atol=1e-6)
+    _assert_params_close(ref, z)
+    assert ref.iteration == z.iteration == 6
+
+
+def test_zero1_with_tp_rule_parity_and_precedence():
+    """TP rules win per-leaf: on a 2x2 mesh with layer_0/W tensor-parallel,
+    parity must hold AND layer_0's moments keep the TP spec while
+    layer_1's moments pick up the data-axis ZeRO sharding."""
+    devs = jax.devices()[:4]
+    rules = (ShardingRules().add(r"layer_0/W", P(None, "model"))
+             .add(r".*", P()))
+    x, y = _data()
+    ref = _net()
+    pw_ref = ParallelWrapper(ref, make_mesh({"data": 2, "model": 2}, devs),
+                             sharding_rules=rules)
+    z = _net()
+    pw_z = ParallelWrapper(z, make_mesh({"data": 2, "model": 2}, devs),
+                           sharding_rules=rules, optimizer_sharding=True)
+    for _ in range(10):
+        pw_ref.fit(x, y)
+        pw_z.fit(x, y)
+    _assert_params_close(ref, z)
+    assert z.opt_state_["layer_0"]["m"]["W"].sharding.spec == \
+        P(None, "model")
+    assert z.opt_state_["layer_1"]["m"]["W"].sharding.spec == P("data")
+
+
+def test_zero1_padded_leaf_parity_and_layout():
+    """n_in=10 on a 4-way mesh: W (10,16) pads to (12,16).  Parity must
+    hold; the moment is stored padded+sharded, the param at its true
+    shape (replicated — uneven device layouts don't materialize)."""
+    x, y = _data(n_in=10)
+    ref = _net(n_in=10)
+    pw_ref = ParallelWrapper(ref, _mesh4())
+    z = _net(n_in=10)
+    pw_z = ParallelWrapper(z, _mesh4(), optimizer_sharding=True)
+    for _ in range(10):
+        pw_ref.fit(x, y)
+        pw_z.fit(x, y)
+    _assert_params_close(ref, z)
+    mom = z.opt_state_["layer_0"]["m"]["W"]
+    assert mom.shape == (12, 16)
+    assert mom.sharding.spec == P("data")
+    assert z.params_["layer_0"]["W"].shape == (10, 16)
+    # the pad region is a fixed point (zero grads -> zero moments)
+    assert np.all(np.asarray(mom)[10:] == 0.0)
+
+
+def test_zero1_disable_unpads_and_matches():
+    """optimizer_sharding(False) restores true-shape moments and keeps
+    training on the replicated path from the same trajectory."""
+    x, y = _data(n_in=10)
+    z = _net(n_in=10)
+    pw = ParallelWrapper(z, _mesh4(), optimizer_sharding=True)
+    pw.fit(x, y)
+    pw.optimizer_sharding(False)
+    pw.fit(x, y)
+    assert z.opt_state_["layer_0"]["m"]["W"].shape == (10, 16)
+    assert z._step_transform is None
+
+    ref = _net(n_in=10)
+    pw_ref = ParallelWrapper(ref, _mesh4())
+    pw_ref.fit(x, y)
+    pw_ref.fit(x, y)
+    _assert_params_close(ref, z)
+
+
+# ---------------------------------------------------------------------------
+# Observability + memory proof (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def test_opt_state_bytes_gauge_shows_reduction():
+    """The training_opt_state_bytes{sharded=} gauge pair must show the ~N×
+    per-replica saving (this net on a 4-way mesh: W moments shard 4-way,
+    only the 3-wide output bias replicates → ratio ≈ 3.8)."""
+    x, y = _data()
+    ref = _net()
+    ParallelWrapper(ref, _mesh4()).fit(x, y)
+    z = _net()
+    ParallelWrapper(z, _mesh4(), optimizer_sharding=True).fit(x, y)
+    repl = registry().get("training_opt_state_bytes", {"sharded": "false"})
+    shard = registry().get("training_opt_state_bytes", {"sharded": "true"})
+    assert repl is not None and shard is not None
+    assert repl.value > 0 and shard.value > 0
+    assert shard.value < repl.value / 2.5, \
+        f"expected ~4x reduction, got {repl.value}/{shard.value}"
+
+
+def test_opt_state_bytes_per_replica_counts_shards_once():
+    mesh = _mesh4()
+    from jax.sharding import NamedSharding
+    repl = jax.device_put(np.zeros((8, 4), np.float32),
+                          NamedSharding(mesh, P()))
+    shd = jax.device_put(np.zeros((8, 4), np.float32),
+                         NamedSharding(mesh, P("data")))
+    assert zero.opt_state_bytes_per_replica({"a": repl}) == 8 * 4 * 4
+    assert zero.opt_state_bytes_per_replica({"a": shd}) == 8 * 4 * 4 // 4
+
+
+def test_zero1_no_per_step_host_transfers():
+    """Transfer-guard proof: after warmup, the sharded step dispatches with
+    ZERO fresh host->device transfers (the gather/scatter are device-side
+    collectives, the iteration counter is device-resident)."""
+    from deeplearning4j_tpu.utils import counters
+    x, y = _data()
+    z = _net()
+    pw = ParallelWrapper(z, _mesh4(), optimizer_sharding=True)
+    pw.fit(x, y)                       # warmup: compile + counter upload
+    x_dev = pw.sharded_placement()(x)
+    y_dev = pw.sharded_placement()(y)
+    pw.fit(x_dev, y_dev)               # second warmup on device-resident args
+    uploads_before = counters.counter_uploads.value
+    with jax.transfer_guard("disallow"):
+        for _ in range(3):
+            pw.fit(x_dev, y_dev)
+    assert counters.counter_uploads.value == uploads_before
+
+
+# ---------------------------------------------------------------------------
+# SameDiff + ComputationGraph step builders
+# ---------------------------------------------------------------------------
+
+def _mlp_sd():
+    from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig
+    sd = SameDiff.create()
+    x = sd.placeholder("input", shape=(-1, 4))
+    y = sd.placeholder("label", shape=(-1, 3))
+    w0 = sd.var("w0", "XAVIER", 4, 16)
+    b0 = sd.var("b0", np.zeros(16, np.float32))
+    w1 = sd.var("w1", "XAVIER", 16, 3)
+    b1 = sd.var("b1", np.zeros(3, np.float32))
+    h = sd.nn.tanh(sd.nn.linear(x, w0, b0))
+    logits = sd.nn.linear(h, w1, b1, name="logits")
+    sd.loss.softmax_cross_entropy(y, logits, name="loss")
+    sd.set_loss_variables("loss")
+    sd.set_training_config(TrainingConfig(
+        updater=Adam(1e-2),
+        data_set_feature_mapping=["input"],
+        data_set_label_mapping=["label"]))
+    return sd
+
+
+def test_zero1_samediff_parity():
+    x, y = _data(n=32, n_in=4)
+    ref, z = _mlp_sd(), _mlp_sd()
+    mesh = _mesh4()
+    zt = zero.enable_zero1(z, mesh)
+    assert z._step_transform is zt
+    with mesh:
+        for _ in range(10):
+            ref.fit(x, y)
+            z.fit(x, y)
+    for k in ref.variables_:
+        np.testing.assert_allclose(np.asarray(ref.variables_[k]),
+                                   np.asarray(z.variables_[k]),
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+    # w0 (4,16) and w1/b0 (16,...) shard over the 4-way axis; b1 (3,) can't
+    assert z.opt_state_["m"]["w0"].sharding.spec == P("data")
+    assert z.opt_state_["m"]["b1"].sharding.spec == P()
+
+
+def test_zero1_computation_graph_parity():
+    from deeplearning4j_tpu.data.dataset import MultiDataSet
+    from deeplearning4j_tpu.nn import (ComputationGraph, GraphBuilder,
+                                       MergeVertex)
+
+    def build():
+        conf = (GraphBuilder().seed(5).updater(Adam(1e-2))
+                .add_inputs("a", "b")
+                .set_input_types(InputType.feed_forward(4),
+                                 InputType.feed_forward(6))
+                .add_layer("da", DenseLayer(n_out=5, activation="tanh"), "a")
+                .add_layer("db", DenseLayer(n_out=7, activation="tanh"), "b")
+                .add_vertex("m", MergeVertex(), "da", "db")
+                .add_layer("out", OutputLayer(n_out=2, loss="mcxent",
+                                              activation="softmax"), "m")
+                .set_outputs("out").build())
+        return ComputationGraph(conf).init()
+
+    rng = np.random.RandomState(3)
+    a = rng.randn(16, 4).astype(np.float32)
+    b = rng.randn(16, 6).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)]
+    mds = MultiDataSet([a, b], [y])
+
+    ref = build()
+    pw_ref = ParallelWrapper(ref, _mesh4())
+    z = build()
+    pw_z = ParallelWrapper(z, _mesh4(), optimizer_sharding=True)
+    for _ in range(6):
+        pw_ref.fit(mds)
+        pw_z.fit(mds)
+    _assert_params_close(ref, z)
+
+
+# ---------------------------------------------------------------------------
+# _shard_opt_state_like structural matching (satellite)
+# ---------------------------------------------------------------------------
+
+def _leaf_shardings(tree):
+    return [leaf.sharding.spec for leaf in jax.tree_util.tree_leaves(tree)]
+
+
+def test_shard_opt_state_like_per_layer_layout():
+    """{layer: {"m": ..., "v": ...}} moments follow each param leaf's
+    sharding leaf-by-leaf."""
+    from deeplearning4j_tpu.parallel.wrapper import _shard_opt_state_like
+    from jax.sharding import NamedSharding
+    mesh = make_mesh({"data": 2, "model": 2}, jax.devices()[:4])
+    params = {"layer_0": {
+        "W": jax.device_put(np.zeros((4, 8), np.float32),
+                            NamedSharding(mesh, P(None, "model"))),
+        "b": jax.device_put(np.zeros(8, np.float32),
+                            NamedSharding(mesh, P()))}}
+    upd = Adam(1e-3)
+    opt = {"layer_0": upd.init_state(params["layer_0"])}
+    placed = _shard_opt_state_like(opt, params, mesh)
+    for mom in ("m", "v"):
+        assert placed["layer_0"][mom]["W"].sharding.spec == P(None, "model")
+        assert placed["layer_0"][mom]["b"].sharding.spec == P()
+
+
+def test_shard_opt_state_like_flat_layout():
+    """{"m": params, "v": params} (flat updaters, the SameDiff layout)."""
+    from deeplearning4j_tpu.parallel.wrapper import _shard_opt_state_like
+    from jax.sharding import NamedSharding
+    mesh = make_mesh({"data": 2, "model": 2}, jax.devices()[:4])
+    params = {
+        "w0": jax.device_put(np.zeros((4, 8), np.float32),
+                             NamedSharding(mesh, P(None, "model"))),
+        "b0": jax.device_put(np.zeros(8, np.float32),
+                             NamedSharding(mesh, P()))}
+    opt = Adam(1e-3).init_state(params)
+    placed = _shard_opt_state_like(opt, params, mesh)
+    assert placed["m"]["w0"].sharding.spec == P(None, "model")
+    assert placed["v"]["w0"].sharding.spec == P(None, "model")
+    assert placed["m"]["b0"].sharding.spec == P()
+
+
+def test_shard_opt_state_like_scalars_and_empty_states():
+    """Scalar step counts replicate; empty Sgd/NoOp states pass through
+    without inventing leaves."""
+    from deeplearning4j_tpu.parallel.wrapper import _shard_opt_state_like
+    from jax.sharding import NamedSharding
+    mesh = _mesh4()
+    params = {"layer_0": {"W": jax.device_put(
+        np.zeros((4, 8), np.float32), NamedSharding(mesh, P()))}}
+    opt = {"layer_0": {"m": {"W": np.zeros((4, 8), np.float32)},
+                       "step": np.float32(3.0)}}
+    placed = _shard_opt_state_like(opt, params, mesh)
+    assert placed["layer_0"]["m"]["W"].sharding.spec == P()
+    assert placed["layer_0"]["step"].sharding.spec == P()
+    assert float(placed["layer_0"]["step"]) == 3.0
+
+    for upd in (Sgd(1e-1), NoOp()):
+        empty = {"layer_0": upd.init_state(params["layer_0"])}
+        placed = _shard_opt_state_like(empty, params, mesh)
+        assert placed == {"layer_0": ()}
+
+
+def test_tree_map_like_params_shape_of_override():
+    """The shared matcher honors a custom shape_of (how zero.py matches
+    padded moments against LeafPlan.padded_shape)."""
+    state = {"m": {"W": np.zeros((12, 16))}}
+    plans = {"W": zero.LeafPlan("shard", (10, 16), 2, P(), P("data"), P())}
+    hits = []
+    tree_map_like_params(
+        lambda s, p: hits.append(True) or s, state, plans,
+        lambda s: s, shape_of=lambda pl: pl.padded_shape)
+    assert hits == [True]
+
+
+# ---------------------------------------------------------------------------
+# Partial final batch (satellite)
+# ---------------------------------------------------------------------------
+
+def test_iterator_partial_final_batch_pads_exactly():
+    """Batches 32,32,20 on an 8-way mesh: the 20-row tail is padded with
+    repeated rows + a zero labels-mask — must match single-device training
+    on the raw (unpadded) batches exactly (masked loss mean)."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+    x, y = _data(n=84, seed=2)
+    splits = [(0, 32), (32, 64), (64, 84)]
+    batches = [DataSet(x[a:b], y[a:b]) for a, b in splits]
+
+    ref = _net(seed=3)
+    for a, b in splits:
+        # single-device reference with the same masking the padded path
+        # uses on the full batches (mask of ones == unmasked mean)
+        ref.fit(x[a:b], y[a:b])
+
+    z = _net(seed=3)
+    pw = ParallelWrapper(z, make_mesh({"data": 8}, jax.devices()))
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        pw.fit(ListDataSetIterator(batches))
+    assert not [w for w in rec if "dropping final partial" in str(w.message)]
+    assert z.iteration == 3
+    _assert_params_close(ref, z, rtol=1e-5, atol=1e-6)
+
+
+def test_iterator_partial_batch_drop_warns_once():
+    """Rank-3 labels without a labels mask can't be mask-padded: the tail
+    batch is dropped with ONE warning across epochs."""
+    from deeplearning4j_tpu.parallel.wrapper import _pad_partial_lists
+    assert _pad_partial_lists([np.zeros((3, 4))],
+                              [np.zeros((3, 2, 5))], None, 1) is None
+
+    class DS:
+        def __init__(self, n):
+            self.features = np.zeros((n, 4), np.float32)
+            self.labels = np.zeros((n, 2, 5), np.float32)
+            self.labels_mask = None
+            self.features_mask = None
+    net = _net()
+    pw = ParallelWrapper(net, _mesh4())
+    with pytest.warns(UserWarning, match="dropping final partial batch"):
+        pw.fit(iter([DS(3)]))
+    assert net.iteration == 0
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")  # second epoch: silent
+        pw.fit(iter([DS(3)]))
+    assert not [w for w in rec if "dropping final partial" in str(w.message)]
+
+
+def test_direct_fit_still_raises_on_indivisible_batch():
+    """fit(x, y) (no iterator) keeps the explicit error — padding is an
+    iterator-epoch affordance, not a silent batch rewrite."""
+    net = _net()
+    pw = ParallelWrapper(net, _mesh4())
+    x, y = _data(n=30)
+    with pytest.raises(ValueError, match="divisible"):
+        pw.fit(x, y)
+
+
+# ---------------------------------------------------------------------------
+# Replica skew (satellite)
+# ---------------------------------------------------------------------------
+
+def test_measure_replica_skew_parallel_polling():
+    x, y = _data()
+    net = _net()
+    pw = ParallelWrapper(net, _mesh4())
+    pw.fit(x, y)
+    skew = pw.measure_replica_skew()
+    assert skew >= 0.0
+    g = registry().get("parallel_replica_skew_ms")
+    assert g is not None and g.value == skew
