@@ -18,7 +18,7 @@ from deeplearning4j_tpu.data.normalizers import (  # noqa: F401
     ImagePreProcessingScaler, MultiNormalizer, Normalizer,
     NormalizerMinMaxScaler, NormalizerStandardize)
 from deeplearning4j_tpu.data.pipeline import (  # noqa: F401
-    DeviceNormalizer, DevicePrefetchIterator, device_blocks)
+    DeviceNormalizer, DevicePrefetchIterator, ProducerError, device_blocks)
 from deeplearning4j_tpu.data.rr_iterator import (  # noqa: F401
     RecordReaderDataSetIterator, SequenceRecordReaderDataSetIterator)
 from deeplearning4j_tpu.data.datasets import (  # noqa: F401

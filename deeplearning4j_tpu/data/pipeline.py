@@ -46,6 +46,13 @@ from deeplearning4j_tpu.data.normalizers import (ImagePreProcessingScaler,
 Placement = Callable[[np.ndarray], jax.Array]
 
 
+class ProducerError(RuntimeError):
+    """The ETL producer thread failed.  Re-raised on the CONSUMER side of
+    `DevicePrefetchIterator` with batch-position context and the original
+    exception chained (`__cause__`) — a producer crash must fail the
+    training loop loudly, never masquerade as a clean end of epoch."""
+
+
 # ---------------------------------------------------------------------------
 # On-device normalization
 # ---------------------------------------------------------------------------
@@ -181,25 +188,72 @@ class DevicePrefetchIterator(DataSetIterator):
     Early-break consumers shut the producer thread down via the async
     layer's stop event (generator ``finally``), and :meth:`close` does the
     same for owners that never finished iterating.
+
+    A producer-thread exception re-raises HERE as :class:`ProducerError`
+    (original chained) instead of silently ending the epoch.  With
+    ``retries=N`` (opt-in; default 0 = fail fast) a transient producer
+    failure is retried up to N times with exponential backoff: the
+    underlying iterator is reset and replayed past the batches already
+    delivered, so the consumer sees an uninterrupted batch sequence.
+    Retries assume a deterministic, restartable underlying iterator.
     """
 
     def __init__(self, underlying: DataSetIterator, depth: int = 2,
                  queue_size: Optional[int] = None,
-                 placement: Optional[Placement] = None):
+                 placement: Optional[Placement] = None, retries: int = 0,
+                 retry_backoff_s: float = 0.05):
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
         self.underlying = underlying
         self.depth = int(depth)
         self.placement = placement
+        self.retries = int(retries)
+        self.retry_backoff_s = float(retry_backoff_s)
         self._async = AsyncDataSetIterator(
             underlying, queue_size=queue_size if queue_size is not None
             else self.depth)
+
+    def _recover(self, state: dict, exc: BaseException) -> None:
+        """One producer-retry round: restart the underlying iterator and
+        replay past the `delivered` batches the consumer already has.
+        Failures during the replay consume retry budget too; budget
+        exhaustion raises `ProducerError` chained to the original."""
+        from deeplearning4j_tpu.monitor.instrument import pipeline_instruments
+        attempt = state["attempts"] + 1
+        if attempt > self.retries:
+            raise ProducerError(
+                f"input producer failed at batch {state['delivered']}"
+                + (f" (after {state['attempts']} retries)"
+                   if state["attempts"] else "")
+                + f": {exc!r}") from exc
+        state["attempts"] = attempt
+        pipeline_instruments().producer_retries.inc()
+        try:
+            state["it"].close()
+        except Exception:
+            pass
+        time.sleep(self.retry_backoff_s * (2 ** (attempt - 1)))
+        self.underlying.reset()
+        state["it"] = iter(self._async)
+        n = 0
+        while n < state["delivered"]:
+            try:
+                next(state["it"])
+            except StopIteration:
+                raise ProducerError(
+                    f"producer ended after {n} batches during retry "
+                    f"replay; consumer already received "
+                    f"{state['delivered']}") from exc
+            except Exception as again:
+                self._recover(state, again)   # fully re-replays
+                return
+            n += 1
 
     def __iter__(self):
         from deeplearning4j_tpu.monitor.instrument import pipeline_instruments
         ins = pipeline_instruments()
         buf: collections.deque = collections.deque()
-        it = iter(self._async)
+        state = {"it": iter(self._async), "delivered": 0, "attempts": 0}
         put = self.placement if self.placement is not None else _default_put
 
         def counting_put(a):
@@ -209,13 +263,23 @@ class DevicePrefetchIterator(DataSetIterator):
                 ins.h2d_bytes.inc(getattr(a, "nbytes", 0) or 0)
             return put(a)
 
+        def next_batch():
+            while True:
+                try:
+                    return next(state["it"])
+                except StopIteration:
+                    raise
+                except Exception as e:
+                    self._recover(state, e)
+
         try:
             while True:
                 t0 = time.perf_counter()
                 try:
-                    ds = next(it)
+                    ds = next_batch()
                 except StopIteration:
                     break
+                state["delivered"] += 1
                 wait = time.perf_counter() - t0
                 buf.append(stage(ds, counting_put))
                 ins.record_stage(wait, len(buf))
@@ -226,7 +290,7 @@ class DevicePrefetchIterator(DataSetIterator):
                 yield buf.popleft()
                 ins.prefetch_depth.set(len(buf))
         finally:
-            it.close()          # releases the producer on early break
+            state["it"].close()    # releases the producer on early break
 
     def close(self, timeout: float = 2.0) -> None:
         self._async.close(timeout)

@@ -19,12 +19,25 @@ docs/observability.md):
   pipeline_prefetch_depth            batches staged on device right now
   pipeline_producer_wait_ms          consumer wait on the ETL producer
   pipeline_h2d_bytes_total           bytes staged host->device
+  pipeline_producer_retries_total    producer restarts (retries= opt-in)
   pipeline_batches_total             batches staged
   parallel_replicas                  mesh data-parallel degree
   parallel_dispatch_ms               SPMD step host dispatch time
   parallel_replica_skew_ms           per-replica completion skew (opt-in)
   training_opt_state_bytes{sharded=} per-replica optimizer-state bytes
                                      (ZeRO-1 sharded=true vs replicated)
+  resilience_checkpoint_save_ms      wall time of one checkpoint save
+                                     (async saves: the background write)
+  resilience_checkpoint_bytes        size of the latest checkpoint payload
+  resilience_checkpoints_total       committed checkpoint saves
+  resilience_checkpoint_gc_total     checkpoints removed by retention GC
+  resilience_restores_total          successful checkpoint restores
+  resilience_restore_fallbacks_total restores that skipped a torn/corrupt
+                                     newest checkpoint for an older one
+  resilience_rollbacks_total         divergence rollbacks to a checkpoint
+  resilience_divergence_events_total NaN/inf/spike steps the guard caught
+  resilience_preemptions_total       SIGTERM checkpoint-and-exit events
+  chaos_faults_injected_total{kind=} faults injected by utils.chaos
 """
 from __future__ import annotations
 
@@ -135,6 +148,9 @@ class PipelineInstruments:
             help="bytes staged host->device by the input pipeline")
         self.batches = reg.counter(
             "pipeline_batches_total", help="batches staged to device")
+        self.producer_retries = reg.counter(
+            "pipeline_producer_retries_total",
+            help="producer restarts by DevicePrefetchIterator retries=")
 
     def record_stage(self, wait_s: float, depth: int) -> None:
         if not enabled():
@@ -179,7 +195,51 @@ class ParallelInstruments:
         self._opt_state_bytes[bool(sharded)].set(int(nbytes))
 
 
+class ResilienceInstruments:
+    """Fault-tolerance handles (train.resilience + utils.chaos)."""
+
+    def __init__(self, registry_: Optional[MetricsRegistry] = None):
+        reg = registry_ if registry_ is not None else registry()
+        self.checkpoint_save_ms = reg.histogram(
+            "resilience_checkpoint_save_ms",
+            help="wall time of one checkpoint save (ms); for async saves "
+            "this is the background write, NOT the step-loop stall")
+        self.checkpoint_bytes = reg.gauge(
+            "resilience_checkpoint_bytes",
+            help="payload bytes of the most recent checkpoint save")
+        self.checkpoints = reg.counter(
+            "resilience_checkpoints_total",
+            help="checkpoint saves committed (manifest written)")
+        self.checkpoint_gc = reg.counter(
+            "resilience_checkpoint_gc_total",
+            help="checkpoints removed by keep-last-K retention GC")
+        self.restores = reg.counter(
+            "resilience_restores_total",
+            help="successful restores from a committed checkpoint")
+        self.restore_fallbacks = reg.counter(
+            "resilience_restore_fallbacks_total",
+            help="restores that skipped a torn or checksum-corrupt newer "
+            "checkpoint and fell back to an older intact one")
+        self.rollbacks = reg.counter(
+            "resilience_rollbacks_total",
+            help="divergence-guard rollbacks to the last checkpoint")
+        self.divergence_events = reg.counter(
+            "resilience_divergence_events_total",
+            help="steps the divergence guard flagged (NaN/inf/spike)")
+        self.preemptions = reg.counter(
+            "resilience_preemptions_total",
+            help="preemption signals honored with a checkpoint-and-exit")
+
+    def record_save(self, dt_s: float, nbytes: int) -> None:
+        if not enabled():
+            return
+        self.checkpoint_save_ms.observe(dt_s * 1000.0)
+        self.checkpoint_bytes.set(int(nbytes))
+        self.checkpoints.inc()
+
+
 _pipeline: Optional[PipelineInstruments] = None
+_resilience: Optional[ResilienceInstruments] = None
 
 
 def pipeline_instruments() -> PipelineInstruments:
@@ -188,6 +248,14 @@ def pipeline_instruments() -> PipelineInstruments:
     if _pipeline is None:
         _pipeline = PipelineInstruments()
     return _pipeline
+
+
+def resilience_instruments() -> ResilienceInstruments:
+    """Process-wide resilience handle bundle (lazy singleton)."""
+    global _resilience
+    if _resilience is None:
+        _resilience = ResilienceInstruments()
+    return _resilience
 
 
 perf_counter = time.perf_counter   # re-export: hot paths import one name

@@ -34,6 +34,7 @@ import numpy as np
 ENV_COORD = "DL4J_TPU_COORDINATOR"
 ENV_NPROC = "DL4J_TPU_NUM_PROCESSES"
 ENV_PID = "DL4J_TPU_PROCESS_ID"
+ENV_CKPT = "DL4J_TPU_CHECKPOINT_DIR"
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -172,11 +173,17 @@ class ElasticLocalRunner:
 
     @staticmethod
     def _classify_failure(message: str) -> str:
-        """Failure taxonomy: `hang` = a rank hit the subprocess timeout
-        (no exit); `peer-loss` = a rank died because the coordination
-        service reported a peer's death (secondary casualty — the real
-        fault is elsewhere); `crash` = a rank exited nonzero on its own."""
+        """Failure taxonomy: `corrupt` = a rank failed restoring a
+        checkpoint whose bytes don't match their recorded checksum
+        (NON-retryable — a relaunch reads the same rotten bytes);
+        `hang` = a rank hit the subprocess timeout (no exit);
+        `peer-loss` = a rank died because the coordination service
+        reported a peer's death (secondary casualty — the real fault is
+        elsewhere); `crash` = a rank exited nonzero on its own."""
         low = message.lower()
+        if "checksummismatch" in low.replace(" ", "") \
+                or "checksumerror" in low:
+            return "corrupt"
         if "<rank timed out>" in message:
             return "hang"
         if "peer task" in low or "coordination service" in low \
@@ -190,20 +197,37 @@ class ElasticLocalRunner:
                    self.backoff_cap_s)
 
     def run(self, script: str, args: Sequence[str] = (),
-            timeout: float = 300.0) -> List[str]:
+            timeout: float = 300.0,
+            checkpoint_dir: Optional[str] = None) -> List[str]:
+        """Run the gang, relaunching after retryable failures.  With
+        `checkpoint_dir=` every (re)launch exports it to the workers as
+        `DL4J_TPU_CHECKPOINT_DIR`, so a resilience-aware worker (e.g.
+        tests/mh_worker_elastic.py via `train.resilience`) resumes from
+        the last committed sharded checkpoint instead of step 0.  A
+        `corrupt` failure (checksum-mismatch restore) aborts immediately:
+        relaunching cannot fix rotten bytes."""
         import time as _time
+        extra_env = {} if checkpoint_dir is None \
+            else {ENV_CKPT: checkpoint_dir}
         last_error: Optional[RuntimeError] = None
         for attempt in range(self.max_restarts + 1):
             launcher = LocalLauncher(self.num_processes,
                                      self.devices_per_process,
                                      self.platform)
             try:
-                return launcher.run(script, args, timeout)
+                return launcher.run(script, args, timeout,
+                                    extra_env=extra_env)
             except RuntimeError as e:
                 last_error = e
                 kind = self._classify_failure(str(e))
                 self.failure_history.append((attempt, kind,
                                              str(e)[-500:]))
+                if kind == "corrupt":
+                    raise RuntimeError(
+                        "checkpoint restore failed with a checksum "
+                        "mismatch — non-retryable (a relaunch reads the "
+                        "same corrupt bytes); restore an older intact "
+                        "checkpoint or repair storage") from e
                 self.restarts = min(attempt + 1, self.max_restarts)
                 if attempt < self.max_restarts:
                     _time.sleep(self.backoff_s(attempt + 1))
@@ -228,12 +252,15 @@ class LocalLauncher:
         self.platform = platform
 
     def run(self, script: str, args: Sequence[str] = (),
-            timeout: float = 300.0) -> List[str]:
+            timeout: float = 300.0,
+            extra_env: Optional[Dict[str, str]] = None) -> List[str]:
         coordinator = f"127.0.0.1:{free_port()}"
         procs = []
         for rank in range(self.num_processes):
             env = child_env(coordinator, self.num_processes, rank,
                             self.devices_per_process, self.platform)
+            if extra_env:
+                env.update(extra_env)
             procs.append(subprocess.Popen(
                 [sys.executable, "-u", script, *map(str, args)],
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,

@@ -85,6 +85,7 @@ class ContinuousBatcher:
         self._cond = threading.Condition()
         self._stop = False
         self._draining = False
+        self._inflight_since: Optional[float] = None   # monotonic
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name="serving-batcher")
         self._worker.start()
@@ -122,6 +123,22 @@ class ContinuousBatcher:
     def queue_depth(self) -> int:
         with self._cond:
             return len(self._pending)
+
+    @property
+    def accepting(self) -> bool:
+        """Whether a `submit()` right now would be admitted (ignoring
+        queue pressure) — the readiness-probe signal."""
+        with self._cond:
+            return not (self._stop or self._draining)
+
+    @property
+    def inflight_age_s(self) -> Optional[float]:
+        """Seconds the worker has been inside the CURRENT dispatch_fn
+        call, or None when no dispatch is running — a large value means
+        the device path is stuck and the server should stop advertising
+        ready."""
+        since = self._inflight_since
+        return None if since is None else time.monotonic() - since
 
     # ---- worker side ----
     def _expire_locked(self) -> None:
@@ -181,6 +198,7 @@ class ContinuousBatcher:
     def _dispatch(self, batch: List[_Request]) -> None:
         xs = [r.x for r in batch]
         t0 = time.monotonic()
+        self._inflight_since = t0
         try:
             outs = self.dispatch_fn(batch[0].group, xs)
         except Exception as e:         # propagate to every waiter
@@ -188,6 +206,8 @@ class ContinuousBatcher:
             for r in batch:
                 r.future.set_exception(e)
             return
+        finally:
+            self._inflight_since = None
         now = time.monotonic()
         if len(outs) != len(batch):
             err = RuntimeError(

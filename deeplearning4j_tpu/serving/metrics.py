@@ -109,6 +109,10 @@ class ServingMetrics:
                          labels=lbl)
         self.failed = c("serving_failed_total",
                         help="requests failed in dispatch", labels=lbl)
+        self.dispatch_retries = c(
+            "serving_dispatch_retries_total",
+            help="dispatch attempts retried after a transient error",
+            labels=lbl)
         self.completed = c("serving_completed_total",
                            help="requests completed", labels=lbl)
         self.dispatches = c("serving_dispatches_total",

@@ -30,6 +30,7 @@ Example:
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import Future
 from typing import List, Optional, Tuple
 
@@ -49,9 +50,16 @@ class ModelServer:
                  mesh=None, data_axis: str = "data",
                  max_batch: int = 64, batch_timeout_ms: float = 5.0,
                  max_queue: int = 256, min_bucket: int = 1,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 dispatch_retries: int = 1,
+                 dispatch_retry_backoff_ms: float = 10.0,
+                 ready_stuck_threshold_s: float = 30.0):
         self.registry = registry if registry is not None else ModelRegistry()
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.dispatch_retries = int(dispatch_retries)
+        self.dispatch_retry_backoff_ms = float(dispatch_retry_backoff_ms)
+        self.ready_stuck_threshold_s = float(ready_stuck_threshold_s)
+        self._started = time.monotonic()
         self.cache = BucketedCompileCache(
             max_batch=max_batch, min_bucket=min_bucket, mesh=mesh,
             data_axis=data_axis, counters=self.metrics.cache)
@@ -142,19 +150,60 @@ class ModelServer:
 
     def _dispatch(self, group, xs: List[np.ndarray]) -> List[np.ndarray]:
         """Batcher callback: one merged, bucket-padded, AOT-compiled
-        forward for a group of compatible requests."""
+        forward for a group of compatible requests.  A transient error
+        (anything raised by the compiled run) gets `dispatch_retries`
+        retries with backoff before the whole group's futures fail —
+        absorbing one-off allocator/transfer hiccups without the client
+        seeing them."""
         key = group[0]
         with self._entries_lock:
             entry = self._entries[key]
         merged = xs[0] if len(xs) == 1 else np.concatenate(xs, axis=0)
         self.metrics.record_padding(
             self.cache.bucket_for(merged.shape[0]) - merged.shape[0])
-        out = self.cache.run(entry.key, entry.model, merged)
+        attempts = 0
+        while True:
+            try:
+                out = self.cache.run(entry.key, entry.model, merged)
+                break
+            except Exception:
+                if attempts >= self.dispatch_retries:
+                    raise
+                attempts += 1
+                self.metrics.dispatch_retries.inc()
+                time.sleep(self.dispatch_retry_backoff_ms
+                           * (2 ** (attempts - 1)) / 1000.0)
         res, off = [], 0
         for x in xs:
             res.append(out[off: off + x.shape[0]])
             off += x.shape[0]
         return res
+
+    # ---- health / readiness ----
+    def healthz(self) -> dict:
+        """Liveness: the process is up and the server object is answering
+        (exported as `GET /healthz` on ui.server when attached)."""
+        return {"ok": True, "uptime_s": time.monotonic() - self._started}
+
+    def readyz(self, stuck_threshold_s: Optional[float] = None) -> dict:
+        """Readiness: would a request submitted NOW be served?  Requires a
+        non-empty model registry, an accepting (not shut down / draining)
+        batcher, and no dispatch stuck on the device longer than
+        `stuck_threshold_s` (default `ready_stuck_threshold_s`).  Returns
+        ``{"ready": bool, "reasons": [...]}`` — reasons list what failed."""
+        thr = (self.ready_stuck_threshold_s if stuck_threshold_s is None
+               else float(stuck_threshold_s))
+        reasons = []
+        if not self.registry.names():
+            reasons.append("model registry is empty (nothing deployed)")
+        if self._closed or not self.batcher.accepting:
+            reasons.append("batcher is not accepting (shut down/draining)")
+        age = self.batcher.inflight_age_s
+        if age is not None and age > thr:
+            reasons.append(
+                f"dispatch in flight for {age:.1f}s (> {thr:.1f}s) — "
+                "device path looks stuck")
+        return {"ready": not reasons, "reasons": reasons}
 
     # ---- lifecycle / observability ----
     def stats(self) -> dict:

@@ -10,3 +10,6 @@ from deeplearning4j_tpu.train.updaters import (  # noqa: F401
     Nesterovs, NoOp, RmsProp, Sgd, UPDATERS)
 from deeplearning4j_tpu.train.solvers import (  # noqa: F401
     ConjugateGradient, LBFGS, LineGradientDescent)
+from deeplearning4j_tpu.train.resilience import (  # noqa: F401
+    CheckpointManager, DivergenceError, DivergenceGuard,
+    FaultTolerantTrainer, NoIntactCheckpointError, Preempted)

@@ -85,6 +85,31 @@ class UIServer:
                 out.append({"error": repr(e)})
         return out
 
+    def healthz(self) -> dict:
+        """Liveness payload for `GET /healthz` — the server thread is up
+        and rendering."""
+        return {"ok": True,
+                "storages": len(self._storages) + len(self._paths),
+                "serving_sources": len(self._serving)}
+
+    def readyz(self) -> dict:
+        """Aggregate readiness for `GET /readyz`: every attached serving
+        source that exposes `readyz()` must report ready (a source that
+        raises counts as not ready).  With no sources attached the UI is
+        trivially ready — it only serves dashboards."""
+        sources, ready = [], True
+        for s in list(self._serving):
+            fn = getattr(s, "readyz", None)
+            if fn is None:
+                continue
+            try:
+                r = fn()
+            except Exception as e:
+                r = {"ready": False, "reasons": [f"readyz raised: {e!r}"]}
+            sources.append(r)
+            ready = ready and bool(r.get("ready"))
+        return {"ready": ready, "sources": sources}
+
     def _registry_html(self) -> str:
         snap = registry().snapshot(bins=24)
         if not (snap["counters"] or snap["gauges"] or snap["histograms"]):
@@ -134,6 +159,7 @@ class UIServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):          # noqa: N802 (stdlib API)
+                status = 200
                 if self.path.rstrip("/") == "/metrics":
                     # Prometheus text exposition of the process registry
                     body = registry().render_prometheus().encode()
@@ -142,10 +168,21 @@ class UIServer:
                     # machine-readable SLO metrics (scrape endpoint)
                     body = json.dumps(ui._serving_snapshots()).encode()
                     ctype = "application/json"
+                elif self.path.rstrip("/") == "/healthz":
+                    # liveness: this thread answered, so the server is up
+                    body = json.dumps(ui.healthz()).encode()
+                    ctype = "application/json"
+                elif self.path.rstrip("/") == "/readyz":
+                    # readiness: 200 only when every attached serving
+                    # source reports ready (503 tells the LB to drain)
+                    payload = ui.readyz()
+                    status = 200 if payload["ready"] else 503
+                    body = json.dumps(payload).encode()
+                    ctype = "application/json"
                 else:
                     body = ui._render().encode()
                     ctype = "text/html; charset=utf-8"
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

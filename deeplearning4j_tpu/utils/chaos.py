@@ -1,0 +1,201 @@
+"""Deterministic fault injection for resilience testing.
+
+Every injector here is reproducible (fires at a fixed step/batch, flips a
+byte at a deterministic offset) and counts itself in the metrics registry
+(``chaos_faults_injected_total{kind=...}``), so a chaos run's blast
+radius is observable next to the recovery counters it should trigger.
+
+    KillSwitch           kill-at-step-N hook for FaultTolerantTrainer
+                         (SIGTERM / hard-kill / in-process exception)
+    corrupt_checkpoint   flip payload bytes, tear or truncate the manifest
+    FlakyIterator        data producer that raises at batch K (N times)
+    SlowIterator         data producer with a fixed per-batch stall
+    FlakyDispatch        serving dispatch_fn that raises N times
+
+None of this is imported by production code paths — tests (and operators
+running game days) compose it in explicitly.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Optional
+
+from deeplearning4j_tpu.data.iterators import DataSetIterator
+from deeplearning4j_tpu.monitor.registry import registry
+
+
+class ChaosError(RuntimeError):
+    """The injected failure (so tests can distinguish chaos from real
+    bugs)."""
+
+
+def _count(kind: str) -> None:
+    registry().counter(
+        "chaos_faults_injected_total",
+        help="faults injected by utils.chaos, by kind",
+        labels={"kind": kind}).inc()
+
+
+class KillSwitch:
+    """Step hook: kill the process (or raise) once `model.iteration`
+    reaches `at_step`.
+
+    `mode`:
+      * ``"sigterm"`` — `os.kill(os.getpid(), SIGTERM)`: exercises the
+        trainer's preemption checkpoint-and-exit path;
+      * ``"kill"``    — `os._exit(9)`: a hard kill, no cleanup, no final
+        checkpoint — resume must come from the last *committed* save;
+      * ``"exception"`` — raise :class:`ChaosError` in-process.
+
+    `marker` (a file path) makes the switch one-shot across relaunches:
+    the first firing writes the marker, later runs see it and stay
+    disarmed — the standard shape for kill-and-resume tests."""
+
+    def __init__(self, at_step: int, mode: str = "sigterm",
+                 marker: Optional[str] = None):
+        if mode not in ("sigterm", "kill", "exception"):
+            raise ValueError(f"unknown KillSwitch mode {mode!r}")
+        self.at_step = int(at_step)
+        self.mode = mode
+        self.marker = marker
+        self.fired = False
+
+    def armed(self) -> bool:
+        if self.fired:
+            return False
+        return self.marker is None or not os.path.exists(self.marker)
+
+    def __call__(self, trainer) -> None:
+        model = getattr(trainer, "model", trainer)
+        if not self.armed() or int(model.iteration) < self.at_step:
+            return
+        self.fired = True
+        if self.marker is not None:
+            with open(self.marker, "w") as f:
+                f.write(str(int(model.iteration)))
+        _count(self.mode)
+        if self.mode == "sigterm":
+            os.kill(os.getpid(), signal.SIGTERM)
+        elif self.mode == "kill":
+            os._exit(9)
+        else:
+            raise ChaosError(
+                f"KillSwitch fired at iteration {model.iteration}")
+
+
+def corrupt_checkpoint(directory: str, what: str = "payload") -> str:
+    """Deterministically damage a committed checkpoint directory.
+
+    `what`:
+      * ``"payload"``       — flip one byte in the middle of the first
+        ``shards-*.npz`` (caught by the per-chunk crc32 on restore);
+      * ``"manifest"``      — overwrite ``manifest.json`` with truncated
+        (torn-write) JSON;
+      * ``"uncommit"``      — delete the manifest, turning the checkpoint
+        back into an uncommitted torn directory.
+
+    Returns the path of the file damaged."""
+    if what == "uncommit":
+        target = os.path.join(directory, "manifest.json")
+        os.remove(target)
+        _count("uncommit")
+        return target
+    if what == "manifest":
+        target = os.path.join(directory, "manifest.json")
+        with open(target) as f:
+            text = f.read()
+        with open(target, "w") as f:
+            f.write(text[: max(1, len(text) // 2)])
+        _count("manifest")
+        return target
+    if what != "payload":
+        raise ValueError(f"unknown corruption kind {what!r}")
+    shards = sorted(n for n in os.listdir(directory)
+                    if n.startswith("shards-") and n.endswith(".npz"))
+    if not shards:
+        raise FileNotFoundError(f"{directory}: no shards-*.npz to corrupt")
+    target = os.path.join(directory, shards[0])
+    with open(target, "rb") as f:
+        data = bytearray(f.read())
+    data[len(data) // 2] ^= 0xFF
+    with open(target, "wb") as f:
+        f.write(bytes(data))
+    _count("payload")
+    return target
+
+
+class FlakyIterator(DataSetIterator):
+    """Wraps a DataSetIterator; raises `exc_type` when batch `fail_at`
+    would be produced, `times` times total (across epochs/resets), then
+    behaves normally — the transient-producer-failure shape the
+    pipeline's `retries=` recovers from."""
+
+    def __init__(self, underlying: DataSetIterator, fail_at: int = 0,
+                 times: int = 1, exc_type=ChaosError):
+        self.underlying = underlying
+        self.fail_at = int(fail_at)
+        self.failures_left = int(times)
+        self.exc_type = exc_type
+
+    def __iter__(self):
+        for i, ds in enumerate(self.underlying):
+            if i == self.fail_at and self.failures_left > 0:
+                self.failures_left -= 1
+                _count("producer")
+                raise self.exc_type(
+                    f"injected producer failure at batch {i}")
+            yield ds
+
+    def reset(self):
+        self.underlying.reset()
+
+    def batch_size(self) -> int:
+        return self.underlying.batch_size()
+
+    def __len__(self):
+        return len(self.underlying)
+
+
+class SlowIterator(DataSetIterator):
+    """Wraps a DataSetIterator with a fixed `delay_s` sleep per batch —
+    for backpressure / stuck-pipeline readiness scenarios."""
+
+    def __init__(self, underlying: DataSetIterator, delay_s: float = 0.05):
+        self.underlying = underlying
+        self.delay_s = float(delay_s)
+
+    def __iter__(self):
+        for ds in self.underlying:
+            time.sleep(self.delay_s)
+            yield ds
+
+    def reset(self):
+        self.underlying.reset()
+
+    def batch_size(self) -> int:
+        return self.underlying.batch_size()
+
+    def __len__(self):
+        return len(self.underlying)
+
+
+class FlakyDispatch:
+    """Wraps a serving `dispatch_fn` (or any callable): raises `exc_type`
+    for the first `times` calls, then delegates — the transient dispatch
+    error `ModelServer._dispatch`'s retry absorbs."""
+
+    def __init__(self, fn, times: int = 1, exc_type=ChaosError):
+        self.fn = fn
+        self.failures_left = int(times)
+        self.exc_type = exc_type
+        self.calls = 0
+
+    def __call__(self, *args, **kwargs):
+        self.calls += 1
+        if self.failures_left > 0:
+            self.failures_left -= 1
+            _count("dispatch")
+            raise self.exc_type("injected dispatch failure")
+        return self.fn(*args, **kwargs)

@@ -1,0 +1,87 @@
+"""Single-process fault-tolerant training worker (spawned by
+test_resilience — NOT a pytest file).
+
+Trains a small seeded MLN under `FaultTolerantTrainer` with periodic
+checkpoints; a `chaos.KillSwitch` hook kills the process partway on the
+FIRST launch (marker file guards the one-shot).  The test relaunches the
+same command line until it exits 0, then compares `final.npz` against an
+uninterrupted run — auto-resume must be bitwise invisible.
+
+argv: work_dir epochs kill_mode kill_at zero1 save_every fused prefetch
+  kill_mode: none | sigterm | kill | exception
+  zero1/fused/prefetch: 0|1
+"""
+import os
+import sys
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from deeplearning4j_tpu.data import (ArrayDataSetIterator,  # noqa: E402
+                                     DevicePrefetchIterator)
+from deeplearning4j_tpu.data.normalizers import (  # noqa: E402
+    NormalizerStandardize)
+from deeplearning4j_tpu.nn import (DenseLayer, InputType,  # noqa: E402
+                                   MultiLayerNetwork, NeuralNetConfiguration,
+                                   OutputLayer)
+from deeplearning4j_tpu.parallel import (ParallelWrapper,  # noqa: E402
+                                         make_mesh)
+from deeplearning4j_tpu.train import Adam  # noqa: E402
+from deeplearning4j_tpu.train.resilience import (CheckpointManager,  # noqa: E402
+                                                 FaultTolerantTrainer,
+                                                 Preempted)
+from deeplearning4j_tpu.utils import chaos  # noqa: E402
+
+(work_dir, epochs, kill_mode, kill_at, zero1, save_every, fused,
+ prefetch) = sys.argv[1:9]
+epochs, kill_at = int(epochs), int(kill_at)
+save_every = int(save_every)
+zero1, fused, prefetch = zero1 == "1", fused == "1", prefetch == "1"
+
+rng = np.random.default_rng(0)
+X = rng.standard_normal((48, 10))
+Y = np.eye(3)[rng.integers(0, 3, 48)]
+
+conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(0.01))
+        .list([DenseLayer(n_out=16, activation="tanh"),
+               OutputLayer(n_out=3, loss="mcxent", activation="softmax")])
+        .set_input_type(InputType.feed_forward(10)).build())
+net = MultiLayerNetwork(conf).init()
+model = (ParallelWrapper(net, make_mesh(), optimizer_sharding=True)
+         if zero1 else net)
+
+manager = CheckpointManager(os.path.join(work_dir, "ckpt"), keep_last=3,
+                            save_every_steps=save_every, async_save=True)
+# pass the fitted normalizer only on a FRESH start; on resume the trainer
+# must rebuild it from checkpoint metadata (that's part of the test)
+norm = None
+if manager.latest_step() is None:
+    norm = NormalizerStandardize()
+    norm.fit(ArrayDataSetIterator(X, Y, 8))
+
+hooks = ()
+if kill_mode != "none":
+    hooks = (chaos.KillSwitch(at_step=kill_at, mode=kill_mode,
+                              marker=os.path.join(work_dir, "killed_once")),)
+
+data = ArrayDataSetIterator(X, Y, 8)
+if prefetch:
+    data = DevicePrefetchIterator(data)
+
+trainer = FaultTolerantTrainer(model, manager, normalizer=norm, hooks=hooks)
+try:
+    trainer.fit(data, epochs=epochs, fused_steps=2 if fused else 1)
+except Preempted as e:
+    print(f"preempted at iteration {net.iteration}", flush=True)
+    sys.exit(e.exit_code)
+
+np.savez(os.path.join(work_dir, "final.npz"),
+         params=np.asarray(net.params()),
+         iteration=np.int64(net.iteration))
+print(f"done at iteration {net.iteration}"
+      + (f" (resumed from step {trainer.resumed_from['step']})"
+         if trainer.resumed_from is not None else ""), flush=True)

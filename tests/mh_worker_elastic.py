@@ -5,7 +5,15 @@ Trains an MLN across processes with per-step checkpoints; on the FIRST
 launch, rank 1 deliberately crashes partway (marker file guards the
 one-shot crash).  The relaunch must resume from the checkpoint and finish
 all steps — proving failure detection (coordination-service heartbeat
-kills the gang) + elastic restart + exact resume."""
+kills the gang) + elastic restart + exact resume.
+
+Two checkpoint paths:
+* `DL4J_TPU_CHECKPOINT_DIR` set (ElasticLocalRunner.run(checkpoint_dir=))
+  — sharded `train.resilience.CheckpointManager` checkpoints: every rank
+  writes its shards, commit is the atomic manifest, resume goes through
+  the resharding loader (full state incl. RNG and counters).
+* unset — legacy single-process zip via rank 0 (the pre-resilience path).
+"""
 import os
 import sys
 
@@ -27,6 +35,7 @@ work_dir = sys.argv[1]
 total_steps = int(sys.argv[2])
 crash_at = int(sys.argv[3])
 rank = multihost.process_index()
+ckpt_dir = os.environ.get(multihost.ENV_CKPT)
 ckpt = os.path.join(work_dir, "ckpt.zip")
 crash_marker = os.path.join(work_dir, "crashed_once")
 
@@ -37,19 +46,36 @@ per = X.shape[0] // multihost.process_count()
 xl = X[rank * per:(rank + 1) * per]
 yl = Y[rank * per:(rank + 1) * per]
 
-if os.path.exists(ckpt):
-    net = MultiLayerNetwork.load(ckpt)
-    print(f"rank {rank}: resumed at iteration {net.iteration}", flush=True)
-else:
+
+def build():
     conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.1))
             .list([DenseLayer(n_out=16, activation="tanh"),
                    OutputLayer(n_out=2, loss="mcxent",
                                activation="softmax")])
             .set_input_type(InputType.feed_forward(10)).build())
-    net = MultiLayerNetwork(conf).init()
+    return MultiLayerNetwork(conf).init()
+
+
+manager = None
+if ckpt_dir:
+    from deeplearning4j_tpu.train.resilience import CheckpointManager
+    manager = CheckpointManager(ckpt_dir, keep_last=2)
+    net = build()
+elif os.path.exists(ckpt):
+    net = MultiLayerNetwork.load(ckpt)
+    print(f"rank {rank}: resumed at iteration {net.iteration}", flush=True)
+else:
+    net = build()
 
 mesh = multihost.global_mesh()
 pw = ParallelWrapper(net, mesh)
+if manager is not None and manager.latest_step() is not None:
+    # place FIRST so the resharding loader assembles straight at the
+    # global sharding (a committed single-device array can't be re-placed
+    # across processes on the CPU backend)
+    pw._place_model()
+    manager.restore(net)
+    print(f"rank {rank}: resumed at iteration {net.iteration}", flush=True)
 while net.iteration < total_steps:
     if (net.iteration == crash_at and rank == 1
             and not os.path.exists(crash_marker)):
@@ -62,7 +88,10 @@ while net.iteration < total_steps:
     # dispatch is async, so without this a crashing rank can take down
     # collectives that logically "happened" steps ago
     jax.block_until_ready(net.params_)
-    if rank == 0:
+    if manager is not None:
+        # every rank participates (save barrier); commit is atomic
+        manager.save(net, block=True)
+    elif rank == 0:
         # atomic checkpoint: a mid-write kill must not corrupt the file
         net.save(ckpt + ".tmp")
         os.replace(ckpt + ".tmp", ckpt)
