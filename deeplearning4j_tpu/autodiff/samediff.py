@@ -484,6 +484,8 @@ class SameDiff:
         self._train_step = None
         self._scan_step = None
         self._step_transform = None   # ZeRO-1 weight update (parallel/zero)
+        self._exec_cache_override = None  # compile.PersistentExecutableCache
+        self._schedule = None             # compile.Schedule (autotuner)
         self._output_fns: Dict[Tuple[str, ...], Callable] = {}
         self._key = jax.random.PRNGKey(0)
         self.math = SDMath(self)
@@ -818,8 +820,55 @@ class SameDiff:
 
         return step
 
+    def _exec_cache(self):
+        """The persistent executable cache in play: the per-graph override
+        (`set_executable_cache`), else the process default — None keeps
+        the plain jax.jit path."""
+        if self._exec_cache_override is not None:
+            return self._exec_cache_override
+        from deeplearning4j_tpu.compile import default_cache
+        return default_cache()
+
+    def set_executable_cache(self, cache) -> "SameDiff":
+        """Route this graph's train-step compilation through a
+        `compile.PersistentExecutableCache` (or a directory path); None
+        reverts to the process default.  Triggers a step rebuild."""
+        if isinstance(cache, str):
+            from deeplearning4j_tpu.compile import PersistentExecutableCache
+            cache = PersistentExecutableCache(cache)
+        self._exec_cache_override = cache
+        self._train_step = None
+        self._scan_step = None
+        return self
+
+    def apply_schedule(self, schedule) -> "SameDiff":
+        """Install an autotuned `compile.Schedule` (iterator `fit()`
+        defaults `fused_steps` from it; the step builder honors
+        `schedule.donation`).  Triggers a step rebuild."""
+        self._schedule = schedule
+        self._train_step = None
+        self._scan_step = None
+        return self
+
+    def _donate_argnums(self) -> tuple:
+        if self._schedule is not None and not self._schedule.donation:
+            return ()
+        return (0, 1)
+
+    def _aot_key_parts(self) -> dict:
+        from deeplearning4j_tpu.compile import (model_fingerprint,
+                                                transform_fingerprint)
+        return {"kind": "samediff_train_step",
+                "model": model_fingerprint(self),
+                "transform": transform_fingerprint(self._step_transform)}
+
     def _build_train_step(self):
-        return jax.jit(self._build_step_body(), donate_argnums=(0, 1))
+        from deeplearning4j_tpu.compile import step_function
+        return step_function(self._build_step_body(),
+                             donate_argnums=self._donate_argnums(),
+                             key_base=self._aot_key_parts,
+                             cache=self._exec_cache(),
+                             dynamic_argnums=(2,))
 
     def _build_scan_step(self):
         """k steps per dispatch (see utils/scan_fit.py); SameDiff's carry
@@ -832,15 +881,25 @@ class SameDiff:
             v, o, loss, r, it = body(v, o, feed, r, it, epoch)
             return (v, o, r, it), loss
 
-        return make_scan_step(tick)
+        return make_scan_step(
+            tick,
+            key_base=lambda: dict(self._aot_key_parts(),
+                                  kind="samediff_scan_step"),
+            cache=self._exec_cache(),
+            donate=(self._schedule is None or self._schedule.donation))
 
     def fit(self, data=None, labels=None, *, iterator=None, epochs: int = 1,
             feeds: Optional[Dict[str, Any]] = None,
-            fused_steps: int = 1) -> "SameDiff":
+            fused_steps: Optional[int] = None) -> "SameDiff":
         """fit(features, labels) / fit(feeds={...}) for one batch, or
         fit(iterator=multi_data_set_iterator, epochs=N).  `fused_steps=k`
         fuses blocks of k consecutive same-shape batches from the
-        iterator into one `fit_steps` dispatch (tails fall back)."""
+        iterator into one `fit_steps` dispatch (tails fall back); unset,
+        it defaults to the installed schedule's (`apply_schedule`),
+        else 1."""
+        if fused_steps is None:
+            fused_steps = (self._schedule.fused_steps
+                           if self._schedule is not None else 1)
         if self.training_config is None:
             raise ValueError("set_training_config(...) first (reference "
                              "throws the same)")

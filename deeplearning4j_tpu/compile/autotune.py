@@ -1,0 +1,258 @@
+"""Schedule autotuner: learned search over the execution-config space.
+
+TVM's lesson (PAPERS.md, arXiv 1802.04799) applied to the knobs this
+framework already exposes but makes users hand-tune: `fused_steps` (scan
+block size), device prefetch depth, ZeRO-1 optimizer sharding on/off,
+buffer donation, and the serving bucket ladder.  The autotuner measures
+real steps/sec per candidate through a caller-supplied measure function
+(bench.py provides one), searches with a coarse grid over the
+highest-impact dimensions followed by greedy per-dimension refinement,
+and persists the winner as a JSON artifact next to the executable store
+— `load_schedule()` re-applies it at build time in any later process, so
+a tuned config survives restarts the same way the compiled executables
+do.
+
+    sch = ScheduleAutotuner(measure).search()
+    save_schedule(sch, cache_dir, model=net)
+    ...                                   # any later process:
+    sch = load_schedule(cache_dir, model=net)
+    if sch: sch.apply(net)                # or ParallelWrapper / ModelServer
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from deeplearning4j_tpu.compile.fingerprint import (environment_fingerprint,
+                                                    model_fingerprint)
+
+SCHEDULE_FORMAT = "deeplearning4j_tpu.schedule.v1"
+
+
+@dataclasses.dataclass
+class Schedule:
+    """One point in the execution-config space.
+
+    Training knobs: `fused_steps` (k steps per compiled scan dispatch),
+    `prefetch_depth` (device-staging depth for DevicePrefetchIterator),
+    `zero1` (ZeRO-1 sharded weight update), `donation` (donate
+    params/state/opt buffers to the step).  Serving knobs: `min_bucket` /
+    `buckets` (the compile-cache bucket ladder).  `steps_per_sec` records
+    the winning measurement for regression checks on re-apply."""
+
+    fused_steps: int = 1
+    prefetch_depth: int = 2
+    zero1: bool = False
+    donation: bool = True
+    min_bucket: Optional[int] = None
+    buckets: Optional[List[int]] = None
+    steps_per_sec: Optional[float] = None
+    source: str = "default"          # default | autotuned | loaded
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    # ---- serialization ----
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "Schedule":
+        known = {f.name for f in dataclasses.fields(Schedule)}
+        return Schedule(**{k: v for k, v in d.items() if k in known})
+
+    def config_key(self) -> tuple:
+        """Identity of the *configuration* (measurement metadata excluded)
+        — the autotuner's dedup key."""
+        return (self.fused_steps, self.prefetch_depth, self.zero1,
+                self.donation, self.min_bucket,
+                tuple(self.buckets) if self.buckets else None)
+
+    # ---- application hooks ----
+    def apply(self, target) -> Any:
+        """Apply this schedule to a build-time target, duck-typed:
+
+        * MultiLayerNetwork / ComputationGraph / SameDiff — installs the
+          schedule (iterator `fit` defaults to `fused_steps`, the step
+          builders honor `donation`).
+        * ParallelWrapper — toggles ZeRO-1 and applies to the wrapped
+          model.
+        * ModelServer / BucketedCompileCache — reconfigures the bucket
+          ladder (prefer passing `schedule=` at construction).
+
+        Returns `target` for chaining."""
+        if hasattr(target, "apply_schedule"):          # models + wrapper
+            return target.apply_schedule(self)
+        if hasattr(target, "cache") and hasattr(target.cache, "set_buckets"):
+            if self.buckets or self.min_bucket:        # ModelServer
+                target.cache.set_buckets(buckets=self.buckets,
+                                         min_bucket=self.min_bucket)
+            return target
+        if hasattr(target, "set_buckets"):             # BucketedCompileCache
+            if self.buckets or self.min_bucket:
+                target.set_buckets(buckets=self.buckets,
+                                   min_bucket=self.min_bucket)
+            return target
+        raise TypeError(
+            f"don't know how to apply a Schedule to {type(target).__name__}")
+
+    def wrap_iterator(self, iterator, **kwargs):
+        """Stage `iterator` through a DevicePrefetchIterator at this
+        schedule's prefetch depth (the input-pipeline application hook)."""
+        from deeplearning4j_tpu.data.pipeline import DevicePrefetchIterator
+        return DevicePrefetchIterator(iterator,
+                                      depth=max(1, self.prefetch_depth),
+                                      **kwargs)
+
+
+# Coarse-grid dimensions first: block size and optimizer sharding dominate
+# steps/sec; prefetch/donation/buckets are refined greedily from the grid
+# winner.
+DEFAULT_SPACE: Dict[str, List[Any]] = {
+    "fused_steps": [1, 2, 4, 8, 16],
+    "zero1": [False, True],
+    "prefetch_depth": [1, 2, 4],
+    "donation": [True, False],
+}
+GRID_DIMS = ("fused_steps", "zero1")
+
+
+class ScheduleAutotuner:
+    """Grid + greedy-refinement search over `Schedule` space.
+
+    `measure(schedule) -> steps/sec` (higher is better) is the only
+    contract; bench.py's `measure_training` builds one from a model
+    factory, tests rig one analytically.  Measurements are memoized per
+    config, every evaluation lands in `history`, and the returned
+    schedule carries its winning steps/sec + search metadata."""
+
+    def __init__(self, measure: Callable[[Schedule], float],
+                 space: Optional[Dict[str, List[Any]]] = None,
+                 base: Optional[Schedule] = None,
+                 refine_rounds: int = 2,
+                 on_candidate: Optional[Callable[[Schedule, float], None]]
+                 = None):
+        self.measure = measure
+        self.space = dict(space if space is not None else DEFAULT_SPACE)
+        self.base = base if base is not None else Schedule()
+        self.refine_rounds = int(refine_rounds)
+        self.on_candidate = on_candidate
+        self.history: List[Dict[str, Any]] = []
+        self._memo: Dict[tuple, float] = {}
+
+    def _eval(self, cand: Schedule) -> float:
+        key = cand.config_key()
+        if key in self._memo:
+            return self._memo[key]
+        sps = float(self.measure(cand))
+        self._memo[key] = sps
+        self.history.append(dict(cand.to_json(), steps_per_sec=sps))
+        if self.on_candidate is not None:
+            self.on_candidate(cand, sps)
+        return sps
+
+    def search(self) -> Schedule:
+        t0 = time.perf_counter()
+        best = self.base
+        best_sps = self._eval(best)
+
+        # stage 1 — coarse grid over the dominant dimensions
+        grid_dims = [d for d in GRID_DIMS if d in self.space]
+        def grid(cands, dim_i):
+            if dim_i == len(grid_dims):
+                yield cands
+                return
+            for v in self.space[grid_dims[dim_i]]:
+                yield from grid(dict(cands, **{grid_dims[dim_i]: v}),
+                                dim_i + 1)
+        for combo in grid({}, 0):
+            cand = dataclasses.replace(best, **combo)
+            sps = self._eval(cand)
+            if sps > best_sps:
+                best, best_sps = cand, sps
+
+        # stage 2 — greedy per-dimension refinement from the grid winner
+        for _ in range(self.refine_rounds):
+            improved = False
+            for dim, values in self.space.items():
+                for v in values:
+                    cand = dataclasses.replace(best, **{dim: v})
+                    sps = self._eval(cand)
+                    if sps > best_sps:
+                        best, best_sps = cand, sps
+                        improved = True
+            if not improved:
+                break
+
+        return dataclasses.replace(
+            best, steps_per_sec=best_sps, source="autotuned",
+            meta={"evaluated": len(self._memo),
+                  "search_wall_s": round(time.perf_counter() - t0, 3),
+                  "baseline_steps_per_sec": self.history[0]["steps_per_sec"],
+                  "env": environment_fingerprint()})
+
+
+# ---------------------------------------------------------------------------
+# Persistence (JSON artifact next to the executable store)
+# ---------------------------------------------------------------------------
+
+def _schedule_name(name: Optional[str], model) -> str:
+    if name is not None:
+        return name
+    if model is not None:
+        return model_fingerprint(model)[:16]
+    return "default"
+
+
+def schedule_path(directory: str, name: Optional[str] = None,
+                  model=None) -> str:
+    return os.path.join(os.path.expanduser(directory),
+                        f"schedule-{_schedule_name(name, model)}.json")
+
+
+def save_schedule(schedule: Schedule, directory: str,
+                  name: Optional[str] = None, model=None) -> str:
+    """Atomically persist `schedule` as
+    `<directory>/schedule-<name|model-fingerprint>.json`; returns the
+    path.  Same tmp+rename commit discipline as the executable entries."""
+    directory = os.path.expanduser(directory)
+    os.makedirs(directory, exist_ok=True)
+    path = schedule_path(directory, name, model)
+    doc = {"format": SCHEDULE_FORMAT,
+           "schedule": schedule.to_json(),
+           "env": environment_fingerprint(),
+           "written_at": time.time()}
+    fd, tmp = tempfile.mkstemp(dir=directory, prefix=".tmp-schedule-")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=2)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def load_schedule(directory: str, name: Optional[str] = None,
+                  model=None) -> Optional[Schedule]:
+    """The persisted schedule for (directory, name-or-model), or None when
+    absent/unreadable/wrong format.  Loaded schedules are marked
+    `source="loaded"`; the recorded `steps_per_sec` rides along so callers
+    can regression-check a re-application against the tuning measurement."""
+    path = schedule_path(directory, name, model)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        if doc.get("format") != SCHEDULE_FORMAT:
+            return None
+        sch = Schedule.from_json(doc["schedule"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+    sch.source = "loaded"
+    sch.meta = dict(sch.meta, loaded_from=path)
+    return sch

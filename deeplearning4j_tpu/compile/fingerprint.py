@@ -1,0 +1,207 @@
+"""Cache-key fingerprints for persisted compiled executables.
+
+A serialized executable is only reusable when *everything* that shaped the
+compiled program matches: the jax/jaxlib/XLA version that produced it, the
+backend topology it was compiled for (platform, device kind and count,
+mesh axes), the model program (config + parameter tree structure, shapes,
+dtypes, plus any constants baked into the trace — on-device normalizer
+stats, ZeRO-1 layout plans), and the concrete argument signature.  Each of
+those becomes a component of one canonical-JSON key whose sha256 names the
+on-disk entry (`PersistentExecutableCache`), so a version bump or topology
+change *changes the key* — stale executables are unreachable rather than
+detected after the fact.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def canonical_json(obj: Any) -> str:
+    """Deterministic JSON: sorted keys, no whitespace drift."""
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"),
+                      default=str)
+
+
+def digest(parts: Any) -> str:
+    """sha256 hex of the canonical JSON of `parts` — the cache key."""
+    return hashlib.sha256(canonical_json(parts).encode()).hexdigest()
+
+
+_env_fp: Optional[Dict[str, Any]] = None
+
+
+def environment_fingerprint() -> Dict[str, Any]:
+    """Process-wide compile-environment identity: jax/jaxlib versions and
+    the default backend's platform/device population.  Cached after first
+    call (none of it changes within a process)."""
+    global _env_fp
+    if _env_fp is None:
+        import jax
+        try:
+            import jaxlib
+            jaxlib_ver = getattr(jaxlib, "__version__", "?")
+        except Exception:       # pragma: no cover - jaxlib always present
+            jaxlib_ver = "?"
+        devs = jax.devices()
+        _env_fp = {
+            "jax": jax.__version__,
+            "jaxlib": jaxlib_ver,
+            "platform": devs[0].platform if devs else "none",
+            "device_kind": devs[0].device_kind if devs else "none",
+            "device_count": len(devs),
+            "process_count": jax.process_count(),
+        }
+    return _env_fp
+
+
+def _reset_environment_fingerprint() -> None:
+    """Test hook: drop the cached fingerprint (e.g. after monkeypatching)."""
+    global _env_fp
+    _env_fp = None
+
+
+def mesh_fingerprint(mesh) -> Optional[Dict[str, Any]]:
+    """Topology identity of a `jax.sharding.Mesh` (None passes through):
+    axis names/sizes plus the flat device-id order — two meshes with the
+    same shape over *differently ordered* devices compile to different
+    collectives."""
+    if mesh is None:
+        return None
+    return {
+        "axes": {str(k): int(v) for k, v in mesh.shape.items()},
+        "device_ids": [int(d.id) for d in mesh.devices.flat],
+    }
+
+
+def tree_spec(tree: Any) -> List[Tuple[str, Tuple[int, ...], str]]:
+    """(path, shape, dtype) for every leaf — the structural identity of a
+    params/state pytree (values are runtime arguments, NOT part of the
+    compiled program, so they stay out of the key)."""
+    import jax
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        out.append((jax.tree_util.keystr(path),
+                    tuple(int(s) for s in np.shape(leaf)),
+                    str(getattr(leaf, "dtype", type(leaf).__name__))))
+    return out
+
+
+def _closure_arrays(fn, depth: int = 0) -> List[np.ndarray]:
+    """Arrays captured (possibly transitively) by a closure — how a
+    DeviceNormalizer carries its fitted stats into the traced step."""
+    out: List[np.ndarray] = []
+    if depth > 4:
+        return out
+    for cell in getattr(fn, "__closure__", None) or ():
+        try:
+            v = cell.cell_contents
+        except ValueError:          # pragma: no cover - empty cell
+            continue
+        if hasattr(v, "shape") and hasattr(v, "dtype"):
+            out.append(np.asarray(v))
+        elif callable(v):
+            out.extend(_closure_arrays(v, depth + 1))
+    return out
+
+
+def _device_norm_fingerprint(dn) -> Optional[Dict[str, Any]]:
+    """An attached DeviceNormalizer's stats are *baked into the executable
+    as constants*, so the key must hash their values, not just shapes.
+    The stats live in the apply closures; if none can be extracted the
+    fingerprint degrades to a process-unique nonce — the disk cache then
+    always misses for this model, which is slow but can never serve an
+    executable with the wrong constants baked in."""
+    if dn is None:
+        return None
+    if isinstance(dn, dict):        # ComputationGraph: input name -> norm
+        if not dn:
+            return None
+        return {k: _device_norm_fingerprint(v)
+                for k, v in sorted(dn.items())}
+    arrays: List[np.ndarray] = []
+    for fn in (getattr(dn, "_features", None), getattr(dn, "_labels", None)):
+        if fn is not None:
+            arrays.extend(_closure_arrays(fn))
+    if not arrays:
+        return {"kind": type(dn).__name__, "opaque_nonce": id(dn)}
+    crcs = sorted(
+        (str(a.dtype), list(a.shape),
+         zlib.crc32(np.ascontiguousarray(a).tobytes()) & 0xFFFFFFFF)
+        for a in arrays)
+    return {"kind": type(dn).__name__, "stats": crcs}
+
+
+def transform_fingerprint(zt) -> Optional[Dict[str, Any]]:
+    """Identity of a ZeRO-1 step transform: the mesh topology plus every
+    leaf's placement plan (kind/shape/pad/specs) — the plans decide which
+    collectives the compiled step contains."""
+    if zt is None:
+        return None
+    import jax
+    plans = []
+    for path, pl in jax.tree_util.tree_flatten_with_path(
+            zt.plans, is_leaf=lambda x: hasattr(x, "store"))[0]:
+        plans.append([jax.tree_util.keystr(path), pl.kind,
+                      list(pl.shape), int(pl.pad),
+                      str(pl.store), str(pl.update), str(pl.compute)])
+    return {"axis": zt.axis, "mesh": mesh_fingerprint(zt.mesh),
+            "plans": plans}
+
+
+def model_fingerprint(model) -> str:
+    """Stable identity of the *program* a model's forward/step traces to:
+    configuration JSON (layers, updater, dtypes, regularization, remat),
+    parameter/state tree structure+shapes+dtypes, baked-in normalizer
+    stats, and the model class.  Two models with identical architecture
+    but different weights share a fingerprint — weights are runtime
+    arguments, so one cached executable serves both (that is what makes a
+    version roll of retrained weights come up warm)."""
+    parts: Dict[str, Any] = {"class": type(model).__name__}
+    conf = getattr(model, "conf", None)
+    if conf is not None and hasattr(conf, "to_json"):
+        # the seed only picks initial weight values — runtime data, not
+        # part of the traced program — so it must not split the key
+        cd = json.loads(conf.to_json())
+        cd.pop("seed", None)
+        parts["conf"] = canonical_json(cd)
+    elif hasattr(model, "_nodes"):     # SameDiff: the graph IS the config
+        import dataclasses
+        parts["nodes"] = [canonical_json(dataclasses.asdict(n))
+                          for n in model._nodes.values()]
+        tc = getattr(model, "training_config", None)
+        parts["training_config"] = tc.to_json() if tc is not None else None
+        parts["loss_variables"] = sorted(getattr(model, "_loss_names", []))
+    params = getattr(model, "params_", None)
+    if params is None:
+        params = getattr(model, "variables_", None)
+    parts["params"] = tree_spec(params)
+    parts["state"] = tree_spec(getattr(model, "state_", None))
+    parts["device_norm"] = _device_norm_fingerprint(
+        getattr(model, "_device_norm", None))
+    return digest(parts)
+
+
+def args_signature(args: Any) -> Tuple:
+    """Hashable in-process signature of a call's argument pytree: tree
+    structure + per-leaf (shape, dtype, weak_type).  Drives the in-memory
+    executable dispatch table; `signature_json` renders the same content
+    deterministically for the on-disk key."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    return (treedef, tuple(
+        (tuple(int(s) for s in np.shape(l)),
+         str(getattr(l, "dtype", type(l).__name__)),
+         bool(getattr(l, "weak_type", False)))
+        for l in leaves))
+
+
+def signature_json(sig: Tuple) -> Dict[str, Any]:
+    """Disk-key form of an `args_signature` tuple."""
+    treedef, leaves = sig
+    return {"tree": str(treedef),
+            "leaves": [[list(s), d, w] for s, d, w in leaves]}

@@ -38,6 +38,15 @@ docs/observability.md):
   resilience_divergence_events_total NaN/inf/spike steps the guard caught
   resilience_preemptions_total       SIGTERM checkpoint-and-exit events
   chaos_faults_injected_total{kind=} faults injected by utils.chaos
+  aot_cache_hits_total               executables deserialized from disk
+  aot_cache_misses_total             disk lookups that found no usable entry
+  aot_cache_compiles_total           fresh XLA compiles through the cache
+  aot_cache_stores_total             executables serialized+committed to disk
+  aot_cache_errors_total             corrupt/mismatched/unserializable events
+  aot_cache_bytes_read_total         entry bytes deserialized from disk
+  aot_cache_bytes_written_total      entry bytes committed to disk
+  aot_cache_load_ms                  disk-hit deserialize wall time
+  aot_cache_store_ms                 serialize+commit wall time
 """
 from __future__ import annotations
 
@@ -238,8 +247,61 @@ class ResilienceInstruments:
         self.checkpoints.inc()
 
 
+class AotCacheInstruments:
+    """Persistent-executable-cache handles (compile.persistent)."""
+
+    def __init__(self, registry_: Optional[MetricsRegistry] = None):
+        reg = registry_ if registry_ is not None else registry()
+        self.hits = reg.counter(
+            "aot_cache_hits_total",
+            help="compiled executables deserialized from the persistent "
+            "on-disk cache (a warm process start shows only these)")
+        self.misses = reg.counter(
+            "aot_cache_misses_total",
+            help="persistent-cache lookups that found no usable entry")
+        self.compiles = reg.counter(
+            "aot_cache_compiles_total",
+            help="fresh XLA compiles performed through the persistent "
+            "cache (each one is then serialized when the backend allows)")
+        self.stores = reg.counter(
+            "aot_cache_stores_total",
+            help="serialized executables committed to disk")
+        self.errors = reg.counter(
+            "aot_cache_errors_total",
+            help="defective entries (crc/header mismatch, torn write) and "
+            "serialize/deserialize failures — all degrade to a recompile, "
+            "never to serving a stale executable")
+        self.bytes_read = reg.counter(
+            "aot_cache_bytes_read_total",
+            help="entry bytes read on disk hits")
+        self.bytes_written = reg.counter(
+            "aot_cache_bytes_written_total",
+            help="entry bytes committed on stores")
+        self.load_ms = reg.histogram(
+            "aot_cache_load_ms",
+            help="disk-hit wall time: read + crc verify + deserialize (ms)")
+        self.store_ms = reg.histogram(
+            "aot_cache_store_ms",
+            help="store wall time: serialize + atomic commit (ms)")
+        self.last_error: Optional[str] = None
+
+    def note_error(self, where: str, exc: BaseException) -> None:
+        """Keep the most recent defect human-readable for debugging (the
+        counters say how often; this says what)."""
+        self.last_error = f"{where}: {exc!r}"[:500]
+
+
 _pipeline: Optional[PipelineInstruments] = None
 _resilience: Optional[ResilienceInstruments] = None
+_aot: Optional[AotCacheInstruments] = None
+
+
+def aot_instruments() -> AotCacheInstruments:
+    """Process-wide AOT-cache handle bundle (lazy singleton)."""
+    global _aot
+    if _aot is None:
+        _aot = AotCacheInstruments()
+    return _aot
 
 
 def pipeline_instruments() -> PipelineInstruments:

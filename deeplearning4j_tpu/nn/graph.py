@@ -469,6 +469,8 @@ class ComputationGraph:
         self._vertex_types: Dict[str, InputType] = {}
         self._device_norm: Dict[str, Any] = {}  # input name -> DeviceNormalizer
         self._instr: Optional[TrainingInstruments] = None
+        self._exec_cache_override = None  # compile.PersistentExecutableCache
+        self._schedule = None             # compile.Schedule (autotuner)
 
     def _instruments(self) -> TrainingInstruments:
         """Lazy telemetry handles shared via the monitor registry."""
@@ -665,10 +667,57 @@ class ComputationGraph:
 
         return step
 
+    def _exec_cache(self):
+        """The persistent executable cache in play: the per-model override
+        (`set_executable_cache`), else the process default — None keeps
+        the plain jax.jit path."""
+        if self._exec_cache_override is not None:
+            return self._exec_cache_override
+        from deeplearning4j_tpu.compile import default_cache
+        return default_cache()
+
+    def set_executable_cache(self, cache) -> "ComputationGraph":
+        """Route this graph's train-step compilation through a
+        `compile.PersistentExecutableCache` (or a directory path); None
+        reverts to the process default.  Triggers a step rebuild."""
+        if isinstance(cache, str):
+            from deeplearning4j_tpu.compile import PersistentExecutableCache
+            cache = PersistentExecutableCache(cache)
+        self._exec_cache_override = cache
+        self._train_step = None
+        self._scan_step = None
+        return self
+
+    def apply_schedule(self, schedule) -> "ComputationGraph":
+        """Install an autotuned `compile.Schedule` (iterator `fit()`
+        defaults `fused_steps` from it; step builders honor
+        `schedule.donation`).  Triggers a step rebuild."""
+        self._schedule = schedule
+        self._train_step = None
+        self._scan_step = None
+        return self
+
+    def _donate_argnums(self) -> tuple:
+        if self._schedule is not None and not self._schedule.donation:
+            return ()
+        return (0, 1, 2)
+
+    def _aot_key_parts(self) -> dict:
+        from deeplearning4j_tpu.compile import (model_fingerprint,
+                                                transform_fingerprint)
+        return {"kind": "cg_train_step",
+                "model": model_fingerprint(self),
+                "transform": transform_fingerprint(self._step_transform)}
+
     def _get_train_step(self):
         if self._train_step is None:
-            self._train_step = jax.jit(self._build_step_body(),
-                                       donate_argnums=(0, 1, 2))
+            from deeplearning4j_tpu.compile import step_function
+            self._train_step = step_function(
+                self._build_step_body(),
+                donate_argnums=self._donate_argnums(),
+                key_base=self._aot_key_parts,
+                cache=self._exec_cache(),
+                dynamic_argnums=(3, 4, 5))
         return self._train_step
 
     def _get_scan_step(self):
@@ -683,7 +732,12 @@ class ComputationGraph:
                                             r, it, epoch)
                 return (p, s, o, r, it), loss
 
-            self._scan_step = make_scan_step(tick)
+            self._scan_step = make_scan_step(
+                tick,
+                key_base=lambda: dict(self._aot_key_parts(),
+                                      kind="cg_scan_step"),
+                cache=self._exec_cache(),
+                donate=(self._schedule is None or self._schedule.donation))
         return self._scan_step
 
     def fit_steps(self, features, labels, labels_masks=None):
@@ -739,20 +793,24 @@ class ComputationGraph:
         return [jnp.asarray(l) for l in labels]
 
     def fit(self, data, labels=None, *, epochs: int = 1,
-            fused_steps: int = 1):
+            fused_steps: Optional[int] = None):
         """fit(features, labels) for one batch (single- or multi-output), or
         fit(MultiDataSetIterator | DataSetIterator, epochs=N).
 
         `fused_steps=k` fuses blocks of k consecutive same-shape batches
         into one compiled dispatch (`fit_steps`); tails and shape changes
-        fall back to per-step dispatch (identical math either way)."""
+        fall back to per-step dispatch (identical math either way).  Unset,
+        it defaults to the installed schedule's (`apply_schedule`), else 1."""
         if labels is not None:
-            if fused_steps != 1:
+            if fused_steps not in (None, 1):
                 raise ValueError(
                     "fused_steps applies to the iterator form only; for a "
                     "pre-stacked [k, batch, ...] block call fit_steps")
             self._fit_batch(self._as_input_dict(data), self._as_list(labels))
             return self
+        if fused_steps is None:
+            fused_steps = (self._schedule.fused_steps
+                           if self._schedule is not None else 1)
         for _ in range(epochs):
             if hasattr(data, "reset"):
                 data.reset()

@@ -245,6 +245,8 @@ class MultiLayerNetwork:
         self._layer_types: List[InputType] = []
         self._device_norm = None   # on-device normalizer prologue (pipeline)
         self._instr: Optional[TrainingInstruments] = None
+        self._exec_cache_override = None  # compile.PersistentExecutableCache
+        self._schedule = None             # compile.Schedule (autotuner)
 
     def _instruments(self) -> TrainingInstruments:
         """Lazy telemetry handles (monitor registry series labeled by
@@ -380,8 +382,62 @@ class MultiLayerNetwork:
         return penalty
 
     # ---- compiled step ----
+    def _exec_cache(self):
+        """The persistent executable cache in play: the per-model override
+        (`set_executable_cache`), else the process default — None keeps
+        the plain jax.jit path."""
+        if self._exec_cache_override is not None:
+            return self._exec_cache_override
+        from deeplearning4j_tpu.compile import default_cache
+        return default_cache()
+
+    def set_executable_cache(self, cache) -> "MultiLayerNetwork":
+        """Route this model's train-step compilation through a
+        `compile.PersistentExecutableCache` (or a directory path), so a
+        restarted process deserializes the step instead of recompiling it.
+        None reverts to the process default ($DL4J_TPU_EXEC_CACHE /
+        `compile.set_default_cache`).  Triggers a step rebuild."""
+        if isinstance(cache, str):
+            from deeplearning4j_tpu.compile import PersistentExecutableCache
+            cache = PersistentExecutableCache(cache)
+        self._exec_cache_override = cache
+        self._train_step = None
+        self._scan_step = None
+        return self
+
+    def apply_schedule(self, schedule) -> "MultiLayerNetwork":
+        """Install an autotuned `compile.Schedule`: the iterator form of
+        `fit()` defaults its `fused_steps` to the schedule's and the step
+        builders honor `schedule.donation`.  (`zero1` is a wrapper-level
+        knob — `parallel.ParallelWrapper.apply_schedule` handles it and
+        delegates the rest here.)  Triggers a step rebuild."""
+        self._schedule = schedule
+        self._train_step = None
+        self._scan_step = None
+        return self
+
+    def _donate_argnums(self) -> tuple:
+        if self._schedule is not None and not self._schedule.donation:
+            return ()
+        return (0, 1, 2)
+
+    def _aot_key_parts(self) -> dict:
+        """Disk-key parts for the persistent tier: model architecture (not
+        weights — restarts and same-arch rolls share the executable) plus
+        the step-shaping config the body closes over."""
+        from deeplearning4j_tpu.compile import (model_fingerprint,
+                                                transform_fingerprint)
+        return {"kind": "mln_train_step",
+                "model": model_fingerprint(self),
+                "transform": transform_fingerprint(self._step_transform)}
+
     def _build_train_step(self):
-        return jax.jit(self._build_step_body(), donate_argnums=(0, 1, 2))
+        from deeplearning4j_tpu.compile import step_function
+        return step_function(self._build_step_body(),
+                             donate_argnums=self._donate_argnums(),
+                             key_base=self._aot_key_parts,
+                             cache=self._exec_cache(),
+                             dynamic_argnums=(3, 4, 5, 6))
 
     def _build_step_body(self):
         conf = self.conf
@@ -477,7 +533,12 @@ class MultiLayerNetwork:
                 p, s, o, loss, r, it = body(p, s, o, *batch, r, it, epoch)
                 return (p, s, o, r, it), loss
 
-            self._scan_step = make_scan_step(tick)
+            self._scan_step = make_scan_step(
+                tick,
+                key_base=lambda: dict(self._aot_key_parts(),
+                                      kind="mln_scan_step"),
+                cache=self._exec_cache(),
+                donate=(self._schedule is None or self._schedule.donation))
         return self._scan_step
 
     def fit_steps(self, xs, ys, features_masks=None, labels_masks=None):
@@ -539,7 +600,7 @@ class MultiLayerNetwork:
 
     # ---- public API ----
     def fit(self, data, labels=None, *, epochs: int = 1, features_mask=None,
-            labels_mask=None, fused_steps: int = 1):
+            labels_mask=None, fused_steps: Optional[int] = None):
         """fit(x, y) for one batch, or fit(iterator, epochs=N)
         (reference `fit(INDArray, INDArray)` / `fit(DataSetIterator, int)`).
 
@@ -547,15 +608,20 @@ class MultiLayerNetwork:
         single compiled dispatch (`fit_steps`), hiding per-step host
         dispatch latency; odd-sized tail batches (and any batch whose
         shape differs from its block) fall back to the per-step path, so
-        results are identical to `fused_steps=1` up to listener cadence."""
+        results are identical to `fused_steps=1` up to listener cadence.
+        Unset, it defaults to the installed schedule's (`apply_schedule`),
+        else 1."""
         if labels is not None:
-            if fused_steps != 1:
+            if fused_steps not in (None, 1):
                 raise ValueError(
                     "fused_steps applies to the iterator form only; for a "
                     "pre-stacked [k, batch, ...] block call fit_steps(xs, ys)")
             self._fit_batch(jnp.asarray(data), jnp.asarray(labels),
                             features_mask, labels_mask)
             return self
+        if fused_steps is None:
+            fused_steps = (self._schedule.fused_steps
+                           if self._schedule is not None else 1)
         for _ in range(epochs):
             if hasattr(data, "reset"):
                 data.reset()

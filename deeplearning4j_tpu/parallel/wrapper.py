@@ -132,6 +132,7 @@ class ParallelWrapper:
         self._placed = False
         self._warned_drop = False
         self._instr: Optional[ParallelInstruments] = None
+        self._schedule = None          # compile.Schedule (apply_schedule)
 
     def _instruments(self) -> ParallelInstruments:
         if self._instr is None:
@@ -203,6 +204,18 @@ class ParallelWrapper:
         if not on:
             zero.disable_zero1(self.model)
         self._placed = False
+        return self
+
+    def apply_schedule(self, schedule) -> "ParallelWrapper":
+        """Apply an autotuned `compile.Schedule` at the wrapper level:
+        `zero1` toggles the sharded weight update here, the rest
+        (fused_steps default, donation) installs on the wrapped model via
+        its own `apply_schedule`.  `fit_prefetched` then defaults its
+        `fused_steps`/`prefetch_depth` from the installed schedule."""
+        self.optimizer_sharding(schedule.zero1)
+        if hasattr(self.model, "apply_schedule"):
+            self.model.apply_schedule(schedule)
+        self._schedule = schedule
         return self
 
     def _place_model(self):
@@ -357,7 +370,8 @@ class ParallelWrapper:
                                          batch_dim=batch_dim)
 
     def fit_prefetched(self, iterator, *, epochs: int = 1,
-                       fused_steps: int = 1, prefetch_depth: int = 2,
+                       fused_steps: Optional[int] = None,
+                       prefetch_depth: Optional[int] = None,
                        zero1: Optional[bool] = None):
         """Async end-to-end SPMD training from a host iterator: batches are
         ETL'd in a producer thread, staged onto the mesh pre-sharded
@@ -366,8 +380,14 @@ class ParallelWrapper:
         pipeline's three latency hiders (prefetch, on-device normalize via
         `model.set_normalizer`, fused dispatch).  `zero1=True` turns on the
         sharded weight update for this and subsequent fits (see
-        `optimizer_sharding`)."""
+        `optimizer_sharding`).  Unset, `fused_steps`/`prefetch_depth`
+        default from the applied schedule (`apply_schedule`), else 1/2."""
         from deeplearning4j_tpu.data.pipeline import DevicePrefetchIterator
+        sch = self._schedule
+        if fused_steps is None:
+            fused_steps = sch.fused_steps if sch is not None else 1
+        if prefetch_depth is None:
+            prefetch_depth = sch.prefetch_depth if sch is not None else 2
         if zero1 is not None:
             self.optimizer_sharding(zero1)
         self._place_model()

@@ -21,6 +21,13 @@ With a `Mesh`, inputs are sharded over the data axis before execution
 (SPMD sharded serving, same data path as `ParallelInference`); the
 minimum bucket is then clamped to the data-parallel degree so every
 bucket divides evenly across devices.
+
+Persistent tier (`compile.PersistentExecutableCache`): with `persistent=`
+(a cache, a directory, or the `$DL4J_TPU_EXEC_CACHE` process default),
+every in-memory miss consults the on-disk executable store before paying
+an XLA compile — `warmup()` in a process whose predecessor already served
+the same model becomes mostly deserialization, which is what makes
+elastic scale-out replicas come up warm.
 """
 from __future__ import annotations
 
@@ -89,8 +96,10 @@ class BucketedCompileCache:
 
     def __init__(self, max_batch: int = 64, min_bucket: int = 1,
                  mesh=None, data_axis: str = "data",
-                 counters: Optional[HitMissCounters] = None):
+                 counters: Optional[HitMissCounters] = None,
+                 persistent=None):
         import jax  # local: keep module import light
+        from deeplearning4j_tpu.compile import as_cache
 
         self._jax = jax
         self.mesh = mesh
@@ -102,7 +111,11 @@ class BucketedCompileCache:
         self.buckets = bucket_sizes(self.max_batch, self.min_bucket)
         self.counters = counters if counters is not None \
             else HitMissCounters("compile_cache")
+        self.persistent = as_cache(persistent)
         self._compiled: Dict[Tuple, Callable] = {}
+        self._inflight: Dict[Tuple, threading.Event] = {}
+        self._model_fps: Dict[int, str] = {}    # id(model) -> fingerprint
+        self._pads: Dict[Tuple, np.ndarray] = {}
         self._lock = threading.Lock()
 
     @property
@@ -110,7 +123,43 @@ class BucketedCompileCache:
         return len(self.buckets)
 
     def bucket_for(self, n: int) -> int:
-        return bucket_for(n, self.max_batch, self.min_bucket)
+        if n < 1:
+            raise ValueError(f"cannot bucket a {n}-row dispatch")
+        for b in self.buckets:      # ladder may be autotuned (non-pow2)
+            if b >= n:
+                return b
+        raise ValueError(
+            f"dispatch of {n} rows exceeds the top bucket "
+            f"{self.buckets[-1]}")
+
+    def set_buckets(self, buckets: Optional[List[int]] = None,
+                    min_bucket: Optional[int] = None) -> List[int]:
+        """Reconfigure the bucket ladder (the autotuner's serving hook).
+        An explicit ascending `buckets` list replaces the ladder wholesale
+        (its max becomes `max_batch`); `min_bucket` alone re-derives the
+        power-of-two ladder.  Already-compiled executables stay valid —
+        buckets key them, and a narrower ladder just stops routing to the
+        dropped sizes."""
+        if buckets:
+            bs = sorted(int(b) for b in buckets)
+            if any(b < 1 for b in bs) or len(set(bs)) != len(bs):
+                raise ValueError(f"invalid bucket ladder {buckets}")
+            if self.mesh is not None:
+                dp = self.mesh.shape[self.data_axis]
+                if any(b % dp for b in bs):
+                    raise ValueError(
+                        f"bucket ladder {bs} must divide the data-parallel "
+                        f"degree {dp}")
+            self.buckets = bs
+            self.min_bucket = bs[0]
+            self.max_batch = bs[-1]
+        elif min_bucket:
+            mb = int(min_bucket)
+            if self.mesh is not None:
+                mb = max(mb, self.mesh.shape[self.data_axis])
+            self.min_bucket = mb
+            self.buckets = bucket_sizes(self.max_batch, self.min_bucket)
+        return self.buckets
 
     # ---- placement ----
     def _x_sharding(self):
@@ -132,49 +181,124 @@ class BucketedCompileCache:
         return self._jax.device_put(x, self._x_sharding())
 
     # ---- compile ----
+    def _model_fingerprint(self, model) -> str:
+        """Memoized per model instance — fingerprinting walks config JSON
+        + param specs, too heavy to redo per bucket."""
+        from deeplearning4j_tpu.compile import model_fingerprint
+        mid = id(model)
+        fp = self._model_fps.get(mid)
+        if fp is None:
+            fp = model_fingerprint(model)
+            with self._lock:
+                self._model_fps[mid] = fp
+        return fp
+
+    def _disk_parts(self, model, bucket: int, trailing: Tuple[int, ...],
+                    dtype) -> dict:
+        """On-disk key: architecture fingerprint, NOT the registry key —
+        two versions of the same architecture (a weights-only model roll)
+        share one serialized executable, so the roll comes up warm."""
+        from deeplearning4j_tpu.compile import mesh_fingerprint
+        return {"kind": "serving_forward",
+                "model": self._model_fingerprint(model),
+                "bucket": int(bucket), "trailing": list(trailing),
+                "dtype": np.dtype(dtype).str,
+                "mesh": mesh_fingerprint(self.mesh),
+                "data_axis": self.data_axis if self.mesh is not None
+                else None}
+
     def _compile(self, model, bucket: int, trailing: Tuple[int, ...],
                  dtype) -> Callable:
         """AOT path: lower the jitted forward against a concrete example of
         the bucket's exact shape (carrying its sharding), compile once, and
         return the bare executable — no tracing ever happens on the request
-        path again for this bucket."""
+        path again for this bucket.  With a persistent tier the compile is
+        replaced by deserialization whenever a previous process already
+        paid for it."""
         if self.mesh is not None:
             self._place_model(model)
-        fwd = _forward_fn(model)
-        example = self._place_input(
-            np.zeros((bucket,) + tuple(trailing), dtype))
-        return self._jax.jit(fwd).lower(
-            model.params_, model.state_, example).compile()
+
+        def fresh():
+            fwd = _forward_fn(model)
+            example = self._place_input(
+                np.zeros((bucket,) + tuple(trailing), dtype))
+            return self._jax.jit(fwd).lower(
+                model.params_, model.state_, example).compile()
+
+        if self.persistent is None:
+            return fresh()
+        fn, _source = self.persistent.get_or_compile(
+            self._disk_parts(model, bucket, trailing, dtype), fresh)
+        return fn
 
     def executable(self, key: str, model, bucket: int,
                    trailing: Tuple[int, ...], dtype) -> Callable:
         """The compiled executable for (key, bucket, trailing, dtype),
         compiling on first use.  `key` identifies the model+version (params
         identity is the caller's contract: hot-swapping weights in place
-        requires a new key or an `invalidate`)."""
+        requires a new key or an `invalidate`).
+
+        Concurrency: compiles run OUTSIDE the global lock behind a per-key
+        in-flight marker, so a multi-second compile miss on one bucket
+        never stalls hits on other, already-warm buckets; racing requests
+        for the *same* key wait on the marker and still pay one compile."""
         ck = (key, int(bucket), tuple(trailing), np.dtype(dtype).str)
-        with self._lock:
-            fn = self._compiled.get(ck)
+        while True:
+            with self._lock:
+                fn = self._compiled.get(ck)
+                if fn is not None:
+                    self.counters.hit()
+                    return fn
+                ev = self._inflight.get(ck)
+                if ev is None:
+                    ev = threading.Event()
+                    self._inflight[ck] = ev
+                    break               # this thread owns the compile
+            ev.wait()                   # somebody else is compiling ck
+            with self._lock:
+                fn = self._compiled.get(ck)
             if fn is not None:
                 self.counters.hit()
                 return fn
-            # compile under the lock: two racing requests for the same new
-            # bucket must cost ONE compile, not two
+            # the owner failed; loop to retry (next iteration claims
+            # ownership and surfaces its own error)
+        try:
             self.counters.miss()
             fn = self._compile(model, bucket, trailing, dtype)
-            self._compiled[ck] = fn
+            with self._lock:
+                self._compiled[ck] = fn
             return fn
+        finally:
+            with self._lock:
+                self._inflight.pop(ck, None)
+            ev.set()
 
     def invalidate(self, key: Optional[str] = None) -> None:
-        """Drop cached executables (all, or one model's)."""
+        """Drop cached executables (all, or one model's).  In-memory only:
+        the persistent tier is keyed by architecture fingerprint and stays
+        valid across weight swaps."""
         with self._lock:
             if key is None:
                 self._compiled.clear()
+                self._model_fps.clear()
             else:
                 self._compiled = {k: v for k, v in self._compiled.items()
                                   if k[0] != key}
 
     # ---- execute ----
+    def _pad_buffer(self, bucket: int, trailing: Tuple[int, ...],
+                    dtype) -> np.ndarray:
+        """Cached zero buffer of (bucket,)+trailing — dispatch padding
+        reuses one allocation per (bucket, trailing, dtype) instead of
+        allocating+zeroing fresh rows on every padded request."""
+        pk = (int(bucket), tuple(trailing), np.dtype(dtype).str)
+        pad = self._pads.get(pk)
+        if pad is None:
+            pad = np.zeros((bucket,) + tuple(trailing), dtype)
+            with self._lock:
+                pad = self._pads.setdefault(pk, pad)
+        return pad
+
     def run(self, key: str, model, x: np.ndarray) -> np.ndarray:
         """Pad `x` up to its bucket, run the (possibly freshly compiled)
         executable, slice the real rows back."""
@@ -188,8 +312,8 @@ class BucketedCompileCache:
         bucket = self.bucket_for(n)
         fn = self.executable(key, model, bucket, x.shape[1:], x.dtype)
         if bucket != n:
-            pad = np.zeros((bucket - n,) + x.shape[1:], x.dtype)
-            x = np.concatenate([x, pad], axis=0)
+            pad = self._pad_buffer(bucket, x.shape[1:], x.dtype)
+            x = np.concatenate([x, pad[n:]], axis=0)
         out = fn(model.params_, model.state_, self._place_input(x))
         if isinstance(out, (list, tuple)):
             out = out[0]
@@ -197,12 +321,30 @@ class BucketedCompileCache:
 
     def warmup(self, key: str, model, trailing: Tuple[int, ...],
                dtype=np.float32,
-               buckets: Optional[List[int]] = None) -> List[int]:
+               buckets: Optional[List[int]] = None,
+               parallel: bool = False) -> List[int]:
         """Pre-compile (and execute once, forcing any lazy backend init)
         every bucket for a model — pay all compile stalls before traffic.
-        Returns the warmed bucket list."""
+        With `parallel=True` the buckets compile concurrently from a
+        thread pool (XLA compilation releases the GIL; the per-key
+        in-flight markers keep the cache coherent), which overlaps the
+        per-bucket stalls into roughly one.  Returns the warmed buckets,
+        in ladder order."""
+        todo = list(buckets if buckets is not None else self.buckets)
+        # the ladder top may exceed max_batch (pad-to-pow2); a clamped
+        # batch still routes to the same bucket, so every bucket compiles
+        sizes = [min(b, self.max_batch) for b in todo]
+        if parallel and len(todo) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=len(todo)) as pool:
+                futs = [pool.submit(
+                    self.run, key, model,
+                    np.zeros((n,) + tuple(trailing), dtype)) for n in sizes]
+                for f in futs:
+                    f.result()          # surface the first failure
+            return todo
         warmed = []
-        for b in (buckets if buckets is not None else self.buckets):
-            self.run(key, model, np.zeros((b,) + tuple(trailing), dtype))
+        for b, n in zip(todo, sizes):
+            self.run(key, model, np.zeros((n,) + tuple(trailing), dtype))
             warmed.append(b)
         return warmed

@@ -161,11 +161,13 @@ class ModelRegistry:
     # ---- warmup ----
     def warmup(self, name: str, cache,
                version: Optional[int] = None,
-               input_shape: Optional[Tuple[int, ...]] = None) -> List[int]:
+               input_shape: Optional[Tuple[int, ...]] = None,
+               parallel: bool = False) -> List[int]:
         """Drive `cache` (a BucketedCompileCache) through every bucket for
         this model so no request ever waits on an XLA compile.  Needs the
         trailing input shape — inferred from the model config when
-        possible, otherwise pass `input_shape`."""
+        possible, otherwise pass `input_shape`.  `parallel=True` overlaps
+        the per-bucket compiles (see BucketedCompileCache.warmup)."""
         import numpy as np
         entry = self.get(name, version)
         shape = tuple(input_shape) if input_shape is not None \
@@ -175,6 +177,7 @@ class ModelRegistry:
                 f"cannot warm '{entry.key}': input shape unknown — pass "
                 "input_shape=(trailing, dims)")
         warmed = cache.warmup(entry.key, entry.model, shape,
-                              np.dtype(entry.input_dtype))
+                              np.dtype(entry.input_dtype),
+                              parallel=parallel)
         entry.warmed_buckets = warmed
         return warmed

@@ -53,16 +53,21 @@ class ModelServer:
                  metrics: Optional[ServingMetrics] = None,
                  dispatch_retries: int = 1,
                  dispatch_retry_backoff_ms: float = 10.0,
-                 ready_stuck_threshold_s: float = 30.0):
+                 ready_stuck_threshold_s: float = 30.0,
+                 cache_dir: Optional[str] = None, schedule=None):
         self.registry = registry if registry is not None else ModelRegistry()
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.dispatch_retries = int(dispatch_retries)
         self.dispatch_retry_backoff_ms = float(dispatch_retry_backoff_ms)
         self.ready_stuck_threshold_s = float(ready_stuck_threshold_s)
         self._started = time.monotonic()
+        persistent = cache_dir      # as_cache also honors the env default
         self.cache = BucketedCompileCache(
             max_batch=max_batch, min_bucket=min_bucket, mesh=mesh,
-            data_axis=data_axis, counters=self.metrics.cache)
+            data_axis=data_axis, counters=self.metrics.cache,
+            persistent=persistent)
+        if schedule is not None:
+            schedule.apply(self)    # reconfigures the bucket ladder
         self.batcher = ContinuousBatcher(
             self._dispatch, max_batch=max_batch,
             batch_timeout_ms=batch_timeout_ms, max_queue=max_queue,

@@ -81,7 +81,7 @@ def check_steps_axes(named_arrays):
     return k
 
 
-def make_scan_step(tick):
+def make_scan_step(tick, key_base=None, cache=None, donate: bool = True):
     """Wrap a per-class `tick` adapter into the jitted k-step scan.
 
     `tick(carry, epoch, batch) -> (carry, loss)` adapts one class's step
@@ -91,7 +91,13 @@ def make_scan_step(tick):
     `step(carry, epoch, batches) -> (carry, losses)`; the whole carry is
     donated (every element is replaced from the return by the callers —
     `advance()` for the counter, attribute reassignment for the rest).
-    `epoch` is NOT donated: `device_counters` caches it across calls."""
+    `epoch` is NOT donated: `device_counters` caches it across calls.
+
+    With `cache` + `key_base` (a `compile.PersistentExecutableCache` and a
+    zero-arg disk-key-parts callable) the scan compiles through the
+    persistent tier like the single-step builders — a restarted fused-fit
+    loop deserializes instead of recompiling.  The batch block is the only
+    dynamic argument (argnum 2)."""
     def many(carry, epoch, batches):
         if (isinstance(batches, (list, tuple)) and len(batches)
                 and isinstance(batches[0], (list, tuple))):
@@ -110,4 +116,7 @@ def make_scan_step(tick):
         # transfer_guard("disallow"))
         return carry, losses, losses[-1]
 
-    return jax.jit(many, donate_argnums=(0,))
+    from deeplearning4j_tpu.compile import step_function
+    return step_function(many, donate_argnums=(0,) if donate else (),
+                         key_base=key_base, cache=cache,
+                         dynamic_argnums=(2,))

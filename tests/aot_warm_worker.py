@@ -1,0 +1,49 @@
+"""Subprocess worker for test_aot_cache.test_warm_restart_subprocess.
+
+Trains a small MLN with its step routed through the persistent executable
+cache at $DL4J_TPU_TEST_CACHE and prints one JSON line: compile/hit stats
+plus the final score.  Run twice against the same directory, the second
+run must report 0 compiles and the identical score — the cross-process
+form of the warm-restart acceptance contract.
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.compile import PersistentExecutableCache  # noqa: E402
+from deeplearning4j_tpu.nn import (DenseLayer, InputType,  # noqa: E402
+                                   MultiLayerNetwork, NeuralNetConfiguration,
+                                   OutputLayer)
+from deeplearning4j_tpu.train.updaters import Sgd  # noqa: E402
+
+
+def main():
+    cache = PersistentExecutableCache(os.environ["DL4J_TPU_TEST_CACHE"])
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(1e-1))
+            .list([DenseLayer(n_out=16, activation="relu"),
+                   OutputLayer(n_out=3, loss="mcxent",
+                               activation="softmax")])
+            .set_input_type(InputType.feed_forward(8)).build())
+    net = MultiLayerNetwork(conf).init().set_executable_cache(cache)
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(12, 8).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 12)]
+    for _ in range(5):
+        net.fit(x, y)
+    print(json.dumps({
+        "compiles": cache.stats["compiles"],
+        "disk_hits": cache.stats["disk_hits"],
+        "stores": cache.stats["stores"],
+        "step_recompiles": net._train_step._cache_size(),
+        "score": float(net.score()),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
