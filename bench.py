@@ -43,6 +43,14 @@ with ZERO compiles (exit 1 otherwise); detail to stderr +
 donation} on the pipeline fixture, persists the winning schedule, reloads
 and re-measures it (restart-survival check); detail to stderr +
 `BENCH_autotune.json`, one stdout JSON line.
+
+`python bench.py --comms [--quick]` A/Bs the hierarchical compressed
+cross-host gradient exchange (threshold int streams + error-feedback
+residuals over TCP) against the dense f32 exchange on a simulated 2-host
+gang (LocalLauncher: real processes, real sockets): cross-host bytes on
+wire (gate: >=5x reduction), steps/sec, and end-of-run loss parity
+(gate: within 1%); detail to stderr + `BENCH_comms.json`, one stdout
+JSON line.
 """
 import json
 import sys
@@ -762,6 +770,104 @@ def main_zero1(quick: bool):
     }))
 
 
+def bench_comms(steps=150, batch=32, procs=2, devices_per_process=2):
+    """A/B the hierarchical gradient exchange: dense f32 vs threshold-
+    compressed int streams across a simulated 2-host gang.
+
+    Each "host" is a real OS process with its own XLA CPU client and
+    local mesh (LocalLauncher), coupled ONLY by the TCP gradient mesh —
+    the compiled grad half reduces over the local devices (ICI role), the
+    host-side exchange combines across processes (DCN role).  Both sides
+    train the same model on the same global data stream; the compressed
+    side must land within 1% of the dense final loss on >=5x fewer
+    cross-host bytes."""
+    import os
+    import tempfile
+    from deeplearning4j_tpu.parallel.multihost import (LocalLauncher,
+                                                       free_port)
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tests", "mh_worker_comms.py")
+    out = {}
+    with tempfile.TemporaryDirectory() as td:
+        for mode in ("dense", "compressed"):
+            launcher = LocalLauncher(procs, devices_per_process)
+            t0 = time.time()
+            launcher.run(worker, [td, mode, steps, batch], timeout=600.0,
+                         gradient_port=free_port())
+            dt = time.time() - t0
+            curves = [np.load(os.path.join(td, f"curve_{mode}_{r}.npz"))
+                      for r in range(procs)]
+            stats = []
+            for r in range(procs):
+                with open(os.path.join(td,
+                                       f"stats_{mode}_{r}.json")) as f:
+                    stats.append(json.load(f))
+            # replica consistency: every rank applies the same combined
+            # gradient, so end-of-run params must agree across ranks
+            for r in range(1, procs):
+                np.testing.assert_allclose(curves[0]["w0"],
+                                           curves[r]["w0"],
+                                           rtol=1e-5, atol=1e-6)
+            wire = sum(s["bytes_sent_total"] + s["bytes_received_total"]
+                       for s in stats)
+            mean_curve = np.mean([c["losses"] for c in curves], axis=0)
+            out[mode] = {
+                "wall_s": dt, "steps_per_sec": steps / dt,
+                "wire_bytes": wire,
+                "final_loss": float(mean_curve[-1]),
+                "compression_ratio_last":
+                    max(s["last_compression_ratio"] for s in stats),
+                "loss_curve": [round(float(v), 5) for v in mean_curve],
+            }
+    dense, comp = out["dense"], out["compressed"]
+    reduction = dense["wire_bytes"] / max(comp["wire_bytes"], 1)
+    parity = (abs(comp["final_loss"] - dense["final_loss"])
+              / max(abs(dense["final_loss"]), 1e-9))
+    return {"procs": procs, "devices_per_process": devices_per_process,
+            "steps": steps, "batch_per_host": batch,
+            "bytes_reduction_x": reduction, "loss_parity_rel": parity,
+            "dense": dense, "compressed": comp}
+
+
+def main_comms(quick: bool):
+    """`--comms` mode: A/B detail to stderr + BENCH_comms.json, ONE
+    stdout JSON line.  The gang itself always runs on forced-CPU child
+    processes (LocalLauncher), so no backend probe is needed — this mode
+    measures the DCN exchange, not the accelerator."""
+    import os
+    try:
+        r = (bench_comms(steps=100) if quick else bench_comms())
+    except Exception as e:
+        print(json.dumps({"metric": "comms_bytes_reduction_x",
+                          "value": None, "unit": "x",
+                          "error": repr(e)[:300]}))
+        sys.exit(1)
+    for k, v in r.items():      # detail to stderr: stdout stays one line
+        if k in ("dense", "compressed"):
+            for kk, vv in v.items():
+                if kk != "loss_curve":
+                    print(f"[comms] {k}.{kk} = {vv}", file=sys.stderr,
+                          flush=True)
+        else:
+            print(f"[comms] {k} = {v}", file=sys.stderr, flush=True)
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_comms.json"), "w") as f:
+        json.dump(r, f, indent=2)
+    ok = r["bytes_reduction_x"] >= 5.0 and r["loss_parity_rel"] <= 0.01
+    print(json.dumps({
+        "metric": "comms_bytes_reduction_x",
+        "value": round(r["bytes_reduction_x"], 2),
+        "unit": "x",
+        "loss_parity_rel": round(r["loss_parity_rel"], 5),
+        "dense_steps_per_sec": round(r["dense"]["steps_per_sec"], 1),
+        "compressed_steps_per_sec":
+            round(r["compressed"]["steps_per_sec"], 1),
+        "pass": ok,
+    }))
+    if not ok:
+        sys.exit(1)
+
+
 def main_pipeline(quick: bool):
     """`--pipeline` mode: A/B detail to stderr, ONE stdout JSON line."""
     import os
@@ -1223,6 +1329,9 @@ def main():
         return
     if "--zero1" in sys.argv:
         main_zero1(quick)
+        return
+    if "--comms" in sys.argv:
+        main_comms(quick)
         return
     if "--resilience" in sys.argv:
         main_resilience(quick)

@@ -47,6 +47,14 @@ docs/observability.md):
   aot_cache_bytes_written_total      entry bytes committed to disk
   aot_cache_load_ms                  disk-hit deserialize wall time
   aot_cache_store_ms                 serialize+commit wall time
+  comms_bytes_on_wire_total{codec=}  gradient bytes over the DCN/host hop
+                                     (codec=threshold vs codec=dense is the
+                                     compression saving)
+  comms_compression_ratio            dense/compressed byte ratio of the most
+                                     recent exchange
+  comms_exchange_ms                  wall time of one cross-host gradient
+                                     exchange (encode + TCP + decode + sum)
+  comms_exchanges_total{codec=}      cross-host gradient exchanges run
 """
 from __future__ import annotations
 
@@ -291,6 +299,46 @@ class AotCacheInstruments:
         self.last_error = f"{where}: {exc!r}"[:500]
 
 
+class CommsInstruments:
+    """Cross-host compressed-gradient-exchange handles
+    (parallel.hierarchical).  Labeled by codec so the threshold path and
+    the dense A/B baseline stay separable in one registry."""
+
+    def __init__(self, registry_: Optional[MetricsRegistry] = None):
+        reg = registry_ if registry_ is not None else registry()
+        self._bytes = {
+            codec: reg.counter(
+                "comms_bytes_on_wire_total",
+                help="gradient payload bytes sent+received over the "
+                "DCN/host hop (TCP frames incl. length prefixes)",
+                labels={"codec": codec})
+            for codec in ("threshold", "dense")}
+        self._exchanges = {
+            codec: reg.counter(
+                "comms_exchanges_total",
+                help="cross-host gradient exchanges completed",
+                labels={"codec": codec})
+            for codec in ("threshold", "dense")}
+        self.compression_ratio = reg.gauge(
+            "comms_compression_ratio",
+            help="dense-bytes / wire-bytes of the most recent compressed "
+            "exchange (1.0 on the dense path)")
+        self.exchange_ms = reg.histogram(
+            "comms_exchange_ms",
+            help="wall time of one cross-host gradient exchange: D2H + "
+            "encode + TCP all-gather + decode + sum (ms)")
+
+    def record_exchange(self, dt_s: float, wire_bytes: int, ratio: float,
+                        compressed: bool) -> None:
+        if not enabled():
+            return
+        codec = "threshold" if compressed else "dense"
+        self._bytes[codec].inc(int(wire_bytes))
+        self._exchanges[codec].inc()
+        self.compression_ratio.set(float(ratio))
+        self.exchange_ms.observe(dt_s * 1000.0)
+
+
 _pipeline: Optional[PipelineInstruments] = None
 _resilience: Optional[ResilienceInstruments] = None
 _aot: Optional[AotCacheInstruments] = None
@@ -302,6 +350,17 @@ def aot_instruments() -> AotCacheInstruments:
     if _aot is None:
         _aot = AotCacheInstruments()
     return _aot
+
+
+_comms: Optional[CommsInstruments] = None
+
+
+def comms_instruments() -> CommsInstruments:
+    """Process-wide comms handle bundle (lazy singleton)."""
+    global _comms
+    if _comms is None:
+        _comms = CommsInstruments()
+    return _comms
 
 
 def pipeline_instruments() -> PipelineInstruments:

@@ -120,19 +120,25 @@ class ThresholdCodec:
         return enc
 
     def decode(self, encoded: np.ndarray,
-               out: Optional[np.ndarray] = None) -> np.ndarray:
+               out: Optional[np.ndarray] = None,
+               threshold: Optional[float] = None) -> np.ndarray:
+        """Scatter a sparse stream back to dense.  `threshold` overrides the
+        codec's own (a peer's stream decodes at the peer's threshold) WITHOUT
+        mutating `self.threshold`, so decode of peer streams can overlap an
+        encode on another thread."""
+        thr = self.threshold if threshold is None else float(threshold)
         if out is None:
             out = np.zeros(self.size, np.float32)
         encoded = np.ascontiguousarray(np.asarray(encoded, np.int32))
         lib = _load()
         if lib is not None:
             lib.threshold_decode(_ptr(encoded), encoded.size,
-                                 self.threshold, _ptr(out), self.size)
+                                 thr, _ptr(out), self.size)
             return out
         pos = encoded[encoded > 0] - 1
         neg = -encoded[encoded < 0] - 1
-        np.add.at(out, pos, self.threshold)
-        np.add.at(out, neg, -self.threshold)
+        np.add.at(out, pos, thr)
+        np.add.at(out, neg, -thr)
         return out
 
     def density(self, grad: np.ndarray) -> float:

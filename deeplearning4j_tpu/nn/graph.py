@@ -464,6 +464,9 @@ class ComputationGraph:
         self._topo = conf.topological_order()
         self._train_step = None
         self._scan_step = None
+        self._grad_step = None    # hierarchical-sharing split: grad half
+        self._apply_step = None   # hierarchical-sharing split: apply half
+        self._grad_sharing = None  # parallel.hierarchical.HierarchicalAllReduce
         self._output_fn = None
         self._step_transform = None   # ZeRO-1 weight update (parallel/zero)
         self._vertex_types: Dict[str, InputType] = {}
@@ -686,6 +689,8 @@ class ComputationGraph:
         self._exec_cache_override = cache
         self._train_step = None
         self._scan_step = None
+        self._grad_step = None
+        self._apply_step = None
         return self
 
     def apply_schedule(self, schedule) -> "ComputationGraph":
@@ -695,6 +700,8 @@ class ComputationGraph:
         self._schedule = schedule
         self._train_step = None
         self._scan_step = None
+        self._grad_step = None
+        self._apply_step = None
         return self
 
     def _donate_argnums(self) -> tuple:
@@ -719,6 +726,155 @@ class ComputationGraph:
                 cache=self._exec_cache(),
                 dynamic_argnums=(3, 4, 5))
         return self._train_step
+
+    # ---- hierarchical gradient sharing (parallel.hierarchical) ----
+    def set_gradient_sharing(self, sharing) -> "ComputationGraph":
+        """Enable/disable hierarchical compressed cross-host gradient
+        sharing (see MultiLayerNetwork.set_gradient_sharing — identical
+        semantics over the DAG step)."""
+        from deeplearning4j_tpu.parallel.hierarchical import (
+            HierarchicalAllReduce, HierarchicalGradientSharing)
+        if sharing is None:
+            if self._grad_sharing is not None:
+                self._grad_sharing.close()
+            self._grad_sharing = None
+        elif isinstance(sharing, HierarchicalGradientSharing):
+            self._grad_sharing = HierarchicalAllReduce(sharing)
+        elif isinstance(sharing, HierarchicalAllReduce):
+            self._grad_sharing = sharing
+        else:
+            raise TypeError(
+                "set_gradient_sharing expects HierarchicalGradientSharing, "
+                f"HierarchicalAllReduce or None, got {type(sharing).__name__}")
+        self._grad_step = None
+        self._apply_step = None
+        return self
+
+    @property
+    def gradient_sharing(self):
+        """The installed `HierarchicalAllReduce`, or None."""
+        return self._grad_sharing
+
+    def _build_grad_body(self):
+        """Grad half of the split step (params NOT donated — the apply
+        half consumes them next)."""
+        zt = self._step_transform
+
+        def grad_step(params, state, inputs, labels, lmasks, rng):
+            inputs = self._apply_device_norm(inputs)
+            rng, srng = jax.random.split(rng)
+            fwd_params = params if zt is None else zt.gather_all(params)
+
+            def loss_fn(p):
+                return self._loss(p, state, inputs, labels, srng, lmasks)
+
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(fwd_params)
+            if zt is not None:
+                # ship the reduce-scattered (padded) shard, not the
+                # gathered tree; empty param subtrees scatter to empty
+                grads = {name: zt.scatter(name, grads[name])
+                         for name in self._topo}
+            return grads, new_state, loss, rng
+
+        return grad_step
+
+    def _build_apply_body(self):
+        """Apply half: updater loop on the DCN-combined gradient
+        (normalization runs here, on the combined gradient)."""
+        conf = self.conf
+        zt = self._step_transform
+
+        def apply_step(params, opt_state, grads, iteration, epoch):
+            new_params, new_opt = {}, {}
+            for name in self._topo:
+                layer = self._layer_of(name)
+                if not params[name]:
+                    new_params[name] = params[name]
+                    new_opt[name] = opt_state[name]
+                    continue
+                if layer is not None and layer.frozen:
+                    new_params[name] = params[name]
+                    new_opt[name] = opt_state[name]
+                    continue
+                g = grads[name]
+                if zt is not None:
+                    g = zt.constrain_update(name, g)
+                gn = (layer.gradient_normalization if layer is not None and
+                      layer.gradient_normalization is not None
+                      else conf.gradient_normalization)
+                if gn:
+                    thr = (layer.gradient_normalization_threshold
+                           if layer is not None and
+                           layer.gradient_normalization is not None
+                           else conf.gradient_normalization_threshold)
+                    g = apply_gradient_normalization(g, gn, thr)
+                p_upd = (params[name] if zt is None
+                         else zt.update_view(name, params[name]))
+                upd_cfg = self._updater_for(name)
+                upd, new_o = upd_cfg.apply(
+                    opt_state[name], g, iteration, epoch, params=p_upd)
+                wd = (layer.weight_decay if layer is not None and
+                      layer.weight_decay is not None else conf.weight_decay)
+                if wd and layer is not None:
+                    lr = upd_cfg.lr_at(iteration, epoch)
+                    upd = _add_scaled_where(
+                        upd, p_upd,
+                        layer.regularizable_mask(p_upd), lr * wd)
+                new_p = jax.tree_util.tree_map(
+                    lambda p_, u_: p_ - u_, p_upd, upd)
+                if zt is not None:
+                    new_p = zt.restore(name, new_p)
+                    new_o = zt.constrain_opt(name, new_o)
+                new_params[name], new_opt[name] = new_p, new_o
+            return new_params, new_opt, iteration + 1
+
+        return apply_step
+
+    def _get_grad_step(self):
+        if self._grad_step is None:
+            from deeplearning4j_tpu.compile import step_function
+            self._grad_step = step_function(
+                self._build_grad_body(),
+                donate_argnums=(1,),
+                key_base=lambda: dict(
+                    self._aot_key_parts(), kind="cg_grad_step"),
+                cache=self._exec_cache(),
+                dynamic_argnums=(2, 3, 4))
+        return self._grad_step
+
+    def _get_apply_step(self):
+        if self._apply_step is None:
+            from deeplearning4j_tpu.compile import step_function
+            self._apply_step = step_function(
+                self._build_apply_body(),
+                donate_argnums=(0, 1),
+                key_base=lambda: dict(
+                    self._aot_key_parts(), kind="cg_apply_step"),
+                cache=self._exec_cache(),
+                dynamic_argnums=())
+        return self._apply_step
+
+    def _fit_batch_shared(self, inputs, labels, lmasks=None):
+        from deeplearning4j_tpu.utils.counters import advance, device_counters
+        t0 = time.perf_counter()
+        gstep = self._get_grad_step()
+        grads, self.state_, loss, self._rng = gstep(
+            self.params_, self.state_, inputs, labels, lmasks, self._rng)
+        combined = self._grad_sharing.exchange(grads)
+        astep = self._get_apply_step()
+        it_dev, ep_dev = device_counters(self)
+        self.params_, self.opt_state_, new_it = astep(
+            self.params_, self.opt_state_, combined, it_dev, ep_dev)
+        ins = self._instruments()
+        ins.record_dispatch(time.perf_counter() - t0)
+        ins.check_compile(gstep, self)
+        ins.check_compile(astep, self)
+        self._score = loss
+        self._last_batch_size = int(next(iter(inputs.values())).shape[0])
+        advance(self, new_it)
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, self.epoch)
 
     def _get_scan_step(self):
         if self._scan_step is None:
@@ -758,6 +914,17 @@ class ComputationGraph:
             [(f"input '{n}'", a) for n, a in inputs.items()]
             + [(f"label {i}", l) for i, l in enumerate(labels)]
             + [(f"labels_mask {i}", m) for i, m in enumerate(lmasks or [])])
+        if self._grad_sharing is not None:
+            # host exchange can't run mid-scan: per-step two-phase loop
+            # (same math; see MultiLayerNetwork.fit_steps)
+            losses = []
+            for i in range(int(k)):
+                self._fit_batch_shared(
+                    {n: a[i] for n, a in inputs.items()},
+                    [l[i] for l in labels],
+                    None if lmasks is None else [m[i] for m in lmasks])
+                losses.append(self._score)
+            return jnp.stack(losses)
         step = self._get_scan_step()
         it_dev, ep_dev = device_counters(self)
         t0 = time.perf_counter()
@@ -870,6 +1037,8 @@ class ComputationGraph:
     def _fit_batch(self, inputs: Dict[str, jnp.ndarray],
                    labels: List[jnp.ndarray], lmasks=None):
         from deeplearning4j_tpu.utils.counters import advance, device_counters
+        if self._grad_sharing is not None:
+            return self._fit_batch_shared(inputs, labels, lmasks)
         step = self._get_train_step()
         it_dev, ep_dev = device_counters(self)
         t0 = time.perf_counter()
@@ -924,6 +1093,8 @@ class ComputationGraph:
                                  for n, nz in normalizers.items()}
         self._train_step = None
         self._scan_step = None
+        self._grad_step = None
+        self._apply_step = None
         self._output_fn = None
         return self
 

@@ -240,6 +240,9 @@ class MultiLayerNetwork:
         self._rng = jax.random.PRNGKey(conf.seed)
         self._train_step = None
         self._scan_step = None
+        self._grad_step = None    # hierarchical-sharing split: grad half
+        self._apply_step = None   # hierarchical-sharing split: apply half
+        self._grad_sharing = None  # parallel.hierarchical.HierarchicalAllReduce
         self._output_fn = None
         self._step_transform = None   # ZeRO-1 weight update (parallel/zero)
         self._layer_types: List[InputType] = []
@@ -403,6 +406,8 @@ class MultiLayerNetwork:
         self._exec_cache_override = cache
         self._train_step = None
         self._scan_step = None
+        self._grad_step = None
+        self._apply_step = None
         return self
 
     def apply_schedule(self, schedule) -> "MultiLayerNetwork":
@@ -414,6 +419,8 @@ class MultiLayerNetwork:
         self._schedule = schedule
         self._train_step = None
         self._scan_step = None
+        self._grad_step = None
+        self._apply_step = None
         return self
 
     def _donate_argnums(self) -> tuple:
@@ -523,6 +530,170 @@ class MultiLayerNetwork:
             self._train_step = self._build_train_step()
         return self._train_step
 
+    # ---- hierarchical gradient sharing (parallel.hierarchical) ----
+    def set_gradient_sharing(self, sharing) -> "MultiLayerNetwork":
+        """Enable/disable hierarchical compressed cross-host gradient
+        sharing.  Accepts a `HierarchicalGradientSharing` config (the
+        runtime is built here), a prebuilt `HierarchicalAllReduce`, or
+        None to clear.  Active sharing splits the compiled step in two —
+        a grad half (forward/backward + ICI reduce, emits the local
+        gradient tree) and an apply half (updater loop on the DCN-combined
+        gradient) — with the host-side compressed exchange between them."""
+        from deeplearning4j_tpu.parallel.hierarchical import (
+            HierarchicalAllReduce, HierarchicalGradientSharing)
+        if sharing is None:
+            if self._grad_sharing is not None:
+                self._grad_sharing.close()
+            self._grad_sharing = None
+        elif isinstance(sharing, HierarchicalGradientSharing):
+            self._grad_sharing = HierarchicalAllReduce(sharing)
+        elif isinstance(sharing, HierarchicalAllReduce):
+            self._grad_sharing = sharing
+        else:
+            raise TypeError(
+                "set_gradient_sharing expects HierarchicalGradientSharing, "
+                f"HierarchicalAllReduce or None, got {type(sharing).__name__}")
+        self._grad_step = None
+        self._apply_step = None
+        return self
+
+    @property
+    def gradient_sharing(self):
+        """The installed `HierarchicalAllReduce`, or None."""
+        return self._grad_sharing
+
+    def _build_grad_body(self):
+        """Grad half of the split step: forward/backward on the local
+        mesh (ICI all-reduce via SPMD, reduce-scatter under ZeRO-1), NO
+        update.  Params are NOT donated — the apply half needs them."""
+        conf = self.conf
+        zt = self._step_transform
+
+        def grad_step(params, state, x, y, fmask, lmask, rng):
+            if self._device_norm is not None:
+                x = self._device_norm.apply_features(x)
+                y = self._device_norm.apply_labels(y)
+            rng, srng = jax.random.split(rng)
+            fwd_params = params if zt is None else zt.gather_all(params)
+
+            def loss_fn(p):
+                loss, new_state = self._loss(p, state, x, y, srng, fmask,
+                                             lmask)
+                return loss, new_state
+
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(fwd_params)
+            if zt is not None:
+                # ship the reduce-scattered (padded, update-layout) shard —
+                # compress the shard, not the gathered tree (ISSUE: ZeRO-1
+                # composition); the apply half re-pins the wire grads with
+                # constrain_update instead of re-padding
+                grads = {conf.layer_name(i): zt.scatter(conf.layer_name(i),
+                                                        grads[conf.layer_name(i)])
+                         for i in range(len(conf.layers))}
+            return grads, new_state, loss, rng
+
+        return grad_step
+
+    def _build_apply_body(self):
+        """Apply half: updater loop on the DCN-combined gradient.
+        Gradient normalization runs HERE, on the cross-host-combined
+        gradient — the same quantity the single-mesh step normalizes
+        (zero pads under ZeRO-1 don't perturb L2 norms)."""
+        conf = self.conf
+        zt = self._step_transform
+
+        def apply_step(params, opt_state, grads, iteration, epoch):
+            new_params = {}
+            new_opt = {}
+            for i, layer in enumerate(conf.layers):
+                name = conf.layer_name(i)
+                if layer.frozen:
+                    new_params[name] = params[name]
+                    new_opt[name] = opt_state[name]
+                    continue
+                g = grads[name]
+                if zt is not None:
+                    g = zt.constrain_update(name, g)
+                gn = (layer.gradient_normalization
+                      if layer.gradient_normalization is not None
+                      else conf.gradient_normalization)
+                if gn:
+                    thr = (layer.gradient_normalization_threshold
+                           if layer.gradient_normalization is not None
+                           else conf.gradient_normalization_threshold)
+                    g = apply_gradient_normalization(g, gn, thr)
+                p_upd = (params[name] if zt is None
+                         else zt.update_view(name, params[name]))
+                upd_cfg = self._updater_for(i)
+                upd, new_o = upd_cfg.apply(opt_state[name], g,
+                                           iteration, epoch,
+                                           params=p_upd)
+                wd = (layer.weight_decay if layer.weight_decay is not None
+                      else conf.weight_decay)
+                if wd:
+                    lr = upd_cfg.lr_at(iteration, epoch)
+                    upd = _add_scaled_where(
+                        upd, p_upd,
+                        layer.regularizable_mask(p_upd), lr * wd)
+                new_p = jax.tree_util.tree_map(
+                    lambda p_, u_: p_ - u_, p_upd, upd)
+                if zt is not None:
+                    new_p = zt.restore(name, new_p)
+                    new_o = zt.constrain_opt(name, new_o)
+                new_params[name] = new_p
+                new_opt[name] = new_o
+            return new_params, new_opt, iteration + 1
+
+        return apply_step
+
+    def _get_grad_step(self):
+        if self._grad_step is None:
+            from deeplearning4j_tpu.compile import step_function
+            self._grad_step = step_function(
+                self._build_grad_body(),
+                donate_argnums=(1,),        # state only: params feed the
+                key_base=lambda: dict(      # apply half next
+                    self._aot_key_parts(), kind="mln_grad_step"),
+                cache=self._exec_cache(),
+                dynamic_argnums=(2, 3, 4, 5))
+        return self._grad_step
+
+    def _get_apply_step(self):
+        if self._apply_step is None:
+            from deeplearning4j_tpu.compile import step_function
+            self._apply_step = step_function(
+                self._build_apply_body(),
+                donate_argnums=(0, 1),
+                key_base=lambda: dict(
+                    self._aot_key_parts(), kind="mln_apply_step"),
+                cache=self._exec_cache(),
+                dynamic_argnums=())
+        return self._apply_step
+
+    def _fit_batch_shared(self, x, y, fmask=None, lmask=None):
+        """One training step through the hierarchical path: compiled grad
+        half → host-side DCN exchange → compiled apply half."""
+        from deeplearning4j_tpu.utils.counters import advance, device_counters
+        t0 = time.perf_counter()
+        gstep = self._get_grad_step()
+        grads, self.state_, loss, self._rng = gstep(
+            self.params_, self.state_, x, y, fmask, lmask, self._rng)
+        combined = self._grad_sharing.exchange(grads)
+        astep = self._get_apply_step()
+        it_dev, ep_dev = device_counters(self)
+        self.params_, self.opt_state_, new_it = astep(
+            self.params_, self.opt_state_, combined, it_dev, ep_dev)
+        ins = self._instruments()
+        ins.record_dispatch(time.perf_counter() - t0)
+        ins.check_compile(gstep, self)
+        ins.check_compile(astep, self)
+        self._score = loss
+        self._last_batch_size = int(x.shape[0])
+        advance(self, new_it)
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, self.epoch)
+
     def _get_scan_step(self):
         if self._scan_step is None:
             from deeplearning4j_tpu.utils.scan_fit import make_scan_step
@@ -556,6 +727,13 @@ class MultiLayerNetwork:
         length-k array."""
         from deeplearning4j_tpu.utils.counters import advance, device_counters
         from deeplearning4j_tpu.utils.scan_fit import check_steps_axes
+        if self._grad_sharing is not None:
+            # a host-side exchange cannot run mid-lax.scan: degrade to a
+            # per-step two-phase loop — exact same math, the fused-dispatch
+            # latency win is traded for the DCN bytes win (documented in
+            # docs/performance.md §6)
+            return self._fit_steps_shared(xs, ys, features_masks,
+                                          labels_masks)
         if isinstance(xs, (list, tuple)):
             k = len(xs)
             if not (isinstance(ys, (list, tuple)) and len(ys) == k):
@@ -659,8 +837,37 @@ class MultiLayerNetwork:
             else:
                 self.fit_steps(*payload)
 
+    def _fit_steps_shared(self, xs, ys, features_masks=None,
+                          labels_masks=None):
+        """Per-step loop replacement for `fit_steps` when hierarchical
+        sharing is active (host exchange can't run inside a scan)."""
+        if isinstance(xs, (list, tuple)):
+            k = len(xs)
+            fms = features_masks if features_masks is not None else [None] * k
+            lms = labels_masks if labels_masks is not None else [None] * k
+            steps = [(jnp.asarray(xs[i]), jnp.asarray(ys[i]),
+                      None if fms[i] is None else jnp.asarray(fms[i]),
+                      None if lms[i] is None else jnp.asarray(lms[i]))
+                     for i in range(k)]
+        else:
+            xs, ys = jnp.asarray(xs), jnp.asarray(ys)
+            k = int(xs.shape[0])
+            steps = [(xs[i], ys[i],
+                      None if features_masks is None
+                      else jnp.asarray(features_masks)[i],
+                      None if labels_masks is None
+                      else jnp.asarray(labels_masks)[i])
+                     for i in range(k)]
+        losses = []
+        for x, y, fm, lm in steps:
+            self._fit_batch_shared(x, y, fm, lm)
+            losses.append(self._score)
+        return jnp.stack(losses)
+
     def _fit_batch(self, x, y, fmask=None, lmask=None):
         from deeplearning4j_tpu.utils.counters import advance, device_counters
+        if self._grad_sharing is not None:
+            return self._fit_batch_shared(x, y, fmask, lmask)
         step = self._get_train_step()
         it_dev, ep_dev = device_counters(self)
         t0 = time.perf_counter()
@@ -703,6 +910,8 @@ class MultiLayerNetwork:
                              else DeviceNormalizer.from_host(normalizer))
         self._train_step = None
         self._scan_step = None
+        self._grad_step = None
+        self._apply_step = None
         self._output_fn = None
         return self
 

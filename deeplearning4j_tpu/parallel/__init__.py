@@ -20,6 +20,10 @@ from deeplearning4j_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_apply, sequential_apply, stack_stage_params)
 from deeplearning4j_tpu.parallel.multihost import (  # noqa: F401
     ElasticLocalRunner, LocalLauncher)
+from deeplearning4j_tpu.parallel.hierarchical import (  # noqa: F401
+    HierarchicalAllReduce, HierarchicalGradientSharing)
+from deeplearning4j_tpu.parallel.composed import (  # noqa: F401
+    ComposedParallel)
 from deeplearning4j_tpu.parallel.checkpoint import (  # noqa: F401
     ChecksumError, load_model_sharded, load_sharded, read_metadata,
     save_model_sharded, save_sharded, verify_checkpoint)

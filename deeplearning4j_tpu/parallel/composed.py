@@ -223,6 +223,71 @@ def composed_train_step(mesh: Mesh, n_heads: int, lr: float = 0.1,
     return step
 
 
+class ComposedParallel:
+    """Facade over the 3D-parallel train step with optional hierarchical
+    compressed gradient sharing across hosts.
+
+    Without sharing: `fit_batch` is `composed_train_step` (one jitted
+    dp×tp×pp step).  With a `HierarchicalGradientSharing` config the step
+    splits the same way the nn models' does — a jitted grad half (all
+    intra-mesh collectives included), the host-side compressed DCN
+    exchange (`parallel.hierarchical`), and a jitted apply half — so a
+    gang of these (one per host, each on its own local 3D mesh) trains
+    with threshold-int streams as the only cross-host traffic."""
+
+    def __init__(self, mesh: Mesh, n_heads: int, lr: float = 0.1,
+                 remat: bool = False, gradient_sharing=None, **axes):
+        self.mesh = mesh
+        self.n_heads = n_heads
+        self.lr = lr
+        self._sharing = None
+        if gradient_sharing is not None:
+            from deeplearning4j_tpu.parallel.hierarchical import (
+                HierarchicalAllReduce, HierarchicalGradientSharing)
+            self._sharing = (gradient_sharing
+                             if isinstance(gradient_sharing,
+                                           HierarchicalAllReduce)
+                             else HierarchicalAllReduce(gradient_sharing))
+        self._step = composed_train_step(mesh, n_heads, lr=lr, remat=remat,
+                                         **axes)
+
+        @jax.jit
+        def grad_fn(params, x, y):
+            def loss_fn(p):
+                out = composed_apply(p, x, mesh, n_heads, remat=remat,
+                                     **axes)
+                return jnp.mean((out - y) ** 2)
+            return jax.value_and_grad(loss_fn)(params)
+
+        @jax.jit
+        def apply_fn(params, grads):
+            return jax.tree_util.tree_map(lambda a, g: a - lr * g,
+                                          params, grads)
+
+        self._grad_fn = grad_fn
+        self._apply_fn = apply_fn
+
+    @property
+    def gradient_sharing(self):
+        return self._sharing
+
+    def fit_batch(self, params, x, y):
+        """(params, loss) after one step; with sharing active the grads
+        cross the compressed DCN hop between the two jitted halves."""
+        if self._sharing is None:
+            with self.mesh:
+                return self._step(params, x, y)
+        with self.mesh:
+            loss, grads = self._grad_fn(params, x, y)
+        combined = self._sharing.exchange(grads)
+        with self.mesh:
+            return self._apply_fn(params, combined), loss
+
+    def close(self) -> None:
+        if self._sharing is not None:
+            self._sharing.close()
+
+
 def composed_train_steps(mesh: Mesh, n_heads: int, lr: float = 0.1,
                          remat: bool = False, **axes):
     """Fused k-step form of `composed_train_step`: the fused-dispatch

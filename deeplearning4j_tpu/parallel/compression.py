@@ -11,7 +11,7 @@ staleness of the reference is deliberately dropped (north star).
 """
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 import jax
 import numpy as np
@@ -57,16 +57,18 @@ class CompressedGradientExchange:
                        [c.threshold for c in self.codecs])
 
     def decode(self, streams: List[np.ndarray],
-               thresholds: List[float] = None):
-        """Sparse streams -> dense gradient pytree."""
-        thresholds = thresholds or self.thresholds()
+               thresholds: Optional[List[float]] = None):
+        """Sparse streams -> dense gradient pytree.  `thresholds` defaults
+        to the most recent encode's ONLY when None — an explicit (possibly
+        empty, for a zero-leaf tree) list is honored as given, and the
+        per-call threshold never mutates codec state, so a decode of peer
+        streams can run concurrently with the next local encode."""
+        if thresholds is None:
+            thresholds = self.thresholds()
         dense = []
         for codec, enc, shape, thr in zip(self.codecs, streams,
                                           self._shapes, thresholds):
-            saved = codec.threshold
-            codec.threshold = thr
-            dense.append(codec.decode(enc).reshape(shape))
-            codec.threshold = saved
+            dense.append(codec.decode(enc, threshold=thr).reshape(shape))
         return jax.tree_util.tree_unflatten(self._treedef, dense)
 
     def compression_ratio(self, streams: List[np.ndarray]) -> float:
@@ -93,3 +95,20 @@ def allreduce_compressed(exchange: CompressedGradientExchange,
         total = dense if total is None else jax.tree_util.tree_map(
             lambda a, b: a + b, total, dense)
     return total
+
+
+def allreduce_dense(transport, grads):
+    """Sum a gradient pytree across ranks shipping FULL-PRECISION f32
+    leaves — the uncompressed baseline the `bench.py --comms` A/B measures
+    the threshold path against.  Same star all-gather, no codec, no
+    residuals; bytes on wire scale with the dense parameter count."""
+    from deeplearning4j_tpu.parallel.transport import (pack_dense,
+                                                       unpack_dense)
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    payload = pack_dense([np.asarray(l) for l in leaves])
+    total = None
+    for peer_payload in transport.allgather(payload):
+        peer = unpack_dense(peer_payload)
+        total = peer if total is None else [a + b
+                                            for a, b in zip(total, peer)]
+    return jax.tree_util.tree_unflatten(treedef, total)

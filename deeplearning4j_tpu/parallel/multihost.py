@@ -35,6 +35,11 @@ ENV_COORD = "DL4J_TPU_COORDINATOR"
 ENV_NPROC = "DL4J_TPU_NUM_PROCESSES"
 ENV_PID = "DL4J_TPU_PROCESS_ID"
 ENV_CKPT = "DL4J_TPU_CHECKPOINT_DIR"
+# TCP port for the hierarchical compressed gradient exchange
+# (parallel.hierarchical resolves its config from these; hierarchical
+# multi-host mode needs NO jax.distributed — each host runs its own local
+# mesh and the gradient mesh is the only coupling)
+ENV_GRAD_PORT = "DL4J_TPU_GRADIENT_PORT"
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -198,14 +203,19 @@ class ElasticLocalRunner:
 
     def run(self, script: str, args: Sequence[str] = (),
             timeout: float = 300.0,
-            checkpoint_dir: Optional[str] = None) -> List[str]:
+            checkpoint_dir: Optional[str] = None,
+            gradient_mesh: bool = False) -> List[str]:
         """Run the gang, relaunching after retryable failures.  With
         `checkpoint_dir=` every (re)launch exports it to the workers as
         `DL4J_TPU_CHECKPOINT_DIR`, so a resilience-aware worker (e.g.
         tests/mh_worker_elastic.py via `train.resilience`) resumes from
-        the last committed sharded checkpoint instead of step 0.  A
-        `corrupt` failure (checksum-mismatch restore) aborts immediately:
-        relaunching cannot fix rotten bytes."""
+        the last committed sharded checkpoint instead of step 0.  With
+        `gradient_mesh=True` every (re)launch exports a FRESH
+        `DL4J_TPU_GRADIENT_PORT` for the hierarchical compressed
+        exchange (a new port per attempt — the dead gang's socket may
+        linger in TIME_WAIT).  A `corrupt` failure (checksum-mismatch
+        restore) aborts immediately: relaunching cannot fix rotten
+        bytes."""
         import time as _time
         extra_env = {} if checkpoint_dir is None \
             else {ENV_CKPT: checkpoint_dir}
@@ -215,8 +225,9 @@ class ElasticLocalRunner:
                                      self.devices_per_process,
                                      self.platform)
             try:
-                return launcher.run(script, args, timeout,
-                                    extra_env=extra_env)
+                return launcher.run(
+                    script, args, timeout, extra_env=extra_env,
+                    gradient_port=free_port() if gradient_mesh else None)
             except RuntimeError as e:
                 last_error = e
                 kind = self._classify_failure(str(e))
@@ -253,12 +264,19 @@ class LocalLauncher:
 
     def run(self, script: str, args: Sequence[str] = (),
             timeout: float = 300.0,
-            extra_env: Optional[Dict[str, str]] = None) -> List[str]:
+            extra_env: Optional[Dict[str, str]] = None,
+            gradient_port: Optional[int] = None) -> List[str]:
+        """`gradient_port=` exports `DL4J_TPU_GRADIENT_PORT` so workers
+        using hierarchical gradient sharing form their TCP gradient mesh
+        on a known port (pass `free_port()` for a fresh one per launch —
+        an elastic relaunch must NOT reuse a port still in TIME_WAIT)."""
         coordinator = f"127.0.0.1:{free_port()}"
         procs = []
         for rank in range(self.num_processes):
             env = child_env(coordinator, self.num_processes, rank,
                             self.devices_per_process, self.platform)
+            if gradient_port is not None:
+                env[ENV_GRAD_PORT] = str(gradient_port)
             if extra_env:
                 env.update(extra_env)
             procs.append(subprocess.Popen(

@@ -11,6 +11,12 @@ multi-process-on-localhost tests (SURVEY §4's Aeron-on-loopback analog).
 Topology: star via rank 0 (the parameter-server-shaped rank), length-
 prefixed binary frames, no pickling — streams are raw int32/float32 buffers
 exactly as the C++ codec emits them.
+
+Failure posture (the Aeron session-timeout role): every socket carries a
+timeout, connects retry with exponential backoff up to a deadline, and a
+peer that dies mid-exchange surfaces as a `PeerUnreachableError` NAMING
+the rank and address — training fails fast with an actionable message
+instead of hanging the whole gang on a silent recv.
 """
 from __future__ import annotations
 
@@ -22,8 +28,14 @@ from typing import List, Optional
 import numpy as np
 
 
-def _send_msg(sock: socket.socket, payload: bytes) -> None:
+class PeerUnreachableError(ConnectionError):
+    """A gradient-mesh peer could not be reached (connect) or stopped
+    responding (exchange).  The message names the rank and address."""
+
+
+def _send_msg(sock: socket.socket, payload: bytes) -> int:
     sock.sendall(struct.pack("<Q", len(payload)) + payload)
+    return len(payload) + 8
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -66,6 +78,39 @@ def unpack_streams(payload: bytes):
     return streams, thresholds
 
 
+def pack_dense(leaves: List[np.ndarray]) -> bytes:
+    """Full-precision framing for the uncompressed A/B baseline:
+    [count | per-leaf: ndim, dims..., raw f32] — self-describing, so
+    `unpack_dense` needs no shape template."""
+    out = [struct.pack("<I", len(leaves))]
+    for a in leaves:
+        # shape BEFORE ascontiguousarray: that call promotes 0-d to 1-d,
+        # which would silently re-shape scalar leaves on the far side
+        a = np.asarray(a, np.float32)
+        out.append(struct.pack("<I", a.ndim))
+        out.append(struct.pack(f"<{max(a.ndim, 1)}q",
+                               *(a.shape if a.ndim else (1,))))
+        out.append(np.ascontiguousarray(a).tobytes())
+    return b"".join(out)
+
+
+def unpack_dense(payload: bytes) -> List[np.ndarray]:
+    (count,) = struct.unpack_from("<I", payload, 0)
+    off = 4
+    leaves = []
+    for _ in range(count):
+        (ndim,) = struct.unpack_from("<I", payload, off)
+        off += 4
+        dims = struct.unpack_from(f"<{max(ndim, 1)}q", payload, off)
+        off += 8 * max(ndim, 1)
+        shape = tuple(dims[:ndim]) if ndim else ()
+        n = int(np.prod(shape)) if ndim else 1
+        a = np.frombuffer(payload, np.float32, n, off).copy()
+        off += 4 * n
+        leaves.append(a.reshape(shape) if ndim else a[0].reshape(()))
+    return leaves
+
+
 class TcpGradientMesh:
     """All-gather of opaque byte payloads across ranks (star via rank 0).
 
@@ -73,39 +118,95 @@ class TcpGradientMesh:
     rank), gathers one payload per rank per round, and broadcasts the full
     list — every rank then holds every rank's compressed stream, mirroring
     the reference mesh where each worker applies every peer's encoded
-    delta."""
+    delta.
+
+    `timeout` bounds every blocking socket op (accept, connect attempts,
+    recv/send during an exchange); `bytes_sent`/`bytes_received` count the
+    actual frames on the wire (the `comms_bytes_on_wire_total` source)."""
 
     def __init__(self, rank: int, world: int, port: int,
-                 host: str = "127.0.0.1", timeout: float = 60.0):
+                 host: str = "127.0.0.1", timeout: float = 60.0,
+                 connect_backoff_base: float = 0.05,
+                 connect_backoff_cap: float = 2.0):
         self.rank = rank
         self.world = world
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self.bytes_sent = 0
+        self.bytes_received = 0
         self._peers: List[Optional[socket.socket]] = [None] * world
+        self._peer_addr: List[str] = ["?"] * world
         self._server: Optional[socket.socket] = None
         if world == 1:
             return
         if rank == 0:
             srv = socket.create_server((host, port), backlog=world)
-            srv.settimeout(timeout)
             self._server = srv
+            deadline = time.monotonic() + timeout
+            connected: set = set()
             for _ in range(world - 1):
-                conn, _ = srv.accept()
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._raise_formation_timeout(connected)
+                srv.settimeout(remaining)
+                try:
+                    conn, addr = srv.accept()
+                except (socket.timeout, TimeoutError):
+                    self._raise_formation_timeout(connected)
                 conn.settimeout(timeout)
                 (peer_rank,) = struct.unpack("<I", _recv_exact(conn, 4))
+                if peer_rank <= 0 or peer_rank >= world \
+                        or peer_rank in connected:
+                    conn.close()
+                    raise ConnectionError(
+                        f"rank 0: peer at {addr[0]}:{addr[1]} identified "
+                        f"as invalid/duplicate rank {peer_rank} "
+                        f"(world={world}, already connected: "
+                        f"{sorted(connected)})")
                 self._peers[peer_rank] = conn
+                self._peer_addr[peer_rank] = f"{addr[0]}:{addr[1]}"
+                connected.add(peer_rank)
         else:
             deadline = time.monotonic() + timeout
+            backoff = connect_backoff_base
+            attempts = 0
+            last_err: Optional[Exception] = None
             while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise PeerUnreachableError(
+                        f"rank {rank}: gradient-mesh coordinator (rank 0) "
+                        f"at {host}:{port} unreachable after {timeout:.1f}s "
+                        f"/ {attempts} attempts: {last_err!r}")
                 try:
-                    conn = socket.create_connection((host, port),
-                                                    timeout=timeout)
+                    conn = socket.create_connection(
+                        (host, port), timeout=min(remaining, timeout))
                     break
-                except OSError:
-                    if time.monotonic() > deadline:
-                        raise
-                    time.sleep(0.1)
+                except OSError as e:
+                    last_err = e
+                    attempts += 1
+                    time.sleep(min(backoff, max(remaining, 0.0)))
+                    backoff = min(backoff * 2, connect_backoff_cap)
             conn.settimeout(timeout)
             conn.sendall(struct.pack("<I", rank))
             self._peers[0] = conn
+            self._peer_addr[0] = f"{host}:{port}"
+
+    def _raise_formation_timeout(self, connected: set) -> None:
+        missing = sorted(set(range(1, self.world)) - connected)
+        raise PeerUnreachableError(
+            f"rank 0: gradient mesh formation timed out after "
+            f"{self.timeout:.1f}s on {self.host}:{self.port} — rank(s) "
+            f"{missing} never connected ({len(connected)}/{self.world - 1} "
+            "peers arrived)")
+
+    def _peer_error(self, r: int, op: str,
+                    e: Exception) -> PeerUnreachableError:
+        return PeerUnreachableError(
+            f"rank {self.rank}: gradient exchange {op} with rank {r} "
+            f"({self._peer_addr[r]}) failed after {self.timeout:.1f}s — "
+            f"peer dead or stalled: {e!r}")
 
     def allgather(self, payload: bytes) -> List[bytes]:
         if self.world == 1:
@@ -114,14 +215,28 @@ class TcpGradientMesh:
             gathered: List[bytes] = [b""] * self.world
             gathered[0] = payload
             for r in range(1, self.world):
-                gathered[r] = _recv_msg(self._peers[r])
+                try:
+                    gathered[r] = _recv_msg(self._peers[r])
+                except (socket.timeout, TimeoutError, OSError,
+                        ConnectionError) as e:
+                    raise self._peer_error(r, "recv", e) from e
+                self.bytes_received += len(gathered[r]) + 8
             blob = struct.pack("<I", self.world) + b"".join(
                 struct.pack("<Q", len(g)) + g for g in gathered)
             for r in range(1, self.world):
-                _send_msg(self._peers[r], blob)
+                try:
+                    self.bytes_sent += _send_msg(self._peers[r], blob)
+                except (socket.timeout, TimeoutError, OSError,
+                        ConnectionError) as e:
+                    raise self._peer_error(r, "send", e) from e
             return gathered
-        _send_msg(self._peers[0], payload)
-        blob = _recv_msg(self._peers[0])
+        try:
+            self.bytes_sent += _send_msg(self._peers[0], payload)
+            blob = _recv_msg(self._peers[0])
+        except (socket.timeout, TimeoutError, OSError,
+                ConnectionError) as e:
+            raise self._peer_error(0, "exchange", e) from e
+        self.bytes_received += len(blob) + 8
         (world,) = struct.unpack_from("<I", blob, 0)
         off = 4
         gathered = []

@@ -122,13 +122,15 @@ class ParallelWrapper:
                  data_axis: str = "data",
                  sharding_rules: Optional[ShardingRules] = None,
                  training_mode: str = "SHARED_GRADIENTS",
-                 optimizer_sharding: bool = False):
+                 optimizer_sharding: bool = False,
+                 gradient_sharing=None):
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
         self.data_axis = data_axis
         self.training_mode = training_mode
         self._rules = sharding_rules
         self._zero1 = bool(optimizer_sharding)
+        self._sharing_cfg = gradient_sharing  # HierarchicalGradientSharing
         self._placed = False
         self._warned_drop = False
         self._instr: Optional[ParallelInstruments] = None
@@ -148,6 +150,7 @@ class ParallelWrapper:
             self._mode = "SHARED_GRADIENTS"
             self._rules: Optional[ShardingRules] = None
             self._zero1 = False
+            self._sharing = None
 
         def workers(self, n: int):
             self._workers = int(n); return self
@@ -169,6 +172,22 @@ class ParallelWrapper:
             as the replicated update, ~N× less optimizer-state HBM."""
             self._zero1 = bool(on); return self
 
+        def gradient_sharing(self, cfg=True):
+            """Hierarchical compressed cross-host gradient all-reduce (the
+            Aeron GradientSharing role at DCN scale): full-precision ICI
+            all-reduce inside the compiled step, threshold-compressed
+            TCP exchange of the ICI-reduced gradient across hosts
+            (parallel.hierarchical).  Pass a `HierarchicalGradientSharing`
+            config, True for env-resolved defaults, or None/False to keep
+            the single-mesh path."""
+            from deeplearning4j_tpu.parallel.hierarchical import (
+                HierarchicalGradientSharing)
+            if cfg is True:
+                cfg = HierarchicalGradientSharing()
+            elif cfg is False:
+                cfg = None
+            self._sharing = cfg; return self
+
         def averaging_frequency(self, n: int):
             return self  # parity no-op: sync all-reduce has no averaging lag
 
@@ -185,7 +204,8 @@ class ParallelWrapper:
             return ParallelWrapper(self._model, mesh,
                                    sharding_rules=self._rules,
                                    training_mode=self._mode,
-                                   optimizer_sharding=self._zero1)
+                                   optimizer_sharding=self._zero1,
+                                   gradient_sharing=self._sharing)
 
     @staticmethod
     def builder(model) -> "ParallelWrapper.Builder":
@@ -204,6 +224,22 @@ class ParallelWrapper:
         if not on:
             zero.disable_zero1(self.model)
         self._placed = False
+        return self
+
+    def gradient_sharing(self, cfg) -> "ParallelWrapper":
+        """Runtime toggle for hierarchical compressed gradient sharing:
+        a `HierarchicalGradientSharing` config (or True for env-resolved
+        defaults) installs the split-step exchange on the wrapped model;
+        None/False removes it.  Takes effect on the next fit call."""
+        from deeplearning4j_tpu.parallel.hierarchical import (
+            HierarchicalGradientSharing)
+        if cfg is True:
+            cfg = HierarchicalGradientSharing()
+        elif cfg is False:
+            cfg = None
+        self._sharing_cfg = cfg
+        if self._placed:
+            self.model.set_gradient_sharing(cfg)
         return self
 
     def apply_schedule(self, schedule) -> "ParallelWrapper":
@@ -244,6 +280,10 @@ class ParallelWrapper:
             if m.opt_state_ is not None:
                 m.opt_state_ = _shard_opt_state_like(m.opt_state_, m.params_,
                                                      self.mesh)
+        if self._sharing_cfg is not None:
+            m.set_gradient_sharing(self._sharing_cfg)
+        elif getattr(m, "_grad_sharing", None) is not None:
+            m.set_gradient_sharing(None)
         self._placed = True
         ins = self._instruments()
         ins.replicas.set(self.mesh.shape[self.data_axis])

@@ -157,6 +157,18 @@ class Zero1Transform:
             return jax.lax.with_sharding_constraint(x, self._ns(pl.store))
         return jax.tree_util.tree_map(r, self._sub(name), new_params)
 
+    def constrain_update(self, name: Optional[str], grads: PyTree) -> PyTree:
+        """Pin an ALREADY-PADDED gradient tree to the update layout.
+
+        The hierarchical-sharing apply-half feeds gradients back that came
+        off the wire at the grad-half's output layout — padded leaves are
+        padded already, so `scatter` (which pads again) would be wrong;
+        this is the re-entry constraint only."""
+        return jax.tree_util.tree_map(
+            lambda pl, x: jax.lax.with_sharding_constraint(
+                x, self._ns(pl.update)),
+            self._sub(name), grads)
+
     def constrain_opt(self, name: Optional[str], opt_state: PyTree) -> PyTree:
         """Pin the new moments to the update layout so the donated output
         matches the input buffers (scalar step counts etc. pass through)."""
@@ -173,6 +185,11 @@ class Zero1Transform:
 def _invalidate_steps(model) -> None:
     model._train_step = None
     model._scan_step = None
+    # hierarchical-sharing split steps (only MLN/CG grow these attrs)
+    if hasattr(model, "_grad_step"):
+        model._grad_step = None
+    if hasattr(model, "_apply_step"):
+        model._apply_step = None
 
 
 def _params_attr(model) -> str:

@@ -1,0 +1,371 @@
+"""Hierarchical compressed cross-host gradient all-reduce
+(parallel/hierarchical + transport + compression; reference: Aeron
+threshold GradientSharing, SURVEY.md §3.4, at DCN scale).
+
+Three layers under test: the codec contracts (explicit thresholds never
+mutate state; error-feedback residuals make the sum-over-steps track the
+true gradient), the TCP mesh failure posture (dead peers fail FAST with
+named-rank errors, never hang), and the split-step training integration
+(world=1 dense sharing is BITWISE the plain step; composes with ZeRO-1
+and the fused `fit_steps` entry; real multi-process parity over TCP)."""
+import json
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.monitor.registry import registry
+from deeplearning4j_tpu.nn import (DenseLayer, InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.parallel import (HierarchicalGradientSharing,
+                                         ParallelWrapper, make_mesh)
+from deeplearning4j_tpu.parallel.compression import (
+    CompressedGradientExchange)
+from deeplearning4j_tpu.parallel.multihost import (ENV_GRAD_PORT,
+                                                   LocalLauncher, free_port)
+from deeplearning4j_tpu.parallel.transport import (PeerUnreachableError,
+                                                   TcpGradientMesh,
+                                                   pack_dense, pack_streams,
+                                                   unpack_dense,
+                                                   unpack_streams)
+from deeplearning4j_tpu.train.updaters import Sgd
+
+
+def _net(seed=7, n_in=8, lr=0.1):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(lr))
+            .list([DenseLayer(n_out=16, activation="relu"),
+                   OutputLayer(n_out=3, loss="mcxent", activation="softmax")])
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=32, n_in=8, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, n_in).astype(np.float32)
+    labels = (x[:, 0] > 0).astype(int) + (x[:, 1] > 0).astype(int)
+    y = np.eye(3, dtype=np.float32)[labels]
+    return x, y
+
+
+def _assert_params_equal(a, b, exact=True):
+    def cmp(x, y):
+        x, y = np.asarray(x), np.asarray(y)
+        if exact:
+            np.testing.assert_array_equal(x, y)
+        else:
+            np.testing.assert_allclose(x, y, rtol=1e-5, atol=1e-6)
+    jax.tree_util.tree_map(cmp, a.params_, b.params_)
+
+
+# ---------------------------------------------------------------------------
+# Codec contracts (satellite: decode must not mutate codec state)
+# ---------------------------------------------------------------------------
+
+def test_decode_explicit_thresholds_no_mutation():
+    """Decoding a peer's stream at the PEER's threshold must not disturb
+    this host's codecs: thresholds unchanged after, and the next local
+    encode/decode round-trip is unaffected."""
+    tmpl = {"w": np.zeros((8, 4), np.float32)}
+    ex = CompressedGradientExchange(tmpl, threshold=0.01)
+    g = {"w": np.full((8, 4), 0.05, np.float32)}
+    streams = ex.encode(g)
+    before = [c.threshold for c in ex.codecs]
+    peer = ex.decode(streams, thresholds=[0.5])     # peer's coarse stream
+    assert float(peer["w"][0, 0]) == pytest.approx(0.5)
+    assert [c.threshold for c in ex.codecs] == before
+    own = ex.decode(streams)                        # None -> used thresholds
+    assert float(own["w"][0, 0]) == pytest.approx(0.01)
+
+
+def test_decode_empty_threshold_list_honored():
+    """An explicit (falsy) empty list is a valid thresholds argument for a
+    zero-leaf tree — it must be honored as given, not swapped for the
+    last-encode default."""
+    ex = CompressedGradientExchange({}, threshold=0.01)
+    assert ex.decode(ex.encode({}), thresholds=[]) == {}
+
+
+def test_residual_error_feedback_flushes_to_true_sum():
+    """What a threshold cut this step, the residual re-emits later: the
+    sum of decoded exchanges converges to the true gradient sum (the
+    reference accumulator's delta semantics)."""
+    thr = 0.01
+    rng = np.random.RandomState(0)
+    g = {"w": (rng.randn(64).astype(np.float32) * 0.03)}
+    ex = CompressedGradientExchange(g, threshold=thr)
+    total = np.zeros(64, np.float32)
+    total += np.asarray(ex.decode(ex.encode(g))["w"])
+    zeros = {"w": np.zeros(64, np.float32)}
+    for _ in range(20):                 # flush residuals
+        total += np.asarray(ex.decode(ex.encode(zeros))["w"])
+    np.testing.assert_allclose(total, g["w"], atol=thr + 1e-7)
+
+
+def test_adaptive_threshold_converges_toward_target_density():
+    """A stream denser than 2x target must drive the threshold UP until
+    the emitted density falls toward the target."""
+    rng = np.random.RandomState(1)
+    ex = CompressedGradientExchange({"w": np.zeros(4096, np.float32)},
+                                    threshold=1e-4,
+                                    adaptive_target_density=1e-2)
+    thr0 = ex.codecs[0].threshold
+    d_first = d_last = None
+    for _ in range(40):
+        g = {"w": rng.randn(4096).astype(np.float32) * 0.01}
+        (s,) = ex.encode(g)
+        d = len(s) / 4096
+        d_first = d if d_first is None else d_first
+        d_last = d
+    assert ex.codecs[0].threshold > thr0
+    assert d_last < d_first
+    assert d_last < 0.1                 # near the 1e-2 target, not ~1.0
+
+
+# ---------------------------------------------------------------------------
+# Wire formats
+# ---------------------------------------------------------------------------
+
+def test_pack_streams_round_trip():
+    streams = [np.array([1, -3, 7], np.int32), np.array([], np.int32),
+               np.array([-1], np.int32)]
+    thrs = [0.01, 0.5, 1e-6]
+    back, back_thr = unpack_streams(pack_streams(streams, thrs))
+    assert len(back) == 3
+    for a, b in zip(back, streams):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_allclose(back_thr, thrs, rtol=1e-6)
+
+
+def test_pack_dense_round_trip_including_scalar():
+    leaves = [np.arange(12, dtype=np.float32).reshape(3, 4),
+              np.float32(2.5),          # 0-d leaf
+              np.array([], np.float32)]
+    back = unpack_dense(pack_dense(leaves))
+    assert back[0].shape == (3, 4) and back[1].shape == () \
+        and back[2].shape == (0,)
+    for a, b in zip(back, leaves):
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# Failure posture (satellite: dead peer must fail fast, named)
+# ---------------------------------------------------------------------------
+
+def test_dead_coordinator_fails_fast_with_named_error():
+    port = free_port()                  # nothing listens here
+    t0 = time.monotonic()
+    with pytest.raises(PeerUnreachableError) as ei:
+        TcpGradientMesh(rank=1, world=2, port=port, timeout=1.0)
+    assert time.monotonic() - t0 < 5.0
+    msg = str(ei.value)
+    assert "rank 0" in msg and str(port) in msg and "unreachable" in msg
+
+
+def test_formation_timeout_names_missing_ranks():
+    with pytest.raises(PeerUnreachableError) as ei:
+        TcpGradientMesh(rank=0, world=3, port=free_port(), timeout=0.5)
+    msg = str(ei.value)
+    assert "[1, 2]" in msg and "never connected" in msg
+
+
+def test_peer_unreachable_is_connection_error():
+    assert issubclass(PeerUnreachableError, ConnectionError)
+
+
+# ---------------------------------------------------------------------------
+# Split-step training integration (world == 1: no sockets)
+# ---------------------------------------------------------------------------
+
+def test_world1_dense_sharing_bitwise_matches_plain_fit():
+    """The grad/apply split with a pass-through exchange must be the SAME
+    math as the fused plain step — bitwise, not approximately."""
+    x, y = _data()
+    ref = _net()
+    shared = _net()
+    shared.set_gradient_sharing(HierarchicalGradientSharing(
+        compressed=False, world=1))
+    for _ in range(5):
+        ref.fit(x, y)
+        shared.fit(x, y)
+    _assert_params_equal(ref, shared, exact=True)
+    assert ref.iteration == shared.iteration == 5
+    shared.set_gradient_sharing(None)
+    assert shared.gradient_sharing is None
+
+
+def test_world1_compressed_converges_and_records_metrics():
+    """The codec round-trip (residuals included) runs even single-host;
+    training must still converge and the comms metrics must land in the
+    shared registry."""
+    x, y = _data(n=64)
+    net = _net()
+    net.set_gradient_sharing(HierarchicalGradientSharing(
+        threshold=5e-3, world=1))
+    first = None
+    for _ in range(40):
+        net.fit(x, y)
+        first = net.score() if first is None else first
+    assert net.score() < first * 0.8
+    st = net.gradient_sharing.stats()
+    assert st["exchanges"] == 40 and st["compressed"] and st["world"] == 1
+    assert st["last_wire_bytes"] > 0
+    c = registry().get("comms_exchanges_total", {"codec": "threshold"})
+    assert c is not None and c.value >= 40
+    b = registry().get("comms_bytes_on_wire_total", {"codec": "threshold"})
+    assert b is not None and b.value > 0
+    g = registry().get("comms_compression_ratio")
+    assert g is not None and g.value > 1.0
+    h = registry().get("comms_exchange_ms")
+    assert h is not None and h.count >= 40
+    net.set_gradient_sharing(None)
+
+
+def test_sharing_composes_with_zero1_and_fit_steps():
+    """ZeRO-1 + sharing: the grad half ships the reduce-scattered shard,
+    the apply half runs the sharded update on the combined gradient —
+    bitwise-equal to plain ZeRO-1 for Sgd, including through the
+    `fit_steps` entry (which degrades to per-step exchange)."""
+    mesh = make_mesh({"data": 8}, jax.devices())
+    rng = np.random.RandomState(2)
+    xs = rng.randn(4, 32, 8).astype(np.float32)
+    ys = np.eye(3, dtype=np.float32)[rng.randint(0, 3, (4, 32))]
+
+    ref = _net()
+    pw_ref = ParallelWrapper(ref, mesh, optimizer_sharding=True)
+    shared = _net()
+    pw_sh = ParallelWrapper(shared, mesh, optimizer_sharding=True,
+                            gradient_sharing=HierarchicalGradientSharing(
+                                compressed=False, world=1))
+    l_ref = pw_ref.fit_steps(xs, ys)
+    l_sh = pw_sh.fit_steps(xs, ys)
+    np.testing.assert_array_equal(np.asarray(l_ref), np.asarray(l_sh))
+    _assert_params_equal(ref, shared, exact=True)
+    assert ref.iteration == shared.iteration == 4
+    pw_sh.gradient_sharing(None)
+
+
+def test_computation_graph_world1_dense_parity():
+    from deeplearning4j_tpu.nn import ComputationGraph, GraphBuilder
+
+    def build():
+        conf = (GraphBuilder().seed(5).updater(Sgd(0.1))
+                .add_inputs("in")
+                .set_input_types(InputType.feed_forward(8))
+                .add_layer("d", DenseLayer(n_out=12, activation="tanh"),
+                           "in")
+                .add_layer("out", OutputLayer(n_out=3, loss="mcxent",
+                                              activation="softmax"), "d")
+                .set_outputs("out").build())
+        return ComputationGraph(conf).init()
+
+    x, y = _data(n=16)
+    ref, shared = build(), build()
+    shared.set_gradient_sharing(HierarchicalGradientSharing(
+        compressed=False, world=1))
+    for _ in range(5):
+        ref.fit(x, y)
+        shared.fit(x, y)
+    _assert_params_equal(ref, shared, exact=True)
+    shared.set_gradient_sharing(None)
+
+
+def test_wrapper_builder_and_runtime_toggle():
+    x, y = _data()
+    net = _net()
+    pw = (ParallelWrapper.builder(net)
+          .workers(4)
+          .gradient_sharing(HierarchicalGradientSharing(
+              compressed=False, world=1))
+          .build())
+    pw.fit(x, y)
+    assert net.gradient_sharing is not None
+    assert net.gradient_sharing.world == 1
+    pw.gradient_sharing(False)          # runtime off-toggle
+    pw.fit(x, y)
+    assert net.gradient_sharing is None
+    assert net.iteration == 2
+
+
+def test_composed_parallel_sharing_matches_plain_step():
+    """The dp×tp×pp composed step with a pass-through (dense, world=1)
+    DCN exchange must track the plain composed step, and the compressed
+    config must run through the same facade."""
+    from deeplearning4j_tpu.parallel.composed import (ComposedParallel,
+                                                      init_stage_params)
+    mesh = make_mesh({"data": 2, "model": 2, "pipe": 2}, jax.devices()[:8])
+    params = init_stage_params(np.random.RandomState(7), 2, 8, 2, 16)
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 8, 8).astype(np.float32)
+    y = rng.randn(8, 8, 8).astype(np.float32)
+
+    plain = ComposedParallel(mesh, n_heads=2, lr=0.2)
+    shared = ComposedParallel(mesh, n_heads=2, lr=0.2,
+                              gradient_sharing=HierarchicalGradientSharing(
+                                  compressed=False, world=1))
+    p_plain, p_shared = params, params
+    for _ in range(2):
+        p_plain, l_plain = plain.fit_batch(p_plain, x, y)
+        p_shared, l_shared = shared.fit_batch(p_shared, x, y)
+    np.testing.assert_allclose(float(l_plain), float(l_shared),
+                               rtol=1e-5, atol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        p_plain, p_shared)
+    assert shared.gradient_sharing.exchanges == 2
+    shared.close()
+
+    comp = ComposedParallel(mesh, n_heads=2, lr=0.2,
+                            gradient_sharing=HierarchicalGradientSharing(
+                                threshold=5e-3, world=1))
+    p, loss = comp.fit_batch(params, x, y)
+    assert np.isfinite(float(loss))
+    assert comp.gradient_sharing.stats()["compressed"]
+    comp.close()
+
+
+def test_config_resolves_from_launcher_env(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_PROCESS_ID", "3")
+    monkeypatch.setenv("DL4J_TPU_NUM_PROCESSES", "4")
+    monkeypatch.setenv(ENV_GRAD_PORT, "50123")
+    cfg = HierarchicalGradientSharing().resolve()
+    assert (cfg.rank, cfg.world, cfg.port) == (3, 4, 50123)
+    assert cfg.host == "127.0.0.1"
+    with pytest.raises(ValueError, match="combine"):
+        HierarchicalGradientSharing(combine="max")
+
+
+# ---------------------------------------------------------------------------
+# Real multi-process exchange (acceptance: compressed-vs-dense parity +
+# bytes-on-wire reduction over actual TCP)
+# ---------------------------------------------------------------------------
+
+def test_multihost_compressed_vs_dense_parity(tmp_path):
+    """Two real processes (own XLA clients, coupled only by the TCP
+    gradient mesh) train the same model A/B: dense wire vs threshold
+    streams.  Ranks must agree bitwise with each other (same combined
+    gradient), compressed must track dense loss, and must ship
+    meaningfully fewer bytes."""
+    worker = os.path.join(os.path.dirname(__file__), "mh_worker_comms.py")
+    steps, res = 40, {}
+    for mode in ("dense", "compressed"):
+        launcher = LocalLauncher(num_processes=2, devices_per_process=1)
+        launcher.run(worker, [str(tmp_path), mode, steps, 16],
+                     timeout=240.0, gradient_port=free_port())
+        curves = [np.load(tmp_path / f"curve_{mode}_{r}.npz")
+                  for r in range(2)]
+        stats = [json.loads((tmp_path / f"stats_{mode}_{r}.json")
+                            .read_text()) for r in range(2)]
+        np.testing.assert_allclose(curves[0]["w0"], curves[1]["w0"],
+                                   rtol=1e-5, atol=1e-6)
+        assert all(s["exchanges"] == steps for s in stats)
+        res[mode] = {
+            "loss": float(np.mean([c["losses"][-1] for c in curves])),
+            "wire": sum(s["bytes_sent_total"] + s["bytes_received_total"]
+                        for s in stats)}
+    assert res["dense"]["wire"] > res["compressed"]["wire"] * 2
+    rel = (abs(res["compressed"]["loss"] - res["dense"]["loss"])
+           / abs(res["dense"]["loss"]))
+    assert rel < 0.05, f"compressed diverged from dense: {res!r}"
