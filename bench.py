@@ -51,6 +51,14 @@ gang (LocalLauncher: real processes, real sockets): cross-host bytes on
 wire (gate: >=5x reduction), steps/sec, and end-of-run loss parity
 (gate: within 1%); detail to stderr + `BENCH_comms.json`, one stdout
 JSON line.
+
+`python bench.py --fleet [--quick]` A/Bs a long-tail model population
+through the warm-pooled `serving.ModelFleet` against the naive
+always-resident posture: models served per fixed device-memory budget
+(gate: >=2x, with a compile-free second sweep via the persistent AOT
+cache) and an overload phase where low-priority traffic is shed while
+the high-priority p99 stays within its SLO (gate: both); detail to
+stderr + `BENCH_fleet.json`, one stdout JSON line.
 """
 import json
 import sys
@@ -1013,6 +1021,223 @@ def main_serving(quick: bool):
     }))
 
 
+def bench_fleet(n_models=16, max_resident=4, duration_s=4.0,
+                flood_requests=400):
+    """`--fleet` A/B: a long-tail model population through a warm-pooled
+    `serving.ModelFleet` vs the naive always-resident posture.
+
+    Phase A (capacity): `n_models` distinct MLPs served through a
+    `max_resident`-slot warm pool backed by a persistent AOT cache.  The
+    naive baseline needs all `n_models` param sets device-resident at
+    once; the fleet's peak residency is `max_resident` of them.  Gate (i):
+    models served per fixed device-memory budget >= 2x naive.  The second
+    sweep must be compile-free — every re-admission deserializes from the
+    persistent cache.
+
+    Phase B (overload): one high-priority model (generous SLO) plus one
+    low-priority model flooded far past capacity.  The flood drives the
+    low-priority p99 over its target; the fleet sheds low-priority traffic
+    and keeps serving.  Gate (ii): high-priority p99 stays within its SLO
+    while low-priority sheds are non-zero."""
+    import shutil
+    import tempfile
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.serving import (LatencySLO, ModelFleet,
+                                            RejectedError)
+    from deeplearning4j_tpu.train.updaters import Sgd
+
+    n_in = 32
+
+    def make_net(seed, hidden):
+        conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(1e-1))
+                .list([DenseLayer(n_out=hidden, activation="relu"),
+                       OutputLayer(n_out=10, loss="mcxent",
+                                   activation="softmax")])
+                .set_input_type(InputType.feed_forward(n_in)).build())
+        return MultiLayerNetwork(conf).init()
+
+    cache_dir = tempfile.mkdtemp(prefix="bench-fleet-")
+    try:
+        # ---- Phase A: long-tail capacity through the warm pool ----
+        fleet = ModelFleet(max_resident=max_resident,
+                           n_slices=2 * max_resident, max_batch=8,
+                           batch_timeout_ms=1.0, cache_dir=cache_dir)
+        per_model_bytes = []
+        for i in range(n_models):
+            # distinct widths -> distinct architecture fingerprints (no
+            # cross-model executable sharing flattering the cache)
+            net = make_net(i, 48 + 8 * (i % 8))
+            import jax
+            per_model_bytes.append(sum(
+                leaf.nbytes for leaf in
+                jax.tree_util.tree_leaves(net.params_)))
+            fleet.deploy(f"m{i:02d}", net,
+                         slo=LatencySLO(target_p99_ms=1000.0))
+        rng = np.random.RandomState(0)
+        reqs = 0
+        t0 = time.perf_counter()
+        compiles_after_first = None
+        for sweep in range(2):
+            for i in rng.permutation(n_models):
+                x = rng.rand(2, n_in).astype(np.float32)
+                fleet.output(f"m{i:02d}", x, deadline_ms=60_000.0,
+                             timeout=120)
+                reqs += 1
+            if sweep == 0:
+                compiles_after_first = fleet.cache.stats["compiles"]
+        sweep_dt = time.perf_counter() - t0
+        second_sweep_compiles = (fleet.cache.stats["compiles"]
+                                 - compiles_after_first)
+        st = fleet.fleet_stats()
+        cache_stats = dict(fleet.cache.stats)
+        warm_admissions = sum(
+            1 for m in st["models"].values()
+            if m["last_admission_fresh_compiles"] == 0)
+        peak_bytes = fleet.resident_bytes_peak
+        naive_bytes = sum(per_model_bytes)
+        # models servable per fixed budget: the fleet serves all n_models
+        # inside a peak residency the naive posture would exhaust after
+        # budget/per_model models
+        ratio = naive_bytes / peak_bytes if peak_bytes else 0.0
+        fleet.shutdown()
+
+        # ---- Phase B: overload -> shed low priority, hold high p99 ----
+        hi_slo_ms = 500.0
+        fleet = ModelFleet(max_resident=2, n_slices=2, max_batch=8,
+                           batch_timeout_ms=1.0, cache_dir=cache_dir,
+                           observe_every=4)
+        fleet.deploy("hi", make_net(1001, 64),
+                     slo=LatencySLO(target_p99_ms=hi_slo_ms, priority=10),
+                     warm=True)
+        fleet.deploy("lo", make_net(1002, 64),
+                     slo=LatencySLO(target_p99_ms=2.0, priority=0),
+                     warm=True)
+        stop = threading.Event()
+        hi_results = []
+
+        def hi_client():
+            rs = np.random.RandomState(7)
+            while not stop.is_set():
+                x = rs.rand(2, n_in).astype(np.float32)
+                try:
+                    fleet.output("hi", x, timeout=60)
+                    hi_results.append(1)
+                except RejectedError:
+                    hi_results.append(0)
+                time.sleep(0.002)
+
+        hi_thread = threading.Thread(target=hi_client, daemon=True)
+        hi_thread.start()
+
+        def lo_flood(i):
+            rs = np.random.RandomState(i)
+            served = shed = 0
+            for _ in range(flood_requests):
+                x = rs.rand(4, n_in).astype(np.float32)
+                try:
+                    f = fleet.submit("lo", x)
+                    f.exception(timeout=60)          # resolve, keep going
+                    served += 1
+                except RejectedError:
+                    shed += 1
+            return served, shed
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(8) as ex:
+            flood_totals = list(ex.map(lo_flood, range(8)))
+        flood_dt = time.perf_counter() - t0
+        end = time.monotonic() + min(duration_s, 2.0)
+        while time.monotonic() < end:               # hold hi load post-flood
+            time.sleep(0.05)
+        stop.set()
+        hi_thread.join(timeout=30)
+        hi_p99 = fleet.member("hi").latency.percentiles((99,))["p99"]
+        lo_sheds = fleet.member("lo").sheds
+        lo_served = sum(s for s, _ in flood_totals)
+        hi_served = sum(hi_results)
+        hi_shed = len(hi_results) - hi_served
+        breached = fleet.member("lo").tracker.breaches_total
+        fleet.shutdown()
+        return {
+            "n_models": n_models,
+            "max_resident": max_resident,
+            "sweep_requests": reqs,
+            "sweep_requests_per_sec": reqs / sweep_dt,
+            "naive_resident_bytes": naive_bytes,
+            "fleet_peak_resident_bytes": peak_bytes,
+            "models_per_budget_ratio": ratio,
+            "second_sweep_compiles": second_sweep_compiles,
+            "warm_admissions": warm_admissions,
+            "evictions": sum(m["evictions"] for m in st["models"].values()),
+            "aot_cache": cache_stats,
+            "hi_slo_ms": hi_slo_ms,
+            "hi_p99_ms": hi_p99,
+            "hi_served": hi_served,
+            "hi_shed": hi_shed,
+            "lo_served": lo_served,
+            "lo_sheds": lo_sheds,
+            "lo_breaches": breached,
+            "flood_duration_s": flood_dt,
+        }
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
+def main_fleet(quick: bool):
+    """`--fleet` mode: A/B detail to stderr + BENCH_fleet.json, ONE stdout
+    JSON line.  Gates: (i) >= 2x models per fixed device-memory budget vs
+    always-resident, with a compile-free second sweep; (ii) high-priority
+    p99 within SLO while low-priority traffic is shed under overload."""
+    import os
+    if not os.environ.get("JAX_PLATFORMS"):
+        # same bounded probe as --serving: the fleet is backend-agnostic,
+        # so fall back to CPU rather than hang on an unreachable TPU
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from __graft_entry__ import _probe_backend_device_count
+        if _probe_backend_device_count() < 1:
+            print("[bench] TPU backend unreachable; fleet bench on CPU",
+                  file=sys.stderr, flush=True)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = bench_fleet(n_models=8 if quick else 16,
+                        max_resident=2 if quick else 4,
+                        duration_s=1.0 if quick else 4.0,
+                        flood_requests=120 if quick else 400)
+    except Exception as e:
+        print(json.dumps({"metric": "fleet_models_per_memory_budget",
+                          "value": None, "unit": "x",
+                          "error": repr(e)[:300]}))
+        sys.exit(1)
+    for k, v in r.items():      # detail to stderr: stdout stays one line
+        print(f"[fleet] {k} = {v}", file=sys.stderr, flush=True)
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_fleet.json"), "w") as f:
+        json.dump(r, f, indent=2)
+    ok = (r["models_per_budget_ratio"] >= 2.0
+          and r["second_sweep_compiles"] == 0
+          and r["hi_p99_ms"] <= r["hi_slo_ms"]
+          and r["lo_sheds"] > 0)
+    print(json.dumps({
+        "metric": "fleet_models_per_memory_budget",
+        "value": round(r["models_per_budget_ratio"], 2),
+        "unit": "x",
+        "threshold": 2.0,
+        "pass": ok,
+        "second_sweep_compiles": r["second_sweep_compiles"],
+        "hi_p99_ms": round(r["hi_p99_ms"], 2),
+        "hi_slo_ms": r["hi_slo_ms"],
+        "lo_sheds": r["lo_sheds"],
+        "evictions": r["evictions"],
+        "warm_admissions": r["warm_admissions"],
+    }))
+    if not ok:
+        sys.exit(1)
+
+
 def aot_child(cache_dir: str, steps: int, batch: int, n_in: int):
     """`--aot-child` worker: ONE process's cold-or-warm measurement.
 
@@ -1320,6 +1545,9 @@ def main():
         return
     if "--serving" in sys.argv:
         main_serving(quick)
+        return
+    if "--fleet" in sys.argv:
+        main_fleet(quick)
         return
     if "--pipeline" in sys.argv:
         main_pipeline(quick)

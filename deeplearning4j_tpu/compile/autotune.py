@@ -81,8 +81,13 @@ class Schedule:
           model.
         * ModelServer / BucketedCompileCache — reconfigures the bucket
           ladder (prefer passing `schedule=` at construction).
+        * ModelFleet — installs this as the fleet default schedule,
+          applied to every replica on warm-pool admission (per-model
+          schedules from `schedules_dir` still win).
 
         Returns `target` for chaining."""
+        if hasattr(target, "set_default_schedule"):    # ModelFleet
+            return target.set_default_schedule(self)
         if hasattr(target, "apply_schedule"):          # models + wrapper
             return target.apply_schedule(self)
         if hasattr(target, "cache") and hasattr(target.cache, "set_buckets"):

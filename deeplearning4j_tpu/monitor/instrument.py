@@ -55,6 +55,22 @@ docs/observability.md):
   comms_exchange_ms                  wall time of one cross-host gradient
                                      exchange (encode + TCP + decode + sum)
   comms_exchanges_total{codec=}      cross-host gradient exchanges run
+  fleet_models                       models deployed to the fleet
+  fleet_models_resident              models currently holding device
+                                     residency (<= warm-pool capacity)
+  fleet_admissions_total{warm=}      warm-pool admissions (warm=true →
+                                     served from the persistent AOT cache,
+                                     zero fresh compiles)
+  fleet_evictions_total              LRU warm-pool evictions (drain +
+                                     device-buffer drop)
+  fleet_requests_total{model=}       requests routed per model (QPS source)
+  fleet_sheds_total{model=,priority=} requests shed by SLO pressure,
+                                     lowest priority first
+  fleet_slo_breaches_total{model=}   sustained-SLO-breach onsets
+  fleet_routing_ms                   router decision time (admission check
+                                     + replica pick; excludes admission
+                                     warmup)
+  fleet_rebalances_total             controller slice reallocations
 """
 from __future__ import annotations
 
@@ -337,6 +353,81 @@ class CommsInstruments:
         self._exchanges[codec].inc()
         self.compression_ratio.set(float(ratio))
         self.exchange_ms.observe(dt_s * 1000.0)
+
+
+class FleetInstruments:
+    """Multi-model fleet handles (serving.fleet).  Per-model families are
+    created lazily and memoized — a 64-model long-tail fleet touches each
+    child once, then records through plain attribute access."""
+
+    def __init__(self, registry_: Optional[MetricsRegistry] = None):
+        reg = registry_ if registry_ is not None else registry()
+        self._reg = reg
+        self.models = reg.gauge(
+            "fleet_models", help="models deployed to the fleet")
+        self.resident = reg.gauge(
+            "fleet_models_resident",
+            help="models currently device-resident (warm-pool occupancy; "
+            "bounded by max_resident)")
+        self._admissions = {
+            flag: reg.counter(
+                "fleet_admissions_total",
+                help="warm-pool admissions (warm=true deserialized every "
+                "executable from the persistent AOT cache — zero compiles)",
+                labels={"warm": "true" if flag else "false"})
+            for flag in (True, False)}
+        self.evictions = reg.counter(
+            "fleet_evictions_total",
+            help="LRU warm-pool evictions (batcher drained, device "
+            "buffers dropped, host registry entry kept)")
+        self.rebalances = reg.counter(
+            "fleet_rebalances_total",
+            help="controller device-slice reallocations between replica "
+            "groups")
+        self.routing_ms = reg.histogram(
+            "fleet_routing_ms",
+            help="router decision wall time: admission/shed check + "
+            "least-loaded replica pick (ms; excludes admission warmup)")
+        self._requests: dict = {}
+        self._sheds: dict = {}
+        self._breaches: dict = {}
+
+    def record_admission(self, warm: bool) -> None:
+        if not enabled():
+            return
+        self._admissions[bool(warm)].inc()
+
+    def requests(self, model: str):
+        c = self._requests.get(model)
+        if c is None:
+            c = self._reg.counter(
+                "fleet_requests_total",
+                help="requests routed through the fleet per model",
+                labels={"model": model})
+            self._requests[model] = c
+        return c
+
+    def sheds(self, model: str, priority: int):
+        key = (model, int(priority))
+        c = self._sheds.get(key)
+        if c is None:
+            c = self._reg.counter(
+                "fleet_sheds_total",
+                help="requests shed under sustained SLO pressure "
+                "(lowest priority classes first)",
+                labels={"model": model, "priority": str(int(priority))})
+            self._sheds[key] = c
+        return c
+
+    def breaches(self, model: str):
+        c = self._breaches.get(model)
+        if c is None:
+            c = self._reg.counter(
+                "fleet_slo_breaches_total",
+                help="sustained p99-over-target onsets per model",
+                labels={"model": model})
+            self._breaches[model] = c
+        return c
 
 
 _pipeline: Optional[PipelineInstruments] = None

@@ -13,12 +13,20 @@ AOT compiled-executable serving model (PAPERS.md).
                       graceful draining shutdown)
     metrics         — p50/p95/p99 latency, queue depth, batch occupancy,
                       compile-cache hit rate (UI: /serving endpoint)
+    slo / fleet     — multi-model fleet: LatencySLO routing, mesh-slice
+                      replica groups, warm-pool LRU eviction backed by the
+                      persistent AOT cache (UI: /fleet endpoint)
 """
 from deeplearning4j_tpu.serving.batcher import (  # noqa: F401
     ContinuousBatcher, DeadlineExceededError, RejectedError)
 from deeplearning4j_tpu.serving.compile_cache import (  # noqa: F401
     BucketedCompileCache, bucket_for, bucket_sizes)
+from deeplearning4j_tpu.serving.fleet import (  # noqa: F401
+    DeviceSlice, FleetController, FleetMember, FleetRouter, ModelFleet,
+    Replica, ReplicaGroup, WarmPool)
 from deeplearning4j_tpu.serving.metrics import ServingMetrics  # noqa: F401
 from deeplearning4j_tpu.serving.registry import (  # noqa: F401
     ModelEntry, ModelRegistry)
 from deeplearning4j_tpu.serving.server import ModelServer  # noqa: F401
+from deeplearning4j_tpu.serving.slo import (  # noqa: F401
+    FleetPolicy, LatencySLO, SLOTracker)

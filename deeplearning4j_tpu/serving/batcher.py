@@ -21,7 +21,14 @@ What's new over the old implementation:
   its deadline passes fails fast with `DeadlineExceededError`
   (a `TimeoutError`) instead of occupying a batch slot for an answer the
   client has already abandoned.
-* **Priority** — higher-priority requests seed dispatch groups first.
+* **Priority with aging** — higher-priority requests seed dispatch groups
+  first.  A queued request whose deadline is approaching gets an aging
+  bump (`aging_bump`, applied once less than `aging_fraction` of its
+  deadline budget remains) so a continuous stream of high-priority
+  traffic cannot starve low-priority entries straight past their
+  deadline: near-deadline requests escalate above fresh arrivals and
+  either dispatch or are shed *deliberately*, with every shed decision
+  counted per priority class (`serving_sheds_total{priority=,reason=}`).
 * **Admission control / backpressure** — the queue is bounded
   (`max_queue` requests); submits beyond it shed load with
   `RejectedError` immediately, keeping tail latency bounded for admitted
@@ -75,11 +82,20 @@ class ContinuousBatcher:
                                              List[np.ndarray]],
                  max_batch: int = 32, batch_timeout_ms: float = 5.0,
                  max_queue: int = 256,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 aging_fraction: float = 0.5,
+                 aging_bump: int = 1 << 20):
         self.dispatch_fn = dispatch_fn
         self.max_batch = int(max_batch)
         self.batch_timeout = float(batch_timeout_ms) / 1000.0
         self.max_queue = int(max_queue)
+        # deadline aging: once less than `aging_fraction` of a request's
+        # deadline budget remains, its effective priority jumps by
+        # `aging_bump` (default: above any sane client priority) so it
+        # seeds the next dispatch instead of starving behind a continuous
+        # high-priority stream
+        self.aging_fraction = float(aging_fraction)
+        self.aging_bump = int(aging_bump)
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self._pending: List[_Request] = []
         self._cond = threading.Condition()
@@ -107,10 +123,12 @@ class ContinuousBatcher:
         with self._cond:
             if self._stop or self._draining:
                 self.metrics.rejected.inc()
+                self.metrics.record_shed(req.priority, "rejected")
                 raise RejectedError(
                     "batcher is shut down; no new requests accepted")
             if len(self._pending) >= self.max_queue:
                 self.metrics.rejected.inc()
+                self.metrics.record_shed(req.priority, "rejected")
                 raise RejectedError(
                     f"request queue full ({self.max_queue} pending); "
                     "load shed — back off and retry")
@@ -141,6 +159,18 @@ class ContinuousBatcher:
         return None if since is None else time.monotonic() - since
 
     # ---- worker side ----
+    def _effective_priority(self, r: _Request, now: float) -> int:
+        """Client priority plus the deadline-aging bump: once less than
+        `aging_fraction` of the request's deadline budget remains, it
+        escalates above normal traffic so it dispatches (or expires with
+        a counted shed) instead of starving in place."""
+        if r.deadline is None:
+            return r.priority
+        budget = max(r.deadline - r.enqueued, 1e-9)
+        if (r.deadline - now) <= self.aging_fraction * budget:
+            return r.priority + self.aging_bump
+        return r.priority
+
     def _expire_locked(self) -> None:
         """Fail and drop past-deadline requests (caller holds the lock)."""
         now = time.monotonic()
@@ -148,6 +178,7 @@ class ContinuousBatcher:
         for r in self._pending:
             if r.deadline is not None and now > r.deadline:
                 self.metrics.expired.inc()
+                self.metrics.record_shed(r.priority, "expired")
                 r.future.set_exception(DeadlineExceededError(
                     f"deadline passed after "
                     f"{(now - r.enqueued) * 1000:.1f} ms in queue"))
@@ -167,8 +198,12 @@ class ContinuousBatcher:
             self._expire_locked()
             if not self._pending:
                 return []
-            # highest priority first, FIFO within a priority level
-            self._pending.sort(key=lambda r: (-r.priority, r.enqueued))
+            # highest effective priority first (client priority + aging
+            # bump near deadline), FIFO within a level
+            now = time.monotonic()
+            self._pending.sort(
+                key=lambda r: (-self._effective_priority(r, now),
+                               r.enqueued))
             group = self._pending[0].group
             window_end = time.monotonic() + self.batch_timeout
             while True:

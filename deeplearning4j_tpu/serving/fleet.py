@@ -1,0 +1,903 @@
+"""Multi-model serving fleet: SLO-aware routing, mesh-slice replica
+groups, and a warm-pool with LRU eviction.
+
+Everything below one `ModelServer` existed already — registry, bucketed
+AOT compile cache, continuous batcher, health probes, persistent
+executable store.  This module is the layer *above* it, the ROADMAP's
+"millions of users" posture: one pod hosting a long tail of models that
+do not all fit resident at once, routed by latency SLO.
+
+    ModelFleet
+      ├── FleetMember per model: LatencySLO + SLOTracker + replica group
+      ├── FleetRouter     admission (shed lowest priority first under
+      │                   sustained SLO breach) + least-loaded replica pick
+      ├── WarmPool        at most `max_resident` models device-resident;
+      │                   LRU eviction = drain batcher → drop executables
+      │                   and device params; the host-side registry entry
+      │                   and the persistent AOT cache survive, so
+      │                   re-admission deserializes instead of recompiling
+      │                   (TVM's shippable-compiled-artifact model,
+      │                   arXiv 1802.04799)
+      └── FleetController reconcile loop: grows a pressured member's
+                          replica group onto a free device slice (or one
+                          reclaimed from an idle donor), add-then-drain so
+                          rebalancing never drops an in-flight request
+
+Device slices: the fleet partitions its devices into fixed-size slices
+(`slice_size` devices each; a slice of >= 1 device carries a data-axis
+`Mesh` so dispatches run SPMD over the slice, exactly like a
+`ModelServer(mesh=...)`).  With no devices given, slices are virtual
+placement tokens — capacity accounting without pinning — which is also
+the single-device CPU test mode.  Packing many long-tail models onto
+shared accelerators is the cuDNN per-chip-throughput argument (arXiv
+1410.0759) applied at fleet granularity.
+
+Example — more models than fit resident:
+
+    fleet = ModelFleet(max_resident=4, cache_dir="/var/cache/dl4j-exec")
+    for name, net in long_tail:                  # e.g. 64 models
+        fleet.deploy(name, net, slo=LatencySLO(target_p99_ms=100.0,
+                                               priority=0))
+    fleet.deploy("ranker", ranker,
+                 slo=LatencySLO(target_p99_ms=20.0, priority=10))
+    y = fleet.output("model-17", x)    # admits on demand, LRU-evicts a
+                                       # cold model, warm-starts from the
+                                       # persistent AOT cache
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.monitor.instrument import FleetInstruments
+from deeplearning4j_tpu.monitor.registry import (Histogram, MetricsRegistry,
+                                                 registry)
+from deeplearning4j_tpu.serving.batcher import RejectedError
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.registry import ModelRegistry
+from deeplearning4j_tpu.serving.server import ModelServer
+from deeplearning4j_tpu.serving.slo import FleetPolicy, LatencySLO, SLOTracker
+
+# deprioritized traffic sorts below every sane client priority but far
+# above the batcher's aging bump floor, so near-deadline aging still wins
+DEPRIORITIZED_OFFSET = 1 << 18
+
+
+# ---------------------------------------------------------------------------
+# Device slices
+# ---------------------------------------------------------------------------
+
+class DeviceSlice:
+    """One placement unit: a fixed chunk of the fleet's devices (with a
+    lazily-built data-axis Mesh), or a virtual token when the fleet is
+    not device-pinned."""
+
+    def __init__(self, index: int,
+                 devices: Optional[Tuple[Any, ...]] = None):
+        self.index = int(index)
+        self.devices = tuple(devices) if devices else None
+        self._mesh = None
+
+    @property
+    def mesh(self):
+        if self.devices is None:
+            return None
+        if self._mesh is None:
+            from deeplearning4j_tpu.parallel.mesh import make_mesh
+            self._mesh = make_mesh({"data": len(self.devices)},
+                                   devices=list(self.devices))
+        return self._mesh
+
+    def describe(self) -> Dict[str, Any]:
+        return {"index": self.index,
+                "devices": ([str(d) for d in self.devices]
+                            if self.devices else None)}
+
+
+class Replica:
+    """One ModelServer pinned to one slice, serving one member."""
+
+    def __init__(self, name: str, server: ModelServer, slice_: DeviceSlice):
+        self.name = name
+        self.server = server
+        self.slice = slice_
+
+    @property
+    def queue_depth(self) -> int:
+        return self.server.batcher.queue_depth
+
+    def describe(self) -> Dict[str, Any]:
+        return {"name": self.name, "slice": self.slice.index,
+                "queue_depth": self.queue_depth}
+
+
+class ReplicaGroup:
+    """A member's replicas.  The list is only mutated under the fleet's
+    admission lock; the router reads an atomic snapshot, so a rebalance
+    (append / remove) never torn-reads against a route."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.replicas: List[Replica] = []
+        self._rr = itertools.count()
+
+    def snapshot(self) -> List[Replica]:
+        return list(self.replicas)
+
+    def queue_depth(self) -> int:
+        snap = self.snapshot()
+        return max((r.queue_depth for r in snap), default=0)
+
+    def drain(self, timeout: float = 10.0) -> None:
+        for r in self.snapshot():
+            r.server.shutdown(drain=True, timeout=timeout)
+
+    def describe(self) -> List[Dict[str, Any]]:
+        return [r.describe() for r in self.snapshot()]
+
+
+# ---------------------------------------------------------------------------
+# Fleet member
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class FleetMember:
+    """One model's fleet-level state (policy + residency + accounting)."""
+
+    name: str
+    slo: LatencySLO
+    tracker: SLOTracker
+    latency: Histogram                   # fleet_latency_ms{model=}
+    replicas_target: int = 1
+    schedule: Any = None                 # compile.Schedule or None
+    state: str = "cold"                  # cold | resident | evicting
+    group: Optional[ReplicaGroup] = None
+    last_used: float = 0.0               # monotonic
+    admissions: int = 0
+    evictions: int = 0
+    sheds: int = 0
+    deprioritized: int = 0
+    requests: int = 0
+    last_admission_fresh_compiles: Optional[int] = None
+    preferred_slices: List[int] = dataclasses.field(default_factory=list)
+    _obs: int = 0
+    _probe: int = 0
+
+    def describe(self, now: float) -> Dict[str, Any]:
+        return {
+            "state": self.state,
+            "priority": self.slo.priority,
+            "slo": self.tracker.snapshot(),
+            "replicas": self.group.describe() if self.group else [],
+            "replicas_target": self.replicas_target,
+            "requests": self.requests,
+            "admissions": self.admissions,
+            "evictions": self.evictions,
+            "sheds": self.sheds,
+            "deprioritized": self.deprioritized,
+            "last_admission_fresh_compiles":
+                self.last_admission_fresh_compiles,
+            "idle_s": (round(now - self.last_used, 3)
+                       if self.last_used else None),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Router
+# ---------------------------------------------------------------------------
+
+class FleetRouter:
+    """Admission control + replica pick.
+
+    Admission: compute the fleet's shed level — the highest priority among
+    members in *sustained* SLO breach.  Any member strictly below that
+    level is shed (or deprioritized, per `FleetPolicy.mode`) before
+    higher-priority traffic is touched; a breached member that is itself
+    outranked self-sheds too, but admits every `probe_every`-th request so
+    fresh latency samples can clear its breach.  The highest-priority
+    member is never shed by the router — relieving it is the controller's
+    job (grow its replica group).
+
+    Routing: least-loaded — the replica with the shallowest batcher queue,
+    round-robin among ties.
+    """
+
+    def __init__(self, fleet: "ModelFleet", policy: FleetPolicy,
+                 probe_every: int = 8):
+        self.fleet = fleet
+        self.policy = policy
+        self.probe_every = max(int(probe_every), 2)
+
+    # ---- admission ----
+    def shed_level(self) -> Optional[int]:
+        levels = [m.slo.priority for m in self.fleet.members()
+                  if m.tracker.breached]
+        return max(levels) if levels else None
+
+    def max_priority(self) -> int:
+        return max((m.slo.priority for m in self.fleet.members()),
+                   default=0)
+
+    def _refuse(self, member: FleetMember) -> int:
+        """Apply the policy to one refused request: count it, then either
+        raise (shed) or return the deprioritized batcher priority."""
+        if self.policy.mode == "shed":
+            member.sheds += 1
+            self.fleet.instruments.sheds(member.name,
+                                         member.slo.priority).inc()
+            raise RejectedError(
+                f"shed: fleet under sustained SLO pressure and "
+                f"'{member.name}' (priority {member.slo.priority}) is "
+                "below the protected level — back off and retry")
+        member.deprioritized += 1
+        return member.slo.priority - DEPRIORITIZED_OFFSET
+
+    def admission_priority(self, member: FleetMember) -> int:
+        """The batcher priority this request is admitted at; raises
+        `RejectedError` when the request is shed instead."""
+        level = self.shed_level()
+        if level is None:
+            return member.slo.priority
+        if member.slo.priority < level:
+            return self._refuse(member)
+        if member.tracker.breached and \
+                member.slo.priority < self.max_priority():
+            member._probe += 1
+            if member._probe % self.probe_every != 0:
+                return self._refuse(member)
+        return member.slo.priority
+
+    # ---- routing ----
+    def pick(self, member: FleetMember) -> Replica:
+        group = member.group
+        snap = group.snapshot() if group is not None else []
+        if not snap:
+            raise RejectedError(
+                f"'{member.name}' has no live replica (evicted mid-route)")
+        lo = min(r.queue_depth for r in snap)
+        ties = [r for r in snap if r.queue_depth == lo]
+        return ties[next(group._rr) % len(ties)]
+
+
+# ---------------------------------------------------------------------------
+# Warm pool
+# ---------------------------------------------------------------------------
+
+class WarmPool:
+    """At most `max_resident` models hold device residency; the rest stay
+    host-side (registry entry + persistent AOT cache) and admit on demand,
+    evicting the least-recently-used resident model to make room.
+
+    Eviction sequence (under the registry's per-name version lock, so a
+    concurrent zero-downtime roll can never be torn down mid-promotion):
+    drain the member's batchers (every in-flight Future resolves), drop
+    the in-memory executables, pull params/state of every registered
+    version back to host numpy.  Re-admission rebuilds the servers and
+    re-warms every bucket — from the shared persistent executable cache
+    when one is configured, i.e. deserialization, not recompilation.
+    """
+
+    def __init__(self, fleet: "ModelFleet", max_resident: int):
+        if max_resident < 1:
+            raise ValueError(f"max_resident must be >= 1, got {max_resident}")
+        self.fleet = fleet
+        self.max_resident = int(max_resident)
+        self._resident: List[FleetMember] = []   # admission order
+
+    def resident(self) -> List[FleetMember]:
+        return list(self._resident)
+
+    def resident_names(self) -> List[str]:
+        return [m.name for m in self._resident]
+
+    # ---- admission ----
+    def ensure_resident(self, member: FleetMember) -> None:
+        if member.state == "resident":          # lock-free fast path
+            return
+        fleet = self.fleet
+        with fleet._admission_lock:
+            if member.state == "resident":
+                return
+            need = member.replicas_target
+            while (len(self._resident) >= self.max_resident
+                   or len(fleet._free_slices) < need):
+                victim = self._lru_victim(member)
+                if victim is None:
+                    raise RejectedError(
+                        f"fleet at capacity: cannot admit '{member.name}' "
+                        f"({len(self._resident)}/{self.max_resident} "
+                        "resident, nothing evictable)")
+                self.evict(victim, reason="lru")
+            self._admit(member)
+
+    def _lru_victim(self, admitting: FleetMember) -> Optional[FleetMember]:
+        candidates = [m for m in self._resident if m is not admitting]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda m: m.last_used)
+
+    def _admit(self, member: FleetMember) -> None:
+        """Caller holds the admission lock."""
+        fleet = self.fleet
+        cache = fleet.cache
+        before = cache.stats["compiles"] if cache is not None else None
+        group = ReplicaGroup(member.name)
+        for _ in range(member.replicas_target):
+            slice_ = fleet._take_slice(member.preferred_slices)
+            group.replicas.append(fleet._build_replica(member, slice_))
+        member.preferred_slices = []
+        member.group = group
+        member.state = "resident"
+        member.admissions += 1
+        member.last_used = time.monotonic()
+        self._resident.append(member)
+        fresh = (cache.stats["compiles"] - before
+                 if cache is not None else None)
+        member.last_admission_fresh_compiles = fresh
+        fleet.instruments.record_admission(
+            warm=cache is not None and fresh == 0)
+        fleet.instruments.resident.set(len(self._resident))
+        fleet._note_resident_bytes()
+
+    # ---- eviction ----
+    def evict(self, member: FleetMember, reason: str = "manual") -> bool:
+        """Drain + drop one resident member.  Caller holds the admission
+        lock (`ModelFleet.evict` is the public wrapper).  Returns False
+        when the member is not resident (already evicted / cold)."""
+        fleet = self.fleet
+        if member.state != "resident":
+            return False
+        # per-name version lock: serialize against a concurrent roll
+        # promoting a new version of this very model
+        with fleet.registry.name_lock(member.name):
+            member.state = "evicting"
+            group, member.group = member.group, None
+            try:
+                group.drain()                    # in-flight futures resolve
+            finally:
+                for r in group.snapshot():
+                    r.server.cache.invalidate()
+                    member.preferred_slices.append(r.slice.index)
+                    fleet._return_slice(r.slice)
+                for entry in fleet.registry.entries(member.name):
+                    _to_host(entry.model)
+                member.state = "cold"
+                member.evictions += 1
+                if member in self._resident:
+                    self._resident.remove(member)
+        fleet.instruments.evictions.inc()
+        fleet.instruments.resident.set(len(self._resident))
+        return True
+
+
+def _to_host(model) -> None:
+    """Pull a model's device buffers back to host numpy so the device
+    allocator reclaims them (the registry entry stays fully usable — the
+    next placement re-uploads)."""
+    import jax
+    for attr in ("params_", "state_"):
+        tree = getattr(model, attr, None)
+        if tree is not None:
+            setattr(model, attr,
+                    jax.tree_util.tree_map(lambda a: np.asarray(a), tree))
+
+
+# ---------------------------------------------------------------------------
+# Controller
+# ---------------------------------------------------------------------------
+
+class FleetController:
+    """Reconcile loop: observe SLO trackers, then reallocate device
+    slices between replica groups as pressure shifts.
+
+    One action per tick, zero-downtime ordering: a pressured member first
+    *gains* a replica (built and bucket-warmed from the persistent cache
+    before it joins routing); a donor replica is removed from its group's
+    routing list *before* it drains, so every request already queued on it
+    still resolves.  Donors are idle members with more replicas than their
+    floor; a member never drops below one replica while resident.
+    """
+
+    def __init__(self, fleet: "ModelFleet", interval_s: Optional[float]
+                 = None):
+        self.fleet = fleet
+        self.interval_s = interval_s
+        self.history: List[Dict[str, Any]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle ----
+    def start(self) -> "FleetController":
+        if self._thread is None and self.interval_s:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="fleet-controller")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.reconcile()
+            except Exception:       # reconcile must never kill the loop
+                pass
+
+    # ---- one reconcile pass ----
+    def reconcile(self) -> Dict[str, Any]:
+        fleet = self.fleet
+        policy = fleet.policy
+        fleet.observe_slo()
+        actions: List[Dict[str, Any]] = []
+        now = time.monotonic()
+        with fleet._admission_lock:
+            resident = fleet.pool.resident()
+            pressured = [m for m in resident
+                         if m.tracker.breached
+                         or m.group.queue_depth() >= policy.grow_at_queue]
+            # grow the most important pressured member first
+            pressured.sort(key=lambda m: (-m.slo.priority,
+                                          -m.group.queue_depth()))
+            for m in pressured:
+                slice_ = self._free_or_reclaimed_slice(m, resident, actions)
+                if slice_ is None:
+                    break
+                m.group.replicas.append(fleet._build_replica(m, slice_))
+                fleet.instruments.rebalances.inc()
+                actions.append({"action": "grow", "model": m.name,
+                                "slice": slice_.index,
+                                "replicas": len(m.group.replicas)})
+                break                       # one reallocation per tick
+            if not actions:
+                # no pressure: shrink a long-idle member back to its floor
+                for m in resident:
+                    if (len(m.group.replicas) > m.replicas_target
+                            and m.group.queue_depth() == 0
+                            and not m.tracker.breached
+                            and now - m.last_used
+                            > policy.shrink_idle_after_s):
+                        self._remove_replica(m, actions, why="idle")
+                        break
+        record = {"at": time.time(), "actions": actions}
+        self.history.append(record)
+        if len(self.history) > 256:
+            del self.history[:-256]
+        return record
+
+    def _free_or_reclaimed_slice(self, needy: FleetMember,
+                                 resident: List[FleetMember],
+                                 actions: List[Dict[str, Any]]
+                                 ) -> Optional[DeviceSlice]:
+        fleet = self.fleet
+        if fleet._free_slices:
+            return fleet._take_slice(needy.preferred_slices)
+        donors = [m for m in resident
+                  if m is not needy and len(m.group.replicas) > 1
+                  and not m.tracker.breached
+                  and m.group.queue_depth() == 0
+                  and m.slo.priority <= needy.slo.priority]
+        if not donors:
+            return None
+        donor = min(donors, key=lambda m: m.last_used)
+        self._remove_replica(donor, actions, why="reclaimed")
+        return fleet._take_slice(needy.preferred_slices) \
+            if fleet._free_slices else None
+
+    def _remove_replica(self, member: FleetMember,
+                        actions: List[Dict[str, Any]], why: str) -> None:
+        """Caller holds the admission lock.  Remove-from-routing first,
+        then drain: queued requests on the leaving replica still answer."""
+        fleet = self.fleet
+        replica = member.group.replicas.pop()    # router stops picking it
+        replica.server.shutdown(drain=True)      # in-flight resolve
+        replica.server.cache.invalidate()
+        fleet._return_slice(replica.slice)
+        fleet.instruments.rebalances.inc()
+        actions.append({"action": "shrink", "model": member.name,
+                        "slice": replica.slice.index, "why": why,
+                        "replicas": len(member.group.replicas)})
+
+
+# ---------------------------------------------------------------------------
+# The fleet
+# ---------------------------------------------------------------------------
+
+class ModelFleet:
+    """N models, one pod: SLO-routed, warm-pooled, slice-scheduled.
+
+    Construction knobs:
+
+    * `max_resident` — warm-pool capacity (models device-resident at
+      once).  Deploy as many models as you like; the long tail pages in
+      and out through the persistent executable cache.
+    * `devices` / `slice_size` — pin replicas to fixed device slices of
+      `slice_size` devices each (SPMD over a per-slice mesh).  Default:
+      `n_slices` virtual placement tokens (2x `max_resident`), no pinning.
+    * `cache` / `cache_dir` — the shared persistent AOT executable store
+      (`compile.PersistentExecutableCache`); this is what turns
+      re-admission into deserialization.  Strongly recommended: without
+      it an eviction costs a recompile on the way back in.
+    * `slo` per `deploy()` — `LatencySLO(target_p99_ms, priority)`;
+      `policy` — `FleetPolicy` (breach hysteresis, shed vs deprioritize,
+      grow/shrink thresholds).
+    * `reconcile_interval_s` — run the `FleetController` loop in a
+      daemon thread (None: call `fleet.controller.reconcile()` yourself).
+    """
+
+    def __init__(self, max_resident: int = 4,
+                 devices: Optional[List[Any]] = None,
+                 slice_size: int = 1,
+                 n_slices: Optional[int] = None,
+                 max_batch: int = 32, batch_timeout_ms: float = 5.0,
+                 max_queue: int = 256, min_bucket: int = 1,
+                 data_axis: str = "data",
+                 cache=None, cache_dir: Optional[str] = None,
+                 schedules_dir: Optional[str] = None,
+                 warmup: bool = True,
+                 policy: Optional[FleetPolicy] = None,
+                 observe_every: int = 8,
+                 reconcile_interval_s: Optional[float] = None,
+                 registry_: Optional[MetricsRegistry] = None):
+        from deeplearning4j_tpu.compile import as_cache
+        self.registry = ModelRegistry()
+        self.policy = policy if policy is not None else FleetPolicy()
+        self.max_batch = int(max_batch)
+        self.batch_timeout_ms = float(batch_timeout_ms)
+        self.max_queue = int(max_queue)
+        self.min_bucket = int(min_bucket)
+        self.data_axis = data_axis
+        self.warmup = bool(warmup)
+        self.observe_every = max(int(observe_every), 1)
+        self.schedules_dir = schedules_dir
+        self.default_schedule = None
+        self.cache = as_cache(cache if cache is not None else cache_dir)
+        self._reg = registry_ if registry_ is not None else registry()
+        self.instruments = FleetInstruments(self._reg)
+        self._members: Dict[str, FleetMember] = {}
+        self._admission_lock = threading.RLock()
+        self._slices, self._free_slices = self._build_slices(
+            devices, slice_size, n_slices, max_resident)
+        self._closed = False
+        self._started = time.monotonic()
+        self._resident_bytes_peak = 0
+        self.pool = WarmPool(self, max_resident)
+        self.router = FleetRouter(self, self.policy)
+        self.controller = FleetController(
+            self, interval_s=reconcile_interval_s).start()
+
+    # ---- slices ----
+    @staticmethod
+    def _build_slices(devices, slice_size, n_slices, max_resident):
+        slices: List[DeviceSlice] = []
+        if devices:
+            size = max(int(slice_size), 1)
+            if len(devices) < size:
+                raise ValueError(
+                    f"slice_size={size} exceeds {len(devices)} devices")
+            for i in range(len(devices) // size):
+                slices.append(DeviceSlice(
+                    i, tuple(devices[i * size:(i + 1) * size])))
+        else:
+            n = n_slices if n_slices is not None else 2 * max_resident
+            slices = [DeviceSlice(i) for i in range(max(int(n), 1))]
+        return slices, [s.index for s in slices]
+
+    def _take_slice(self, preferred: Optional[List[int]] = None
+                    ) -> DeviceSlice:
+        """Caller holds the admission lock.  Prefer a member's previous
+        slices: on device-pinned fleets the persistent-cache key includes
+        the mesh fingerprint, so re-admission onto the same slice is the
+        zero-recompile path."""
+        for idx in preferred or ():
+            if idx in self._free_slices:
+                self._free_slices.remove(idx)
+                return self._slices[idx]
+        if not self._free_slices:
+            raise RejectedError("no free device slice")
+        return self._slices[self._free_slices.pop(0)]
+
+    def _return_slice(self, slice_: DeviceSlice) -> None:
+        if slice_.index not in self._free_slices:
+            self._free_slices.append(slice_.index)
+            self._free_slices.sort()
+
+    # ---- deployment ----
+    def members(self) -> List[FleetMember]:
+        return list(self._members.values())
+
+    def member(self, name: str) -> FleetMember:
+        m = self._members.get(name)
+        if m is None:
+            raise KeyError(
+                f"no model '{name}' deployed; have {sorted(self._members)}")
+        return m
+
+    def deploy(self, name: str, model=None, *, zoo: Optional[str] = None,
+               keras: Optional[str] = None, onnx=None,
+               slo: Optional[LatencySLO] = None,
+               replicas: int = 1, schedule=None,
+               input_shape: Optional[Tuple[int, ...]] = None,
+               warm: bool = False, **kwargs) -> FleetMember:
+        """Register one model with the fleet under its SLO.  Sources
+        mirror `ModelServer.deploy` (model / zoo / keras / onnx).  The
+        model becomes routable immediately but takes device residency
+        lazily on first traffic (or now, with `warm=True`).  A
+        per-model `compile.Schedule` — passed, loaded from
+        `schedules_dir` by name, or the fleet default — is applied to
+        every replica on admission (bucket-ladder reconfiguration)."""
+        if self._closed:
+            raise RejectedError("fleet is shut down")
+        if name in self._members:
+            raise ValueError(
+                f"model '{name}' already deployed; use roll() for a "
+                "zero-downtime version update")
+        sources = [s for s in (model, zoo, keras, onnx) if s is not None]
+        if len(sources) != 1:
+            raise ValueError(
+                "deploy() needs exactly one of: model=, zoo=, keras=, onnx=")
+        if model is not None:
+            self.registry.register(name, model, input_shape=input_shape,
+                                   **kwargs)
+        elif zoo is not None:
+            self.registry.register_zoo(name, zoo, **kwargs)
+        elif keras is not None:
+            self.registry.register_keras(name, keras, **kwargs)
+        else:
+            self.registry.register_onnx(name, onnx, **kwargs)
+        if schedule is None and self.schedules_dir:
+            from deeplearning4j_tpu.compile import load_schedule
+            schedule = load_schedule(self.schedules_dir, name=name)
+        if schedule is None:
+            schedule = self.default_schedule
+        slo = slo if slo is not None else LatencySLO()
+        member = FleetMember(
+            name=name, slo=slo,
+            tracker=SLOTracker(slo, breach_after=self.policy.breach_after,
+                               clear_after=self.policy.clear_after),
+            latency=self._reg.histogram(
+                "fleet_latency_ms",
+                help="end-to-end fleet request latency per model (ms)",
+                labels={"model": name}, maxlen=512),
+            replicas_target=max(int(replicas), 1), schedule=schedule)
+        self._members[name] = member
+        self.instruments.models.set(len(self._members))
+        if warm:
+            self.pool.ensure_resident(member)
+        return member
+
+    def roll(self, name: str, model, version: Optional[int] = None,
+             **kwargs):
+        """Zero-downtime version roll: register the new version under the
+        per-name version lock (serializing against a concurrent LRU
+        eviction of the same name), then pre-warm its executables on every
+        live replica.  In-flight requests finish on the version they
+        resolved; new submits pick up the new one."""
+        member = self.member(name)
+        with self.registry.name_lock(name):
+            entry = self.registry.register(name, model, version=version,
+                                           **kwargs)
+            group = member.group
+            if member.state == "resident" and group is not None \
+                    and self.warmup and entry.input_shape is not None:
+                for replica in group.snapshot():
+                    self.registry.warmup(name, replica.server.cache,
+                                         version=entry.version,
+                                         input_shape=entry.input_shape)
+        return entry
+
+    def evict(self, name: str, reason: str = "manual") -> bool:
+        """Manually evict one model from the warm pool (drain + drop)."""
+        member = self.member(name)
+        with self._admission_lock:
+            return self.pool.evict(member, reason=reason)
+
+    def set_default_schedule(self, schedule) -> "ModelFleet":
+        """Install a fleet-default `compile.Schedule`, applied on
+        admission to members that have no per-model schedule (the
+        `Schedule.apply(fleet)` hook)."""
+        self.default_schedule = schedule
+        return self
+
+    # ---- replica construction (admission lock held) ----
+    def _build_replica(self, member: FleetMember,
+                       slice_: DeviceSlice) -> Replica:
+        rname = f"{member.name}/r{slice_.index}"
+        metrics = ServingMetrics(window=512, server_label=rname,
+                                 model_label=member.name,
+                                 registry_=self._reg)
+        srv = ModelServer(
+            registry=self.registry, mesh=slice_.mesh,
+            data_axis=self.data_axis, max_batch=self.max_batch,
+            batch_timeout_ms=self.batch_timeout_ms,
+            max_queue=self.max_queue, min_bucket=self.min_bucket,
+            metrics=metrics, cache_dir=self.cache)
+        if member.schedule is not None:
+            member.schedule.apply(srv)
+        entry = self.registry.get(member.name)
+        if self.warmup and entry.input_shape is not None:
+            self.registry.warmup(member.name, srv.cache,
+                                 input_shape=entry.input_shape)
+        return Replica(rname, srv, slice_)
+
+    # ---- request path ----
+    def submit(self, name: str, x, priority: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> Future:
+        """Route one request: admission check (SLO shed ordering), warm-
+        pool admission if the model is cold (LRU-evicting as needed),
+        least-loaded replica pick, then the replica's continuous batcher.
+        Returns the request Future.  Raises `KeyError` (unknown model) or
+        `RejectedError` (shed / capacity)."""
+        if self._closed:
+            raise RejectedError("fleet is shut down")
+        member = self.member(name)
+        t0 = time.monotonic()
+        batch_priority = self.router.admission_priority(member)
+        if priority is not None:            # explicit caller override
+            batch_priority = int(priority)
+        dl = deadline_ms if deadline_ms is not None \
+            else member.slo.request_deadline_ms()
+        last_err: Optional[Exception] = None
+        for _ in range(2):              # retry once across an evict race
+            self.pool.ensure_resident(member)
+            member.last_used = time.monotonic()
+            try:
+                replica = self.router.pick(member)
+                fut = replica.server.submit(name, x,
+                                            priority=batch_priority,
+                                            deadline_ms=dl)
+                break
+            except RejectedError as e:
+                last_err = e
+                continue
+        else:
+            raise last_err if last_err is not None else RejectedError(
+                f"could not route '{name}'")
+        self.instruments.routing_ms.observe(
+            (time.monotonic() - t0) * 1000.0)
+        self.instruments.requests(name).inc()
+        member.requests += 1
+        fut.add_done_callback(self._make_observer(member, t0))
+        return fut
+
+    def output(self, name: str, x, priority: Optional[int] = None,
+               deadline_ms: Optional[float] = None,
+               timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking convenience form of `submit`."""
+        return self.submit(name, x, priority=priority,
+                           deadline_ms=deadline_ms).result(timeout=timeout)
+
+    def _make_observer(self, member: FleetMember, t0: float):
+        def _done(fut: Future) -> None:
+            if isinstance(fut.exception(), RejectedError):
+                return                      # never dispatched: no latency
+            member.latency.observe((time.monotonic() - t0) * 1000.0)
+            member._obs += 1
+            if member._obs % self.observe_every == 0:
+                self._observe_member(member)
+        return _done
+
+    # ---- SLO observation ----
+    def _observe_member(self, member: FleetMember) -> None:
+        p99 = member.latency.percentiles((99,))["p99"]
+        was = member.tracker.breached
+        now_breached = member.tracker.observe(p99)
+        if now_breached and not was:
+            self.instruments.breaches(member.name).inc()
+
+    def observe_slo(self) -> None:
+        """Feed every member's windowed p99 into its SLO tracker (the
+        reconcile loop calls this; submits also sample inline every
+        `observe_every` completions)."""
+        for member in self.members():
+            if member.latency.count:
+                self._observe_member(member)
+
+    # ---- accounting / observability ----
+    def resident_bytes(self) -> int:
+        """Device bytes held by resident models' params/state — the
+        memory the warm pool is budgeting (peak tracked across
+        admissions)."""
+        import jax
+        total = 0
+        for m in self.pool.resident():
+            for entry in self.registry.entries(m.name):
+                for tree in (getattr(entry.model, "params_", None),
+                             getattr(entry.model, "state_", None)):
+                    for leaf in jax.tree_util.tree_leaves(tree):
+                        total += getattr(leaf, "nbytes", 0) or 0
+        return total
+
+    def _note_resident_bytes(self) -> None:
+        try:
+            b = self.resident_bytes()
+        except Exception:
+            return
+        if b > self._resident_bytes_peak:
+            self._resident_bytes_peak = b
+
+    @property
+    def resident_bytes_peak(self) -> int:
+        return self._resident_bytes_peak
+
+    def fleet_stats(self) -> Dict[str, Any]:
+        """The `/fleet` JSON payload: per-model residency/SLO/accounting,
+        warm-pool occupancy, slice allocation, shed level, AOT-cache
+        stats, recent controller actions."""
+        now = time.monotonic()
+        return {
+            "models": {name: m.describe(now)
+                       for name, m in sorted(self._members.items())},
+            "resident": self.pool.resident_names(),
+            "capacity": {
+                "max_resident": self.pool.max_resident,
+                "slices_total": len(self._slices),
+                "slices_free": len(self._free_slices),
+                "slice_size": (len(self._slices[0].devices)
+                               if self._slices and self._slices[0].devices
+                               else 0),
+            },
+            "shed_level": self.router.shed_level(),
+            "policy": dataclasses.asdict(self.policy),
+            "resident_bytes": (self.resident_bytes()
+                               if self._members else 0),
+            "resident_bytes_peak": self._resident_bytes_peak,
+            "aot_cache": dict(self.cache.stats)
+            if self.cache is not None else None,
+            "recent_actions": [a for rec in self.controller.history[-8:]
+                               for a in rec["actions"]],
+            "uptime_s": now - self._started,
+        }
+
+    # ---- health ----
+    def healthz(self) -> dict:
+        return {"ok": True, "models": len(self._members),
+                "resident": len(self.pool.resident()),
+                "uptime_s": time.monotonic() - self._started}
+
+    def readyz(self) -> dict:
+        """Fleet-aware readiness: the fleet accepts traffic and every
+        *resident* replica's server is ready.  Cold members do not block
+        readiness — they admit on demand; an empty fleet is not ready
+        (nothing deployed ≠ serving)."""
+        reasons = []
+        if self._closed:
+            reasons.append("fleet is shut down")
+        if not self._members:
+            reasons.append("no models deployed")
+        for m in self.pool.resident():
+            group = m.group
+            for replica in (group.snapshot() if group else []):
+                r = replica.server.readyz()
+                if not r["ready"]:
+                    reasons.extend(
+                        f"{replica.name}: {why}" for why in r["reasons"])
+        return {"ready": not reasons, "reasons": reasons}
+
+    # ---- lifecycle ----
+    def shutdown(self, drain: bool = True, timeout: float = 10.0) -> None:
+        """Stop the controller, refuse new submits, drain every resident
+        replica so accepted Futures resolve.  Idempotent."""
+        self._closed = True
+        self.controller.stop()
+        with self._admission_lock:
+            for m in self.pool.resident():
+                group = m.group
+                if group is not None:
+                    for r in group.snapshot():
+                        r.server.shutdown(drain=drain, timeout=timeout)
+
+    def __enter__(self) -> "ModelFleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

@@ -72,18 +72,32 @@ class ServingMetrics:
     Exposed through `snapshot()` (a plain JSON-able dict), the UI server's
     `/serving` endpoint, `ui.stats.render_serving_html`, and — as labeled
     series in the shared registry — the Prometheus `/metrics` endpoint.
+
+    Label hygiene: pass an explicit `server_label` (replica identity) and
+    `model_label` (the model the replica serves) so a fleet of servers
+    lands on aggregatable `{server=, model=}` series instead of minting a
+    fresh process-local `server=sN` per instance.  Because the registry's
+    get-or-create returns the same child for the same (name, labels), a
+    re-registration under the same label pair (a warm re-admission
+    rebuilding a ModelServer) reuses the existing series — counters keep
+    accumulating, no duplicate family members appear.
     """
 
     _ids = itertools.count()
 
     def __init__(self, window: int = 4096,
                  registry_: Optional[MetricsRegistry] = None,
-                 server_label: Optional[str] = None):
+                 server_label: Optional[str] = None,
+                 model_label: Optional[str] = None):
         reg = registry_ if registry_ is not None else registry()
         self.registry = reg
         self.server_label = server_label if server_label is not None \
             else f"s{next(ServingMetrics._ids)}"
+        self.model_label = model_label
         lbl = {"server": self.server_label}
+        if model_label is not None:
+            lbl["model"] = model_label
+        self._base_labels = dict(lbl)
         self.latency = LatencyWindow(histogram=reg.histogram(
             "serving_latency_ms",
             help="end-to-end request latency, enqueue->result (ms)",
@@ -133,6 +147,7 @@ class ServingMetrics:
         self._queue_depth_peak = reg.gauge(
             "serving_queue_depth_peak", help="high-water mark of the "
             "batcher queue", labels=lbl)
+        self._sheds: Dict[tuple, object] = {}   # (priority, reason) children
 
     # ---- recording hooks (called by batcher / cache / server) ----
     def record_submit(self, queue_depth: int) -> None:
@@ -161,6 +176,31 @@ class ServingMetrics:
     def record_padding(self, rows: int) -> None:
         if rows:
             self._rows_padded.inc(rows)
+
+    def record_shed(self, priority: int, reason: str) -> None:
+        """One shed decision for a request of `priority` class:
+        `reason="rejected"` (refused at admission) or `"expired"`
+        (deadline passed in queue).  Lands on the labeled family
+        `serving_sheds_total{priority=,reason=}` so shed ordering across
+        priority classes is observable per server AND aggregatable per
+        model across a fleet."""
+        key = (int(priority), str(reason))
+        c = self._sheds.get(key)
+        if c is None:
+            c = self.registry.counter(
+                "serving_sheds_total",
+                help="requests shed (admission reject / deadline expiry) "
+                "by priority class",
+                labels=dict(self._base_labels, priority=str(key[0]),
+                            reason=key[1]))
+            self._sheds[key] = c
+        c.inc()
+
+    def sheds_by_priority(self) -> Dict[str, int]:
+        """{"<reason>:p<priority>": count} over this server's shed
+        decisions (snapshot view of the labeled family)."""
+        return {f"{reason}:p{prio}": c.value
+                for (prio, reason), c in sorted(self._sheds.items())}
 
     # ---- derived views ----
     @property
@@ -196,4 +236,5 @@ class ServingMetrics:
             "padding_fraction": (padded / (rows + padded)
                                  if rows + padded else 0.0),
             "compile_cache": self.cache.snapshot(),
+            "sheds": self.sheds_by_priority(),
         }

@@ -63,18 +63,39 @@ def infer_input_shape(model) -> Optional[Tuple[int, ...]]:
 
 
 class ModelRegistry:
-    """Thread-safe name → {version → ModelEntry} catalog."""
+    """Thread-safe name → {version → ModelEntry} catalog.
+
+    Besides the short internal lock guarding the catalog dicts, each name
+    has a re-entrant **version lock** (`name_lock(name)`) held across the
+    slower multi-step sequences that must not interleave per name: a
+    zero-downtime roll (register new version → warm → route) and a fleet
+    warm-pool eviction (drain batcher → drop device buffers).  Without it
+    an LRU eviction can tear down the very version a concurrent roll is
+    promoting; with it the two serialize per name while other names stay
+    unaffected."""
 
     def __init__(self):
         self._models: Dict[str, Dict[int, ModelEntry]] = {}
         self._lock = threading.Lock()
+        self._name_locks: Dict[str, threading.RLock] = {}
+
+    def name_lock(self, name: str) -> threading.RLock:
+        """The per-name version lock.  `register()` takes it internally;
+        hold it yourself around any drain/drop/promote sequence for
+        `name` (e.g. `with reg.name_lock("m"): ...evict...`) so rolls and
+        evictions of the same name serialize instead of racing."""
+        with self._lock:
+            lock = self._name_locks.get(name)
+            if lock is None:
+                lock = self._name_locks[name] = threading.RLock()
+            return lock
 
     # ---- registration ----
     def register(self, name: str, model, version: Optional[int] = None,
                  source: str = "direct",
                  input_shape: Optional[Tuple[int, ...]] = None,
                  input_dtype: str = "float32") -> ModelEntry:
-        with self._lock:
+        with self.name_lock(name), self._lock:
             versions = self._models.setdefault(name, {})
             if version is None:
                 version = max(versions) + 1 if versions else 1
@@ -146,9 +167,17 @@ class ModelRegistry:
         with self._lock:
             return sorted(self._models.get(name, {}))
 
+    def entries(self, name: str) -> List[ModelEntry]:
+        """Every registered ModelEntry for `name`, oldest version first
+        (empty when unknown) — the fleet eviction path walks these to
+        drop device buffers from all live versions."""
+        with self._lock:
+            versions = self._models.get(name, {})
+            return [versions[v] for v in sorted(versions)]
+
     def unregister(self, name: str, version: Optional[int] = None) -> None:
         """Remove one version (or the whole name)."""
-        with self._lock:
+        with self.name_lock(name), self._lock:
             if name not in self._models:
                 raise KeyError(f"no model '{name}' registered")
             if version is None:

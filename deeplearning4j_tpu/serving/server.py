@@ -61,6 +61,9 @@ class ModelServer:
         self.dispatch_retry_backoff_ms = float(dispatch_retry_backoff_ms)
         self.ready_stuck_threshold_s = float(ready_stuck_threshold_s)
         self._started = time.monotonic()
+        # `cache_dir` accepts a directory path OR an already-built
+        # compile.PersistentExecutableCache — a fleet passes one shared
+        # instance so every replica lands on the same on-disk store
         persistent = cache_dir      # as_cache also honors the env default
         self.cache = BucketedCompileCache(
             max_batch=max_batch, min_bucket=min_bucket, mesh=mesh,

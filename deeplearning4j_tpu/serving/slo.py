@@ -1,0 +1,132 @@
+"""SLO policy types for the serving fleet.
+
+A fleet hosts many models on one pod; what separates them operationally
+is not architecture but *contract*: how fast each model's p99 must be and
+who gets sacrificed when the pod cannot hold every contract at once.
+This module holds the policy vocabulary — `LatencySLO` (the per-model
+contract), `SLOTracker` (sustained-breach detection over the windowed p99
+the metrics registry already computes), and `FleetPolicy` (what the
+router/controller do about a breach) — kept separate from `fleet.py` so
+the mechanism and the policy stay independently testable.
+
+Shed ordering contract (the "millions of users" posture): when any
+member's SLO is in *sustained* breach, traffic for lower-priority models
+is shed (or deprioritized) before higher-priority models are touched; the
+highest-priority members are never shed by the router.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class LatencySLO:
+    """One model's latency contract.
+
+    `target_p99_ms` — the end-to-end (enqueue→result) p99 the model must
+    hold; compared against the sliding-window p99 from `ServingMetrics`.
+    `priority` — shed ordering, higher = more important: under sustained
+    breach the fleet sheds strictly-lower-priority traffic first.
+    `deadline_ms` — default per-request deadline applied by
+    `ModelFleet.submit` when the caller passes none (a queue-bound, so a
+    dead request never occupies a batch slot).
+    """
+
+    target_p99_ms: float = 200.0
+    priority: int = 0
+    deadline_ms: Optional[float] = None
+
+    def __post_init__(self):
+        if self.target_p99_ms <= 0:
+            raise ValueError(
+                f"target_p99_ms must be > 0, got {self.target_p99_ms}")
+        if self.deadline_ms is not None and self.deadline_ms <= 0:
+            raise ValueError(
+                f"deadline_ms must be > 0 or None, got {self.deadline_ms}")
+
+    def request_deadline_ms(self) -> Optional[float]:
+        """The deadline stamped on a request with no explicit one: the
+        configured `deadline_ms`, else 4x the p99 target (past that the
+        answer is an SLO miss anyway — better to fail fast and count a
+        shed than to serve a corpse)."""
+        if self.deadline_ms is not None:
+            return self.deadline_ms
+        return 4.0 * self.target_p99_ms
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetPolicy:
+    """What the router/controller do about SLO pressure.
+
+    `breach_after` / `clear_after` — consecutive p99 observations over /
+    under target before a member flips into / out of sustained breach
+    (hysteresis: one slow dispatch must not trigger fleet-wide shedding).
+    `mode` — `"shed"` rejects low-priority submits with `RejectedError`
+    while pressure lasts; `"deprioritize"` admits them at the batcher's
+    floor priority instead (they still run, last).
+    `grow_at_queue` — reconcile grows a member's replica group when its
+    deepest replica queue reaches this.
+    `shrink_idle_after_s` — reconcile reclaims a slice from a member
+    whose group has been idle (zero queue, no breach) this long.
+    """
+
+    breach_after: int = 3
+    clear_after: int = 3
+    mode: str = "shed"                      # shed | deprioritize
+    grow_at_queue: int = 8
+    shrink_idle_after_s: float = 30.0
+
+    def __post_init__(self):
+        if self.mode not in ("shed", "deprioritize"):
+            raise ValueError(
+                f"mode must be 'shed' or 'deprioritize', got {self.mode!r}")
+        if self.breach_after < 1 or self.clear_after < 1:
+            raise ValueError("breach_after/clear_after must be >= 1")
+
+
+class SLOTracker:
+    """Sustained-breach state machine over windowed p99 observations.
+
+    `observe(p99_ms)` feeds one measurement (NaN — empty latency window —
+    counts as healthy: a model nobody queries breaches nothing) and
+    returns the current sustained-breach state.  Flips to breached after
+    `breach_after` consecutive over-target observations, back to clear
+    after `clear_after` consecutive under-target ones — hysteresis in
+    both directions so routing decisions don't flap per dispatch."""
+
+    def __init__(self, slo: LatencySLO, breach_after: int = 3,
+                 clear_after: int = 3):
+        self.slo = slo
+        self.breach_after = int(breach_after)
+        self.clear_after = int(clear_after)
+        self.breached = False
+        self.breaches_total = 0          # sustained-breach onsets
+        self.last_p99_ms: Optional[float] = None
+        self._over = 0
+        self._under = 0
+
+    def observe(self, p99_ms: float) -> bool:
+        self.last_p99_ms = p99_ms
+        over = p99_ms == p99_ms and p99_ms > self.slo.target_p99_ms
+        if over:
+            self._over += 1
+            self._under = 0
+            if not self.breached and self._over >= self.breach_after:
+                self.breached = True
+                self.breaches_total += 1
+        else:
+            self._under += 1
+            self._over = 0
+            if self.breached and self._under >= self.clear_after:
+                self.breached = False
+        return self.breached
+
+    def snapshot(self) -> dict:
+        return {
+            "target_p99_ms": self.slo.target_p99_ms,
+            "priority": self.slo.priority,
+            "last_p99_ms": self.last_p99_ms,
+            "breached": self.breached,
+            "breaches_total": self.breaches_total,
+        }

@@ -34,6 +34,7 @@ class UIServer:
         self._storages: List[InMemoryStatsStorage] = []
         self._paths: List[str] = []
         self._serving: List = []          # serving.ServingMetrics sources
+        self._fleets: List = []           # serving.ModelFleet sources
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.refresh_seconds = 5
@@ -75,6 +76,26 @@ class UIServer:
         self._serving = [s for s in self._serving if s is not source]
         return self
 
+    def attach_fleet(self, fleet) -> "UIServer":
+        """Monitor a `serving.ModelFleet` (anything with `fleet_stats()`
+        and `readyz()`): exported as JSON at `/fleet`, and folded into the
+        aggregate `/readyz` — the pod is ready only when the fleet is."""
+        self._fleets.append(fleet)
+        return self
+
+    def detach_fleet(self, fleet) -> "UIServer":
+        self._fleets = [f for f in self._fleets if f is not fleet]
+        return self
+
+    def _fleet_snapshots(self) -> List[dict]:
+        out = []
+        for f in list(self._fleets):
+            try:
+                out.append(f.fleet_stats())
+            except Exception as e:      # a dead fleet must not 500 the UI
+                out.append({"error": repr(e)})
+        return out
+
     def _serving_snapshots(self) -> List[dict]:
         out = []
         for s in list(self._serving):
@@ -90,15 +111,18 @@ class UIServer:
         and rendering."""
         return {"ok": True,
                 "storages": len(self._storages) + len(self._paths),
-                "serving_sources": len(self._serving)}
+                "serving_sources": len(self._serving),
+                "fleets": len(self._fleets)}
 
     def readyz(self) -> dict:
         """Aggregate readiness for `GET /readyz`: every attached serving
-        source that exposes `readyz()` must report ready (a source that
-        raises counts as not ready).  With no sources attached the UI is
-        trivially ready — it only serves dashboards."""
+        source AND fleet that exposes `readyz()` must report ready (a
+        source that raises counts as not ready).  Fleet readiness is
+        residency-aware — cold fleet members admit on demand and do not
+        block the pod.  With no sources attached the UI is trivially
+        ready — it only serves dashboards."""
         sources, ready = [], True
-        for s in list(self._serving):
+        for s in list(self._serving) + list(self._fleets):
             fn = getattr(s, "readyz", None)
             if fn is None:
                 continue
@@ -167,6 +191,11 @@ class UIServer:
                 elif self.path.rstrip("/") == "/serving":
                     # machine-readable SLO metrics (scrape endpoint)
                     body = json.dumps(ui._serving_snapshots()).encode()
+                    ctype = "application/json"
+                elif self.path.rstrip("/") == "/fleet":
+                    # fleet topology: residency, per-model SLO state,
+                    # slice allocation, recent controller actions
+                    body = json.dumps(ui._fleet_snapshots()).encode()
                     ctype = "application/json"
                 elif self.path.rstrip("/") == "/healthz":
                     # liveness: this thread answered, so the server is up
