@@ -52,6 +52,15 @@ wire (gate: >=5x reduction), steps/sec, and end-of-run loss parity
 (gate: within 1%); detail to stderr + `BENCH_comms.json`, one stdout
 JSON line.
 
+`python bench.py --elastic [--quick]` A/Bs elastic gang survival: a
+3-process gang whose rank 2 is killed mid-run (heartbeat detection,
+generation-fenced re-formation at world 2, checkpoint-coordinated
+resume) against the same training uninterrupted — gates: detection
+within the failure deadline, resumed final loss matches an
+uninterrupted world-2 run from the same checkpoint, and the whole
+interruption inside the overhead budget; detail to stderr +
+`BENCH_elastic.json`, one stdout JSON line.
+
 `python bench.py --fleet [--quick]` A/Bs a long-tail model population
 through the warm-pooled `serving.ModelFleet` against the naive
 always-resident posture: models served per fixed device-memory budget
@@ -876,6 +885,124 @@ def main_comms(quick: bool):
         sys.exit(1)
 
 
+def bench_elastic(steps=24, kill_step=8, heartbeat_s=0.1,
+                  failure_deadline_s=2.0, overhead_budget_ms=15000.0):
+    """A/B elastic gang survival: a 3-process gang whose rank 2 is killed
+    mid-run (shrink-and-continue) vs the same training uninterrupted.
+
+    Three runs: (A) 3-proc gang with a mid-run kill — the survivors must
+    detect within the failure deadline, re-form at world 2 under a new
+    generation, and resume from the coordinated checkpoint; (B) a clean
+    world-2 gang started from THAT checkpoint — A's final loss must match
+    it (nothing lost or double-counted across the reformation); (C) a
+    clean 3-proc run of the same length, the wall-clock baseline the
+    reformation overhead is reported against."""
+    import os
+    import shutil
+    import tempfile
+    from deeplearning4j_tpu.parallel.multihost import ElasticLocalRunner
+    from deeplearning4j_tpu.train.resilience import CheckpointManager
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tests", "mh_worker_elastic_gang.py")
+
+    def run(tag, td, procs, kill_rank, kill_at, ckpt_dir):
+        out_dir = os.path.join(td, f"out_{tag}")
+        os.makedirs(out_dir)
+        t0 = time.time()
+        res = ElasticLocalRunner(procs, backoff_base_s=0.2).run_elastic(
+            worker, [out_dir, str(steps), "1", str(kill_rank), str(kill_at)],
+            timeout=600.0, checkpoint_dir=ckpt_dir, policy="shrink",
+            heartbeat_s=heartbeat_s, failure_deadline_s=failure_deadline_s,
+            relaunch=False)
+        wall = time.time() - t0
+        if res["r0"][0] != 0:
+            raise RuntimeError(f"{tag}: rank 0 failed:\n"
+                               + res["r0"][1][-2000:])
+        final = np.load(os.path.join(out_dir, "final_0.npz"))
+        with open(os.path.join(out_dir, "elastic_0.json")) as f:
+            info = json.load(f)
+        return wall, final, info
+
+    with tempfile.TemporaryDirectory() as td:
+        ckpt_a = os.path.join(td, "ckpt_a")
+        wall_a, final_a, info_a = run("a", td, 3, 2, kill_step, ckpt_a)
+        reforms = info_a["reformations"]
+        if len(reforms) != 1:
+            raise RuntimeError(f"expected 1 reformation, got {reforms}")
+        rf = reforms[0]
+        # B: uninterrupted world-2 comparator from the resume checkpoint
+        ckpt_b = os.path.join(td, "ckpt_b")
+        shutil.copytree(ckpt_a, ckpt_b)
+        for name in os.listdir(ckpt_b):
+            p = os.path.join(ckpt_b, name)
+            if os.path.isdir(p) and name.startswith(CheckpointManager.PREFIX) \
+                    and int(name[len(CheckpointManager.PREFIX):]) \
+                    > int(rf["resume_step"]):
+                shutil.rmtree(p)
+        _, final_b, _ = run("b", td, 2, -1, 0, ckpt_b)
+        # C: clean 3-proc baseline for the wall-clock overhead
+        wall_c, _, _ = run("c", td, 3, -1, 0, os.path.join(td, "ckpt_c"))
+    loss_a, loss_b = float(final_a["score"]), float(final_b["score"])
+    loss_delta_rel = abs(loss_a - loss_b) / max(abs(loss_b), 1e-12)
+    return {
+        "steps": steps, "kill_step": kill_step,
+        "heartbeat_s": heartbeat_s,
+        "failure_deadline_s": failure_deadline_s,
+        "cause": rf["cause"], "world_after": rf["world"],
+        "generation_after": info_a["stats"]["generation"],
+        "detection_ms": rf["detection_ms"],
+        "resume_ms": rf["resume_ms"],
+        "reformation_ms": rf["detection_ms"] + rf["resume_ms"],
+        "overhead_budget_ms": overhead_budget_ms,
+        "final_loss_chaos": loss_a,
+        "final_loss_uninterrupted": loss_b,
+        "loss_delta_rel": loss_delta_rel,
+        "wall_chaos_s": wall_a, "wall_clean_s": wall_c,
+        "wall_overhead_s": wall_a - wall_c,
+    }
+
+
+def main_elastic(quick: bool):
+    """`--elastic` mode: chaos A/B detail to stderr + BENCH_elastic.json,
+    ONE stdout JSON line.  Gates: failure detected within the configured
+    deadline (plus reactor slack), resumed final loss matches the
+    uninterrupted-from-checkpoint run, and the whole
+    detection-to-resumed interruption stays inside the overhead budget.
+    The gang runs on forced-CPU child processes, so no backend probe."""
+    import os
+    try:
+        r = (bench_elastic(steps=12, kill_step=4) if quick
+             else bench_elastic())
+    except Exception as e:
+        print(json.dumps({"metric": "elastic_reformation_ms",
+                          "value": None, "unit": "ms",
+                          "error": repr(e)[:300]}))
+        sys.exit(1)
+    for k, v in r.items():      # detail to stderr: stdout stays one line
+        print(f"[elastic] {k} = {v}", file=sys.stderr, flush=True)
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_elastic.json"), "w") as f:
+        json.dump(r, f, indent=2)
+    detect_ok = r["detection_ms"] is not None and \
+        r["detection_ms"] <= r["failure_deadline_s"] * 1000.0 + 2000.0
+    loss_ok = r["loss_delta_rel"] <= 1e-9       # bitwise in practice
+    overhead_ok = r["reformation_ms"] <= r["overhead_budget_ms"]
+    ok = detect_ok and loss_ok and overhead_ok
+    print(json.dumps({
+        "metric": "elastic_reformation_ms",
+        "value": round(r["reformation_ms"], 1),
+        "unit": "ms",
+        "detection_ms": round(r["detection_ms"], 1),
+        "resume_ms": round(r["resume_ms"], 1),
+        "loss_delta_rel": r["loss_delta_rel"],
+        "detect_ok": detect_ok, "loss_ok": loss_ok,
+        "overhead_ok": overhead_ok,
+        "pass": ok,
+    }))
+    if not ok:
+        sys.exit(1)
+
+
 def main_pipeline(quick: bool):
     """`--pipeline` mode: A/B detail to stderr, ONE stdout JSON line."""
     import os
@@ -1560,6 +1687,9 @@ def main():
         return
     if "--comms" in sys.argv:
         main_comms(quick)
+        return
+    if "--elastic" in sys.argv:
+        main_elastic(quick)
         return
     if "--resilience" in sys.argv:
         main_resilience(quick)

@@ -71,6 +71,23 @@ docs/observability.md):
                                      + replica pick; excludes admission
                                      warmup)
   fleet_rebalances_total             controller slice reallocations
+  fleet_replica_unhealthy_total      replicas removed from routing after
+                                     consecutive dispatch failures
+  fleet_replica_probes_total         requests routed to an unhealthy
+                                     replica as a recovery probe
+  gang_generation                    current gang membership generation
+  gang_members                       live ranks in the gradient-mesh gang
+  gang_reformations_total{cause=}    membership reformations (cause=crash|
+                                     partition|straggler|join)
+  gang_detection_ms                  silence observed on a peer when it
+                                     was declared lost (failure-detection
+                                     latency)
+  gang_resume_ms                     reform-to-training-resumed wall time
+                                     (rebuild + checkpoint restore +
+                                     iterator fast-forward)
+  gang_stale_frames_total            stale-generation data frames fenced
+                                     and dropped (never summed into
+                                     gradients)
 """
 from __future__ import annotations
 
@@ -355,6 +372,65 @@ class CommsInstruments:
         self.exchange_ms.observe(dt_s * 1000.0)
 
 
+class GangInstruments:
+    """Elastic gang-membership handles (parallel.transport elastic mesh +
+    train.resilience ElasticTrainer).  One unlabeled series set per
+    process — a process is exactly one gang member."""
+
+    def __init__(self, registry_: Optional[MetricsRegistry] = None):
+        reg = registry_ if registry_ is not None else registry()
+        self._reg = reg
+        self.generation = reg.gauge(
+            "gang_generation",
+            help="current membership generation of the gradient-mesh gang "
+            "(bumped by every reformation; stale-generation traffic is "
+            "fenced)")
+        self.members = reg.gauge(
+            "gang_members", help="live ranks in the gradient-mesh gang")
+        self.detection_ms = reg.histogram(
+            "gang_detection_ms",
+            help="silence observed on a peer at the moment it was declared "
+            "lost (ms) — the failure-detection latency the heartbeat "
+            "deadline bounds")
+        self.resume_ms = reg.histogram(
+            "gang_resume_ms",
+            help="wall time from catching a reformation to training "
+            "resumed: sharing rebuild + checkpoint restore + iterator "
+            "fast-forward (ms)")
+        self.stale_frames = reg.counter(
+            "gang_stale_frames_total",
+            help="stale-generation data frames fenced and dropped — "
+            "traffic from a previous membership generation that must "
+            "never be summed into gradients")
+        self._reformations: dict = {}
+
+    def reformations(self, cause: str):
+        c = self._reformations.get(cause)
+        if c is None:
+            c = self._reg.counter(
+                "gang_reformations_total",
+                help="gang membership reformations, by cause "
+                "(crash|partition|straggler|join)",
+                labels={"cause": cause})
+            self._reformations[cause] = c
+        return c
+
+    def record_membership(self, generation: int, members: int) -> None:
+        if not enabled():
+            return
+        self.generation.set(int(generation))
+        self.members.set(int(members))
+
+    def record_reform(self, cause: str, detection_ms: Optional[float],
+                      generation: int, members: int) -> None:
+        if not enabled():
+            return
+        self.reformations(cause).inc()
+        if detection_ms is not None:
+            self.detection_ms.observe(float(detection_ms))
+        self.record_membership(generation, members)
+
+
 class FleetInstruments:
     """Multi-model fleet handles (serving.fleet).  Per-model families are
     created lazily and memoized — a 64-model long-tail fleet touches each
@@ -388,6 +464,14 @@ class FleetInstruments:
             "fleet_routing_ms",
             help="router decision wall time: admission/shed check + "
             "least-loaded replica pick (ms; excludes admission warmup)")
+        self.replica_unhealthy = reg.counter(
+            "fleet_replica_unhealthy_total",
+            help="replicas removed from routing after consecutive "
+            "dispatch failures (the gang-heartbeat analog for serving)")
+        self.replica_probes = reg.counter(
+            "fleet_replica_probes_total",
+            help="requests deliberately routed to an unhealthy replica "
+            "as a recovery probe (one success restores routing)")
         self._requests: dict = {}
         self._sheds: dict = {}
         self._breaches: dict = {}
@@ -444,6 +528,15 @@ def aot_instruments() -> AotCacheInstruments:
 
 
 _comms: Optional[CommsInstruments] = None
+_gang: Optional[GangInstruments] = None
+
+
+def gang_instruments() -> GangInstruments:
+    """Process-wide gang handle bundle (lazy singleton)."""
+    global _gang
+    if _gang is None:
+        _gang = GangInstruments()
+    return _gang
 
 
 def comms_instruments() -> CommsInstruments:

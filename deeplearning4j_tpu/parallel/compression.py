@@ -76,6 +76,43 @@ class CompressedGradientExchange:
         sparse_bytes = sum(4 * (len(s) + 1) for s in streams)
         return dense_bytes / max(sparse_bytes, 1)
 
+    # ---- error-feedback residual management (elastic gang support) ----
+    def residuals(self) -> List[np.ndarray]:
+        """Per-leaf error-feedback residuals (live views, not copies)."""
+        return [c.residual for c in self.codecs]
+
+    def residual_norm(self) -> float:
+        """Total l2 mass currently parked in error-feedback residuals —
+        the gradient signal a membership change would strand."""
+        return float(np.sqrt(sum(float(np.dot(c.residual, c.residual))
+                                 for c in self.codecs)))
+
+    def reset_residuals(self) -> None:
+        """Zero the error-feedback state.  Used when a gang reformation
+        rewinds to a checkpoint: the parked residual was accumulated from
+        steps the rewind discards, so flushing it would double-count
+        gradient mass the resumed run will recompute."""
+        for c in self.codecs:
+            c.residual[:] = 0.0
+
+    def take_residuals(self) -> List[np.ndarray]:
+        """Detach and return the residuals, zeroing the codec state.  A
+        forward (non-rewind) membership change carries these into the
+        next exchange via `flush_into` so no gradient mass is silently
+        lost."""
+        out = [c.residual.copy() for c in self.codecs]
+        self.reset_residuals()
+        return out
+
+    def flush_into(self, residuals: List[np.ndarray]) -> None:
+        """Add previously taken residuals into this exchange's codecs so
+        the next encode emits them (shape-checked leafwise)."""
+        for c, r in zip(self.codecs, residuals):
+            if r.shape != c.residual.shape:
+                raise ValueError(
+                    f"residual shape {r.shape} != codec {c.residual.shape}")
+            c.residual += r.astype(np.float32, copy=False)
+
 
 def allreduce_compressed(exchange: CompressedGradientExchange,
                          transport, grads):

@@ -42,6 +42,9 @@ ENV_PID = "DL4J_TPU_PROCESS_ID"
 ENV_NPROC = "DL4J_TPU_NUM_PROCESSES"
 ENV_GRAD_PORT = "DL4J_TPU_GRADIENT_PORT"
 ENV_GRAD_HOST = "DL4J_TPU_GRADIENT_HOST"
+ENV_HEARTBEAT = "DL4J_TPU_HEARTBEAT_S"
+ENV_DEADLINE = "DL4J_TPU_FAILURE_DEADLINE_S"
+ENV_JOIN = "DL4J_TPU_JOIN"
 
 PyTree = Any
 
@@ -49,6 +52,18 @@ PyTree = Any
 def _env_int(name: str, default: int) -> int:
     v = os.environ.get(name)
     return default if v in (None, "") else int(v)
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return default if v in (None, "") else float(v)
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v in (None, ""):
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -74,6 +89,12 @@ class HierarchicalGradientSharing:
     port: Optional[int] = None        # default: env, else 49152
     host: Optional[str] = None        # default: env, else 127.0.0.1
     timeout: float = 60.0
+    # elastic gang membership (PR 9): heartbeat failure detection +
+    # generation-fenced reformation instead of fail-stop
+    elastic: bool = False
+    heartbeat_interval_s: Optional[float] = None   # env, else 0.25
+    failure_deadline_s: Optional[float] = None     # env, else 5.0
+    join: Optional[bool] = None       # env DL4J_TPU_JOIN, else False
 
     def __post_init__(self):
         if self.combine not in ("mean", "sum"):
@@ -81,7 +102,8 @@ class HierarchicalGradientSharing:
                              f"got {self.combine!r}")
 
     def resolve(self) -> "HierarchicalGradientSharing":
-        """Fill rank/world/port/host from the launcher env."""
+        """Fill rank/world/port/host (and the elastic knobs) from the
+        launcher env."""
         return dataclasses.replace(
             self,
             rank=self.rank if self.rank is not None
@@ -91,7 +113,15 @@ class HierarchicalGradientSharing:
             port=self.port if self.port is not None
             else _env_int(ENV_GRAD_PORT, 49152),
             host=self.host if self.host is not None
-            else os.environ.get(ENV_GRAD_HOST, "127.0.0.1"))
+            else os.environ.get(ENV_GRAD_HOST, "127.0.0.1"),
+            heartbeat_interval_s=self.heartbeat_interval_s
+            if self.heartbeat_interval_s is not None
+            else _env_float(ENV_HEARTBEAT, 0.25),
+            failure_deadline_s=self.failure_deadline_s
+            if self.failure_deadline_s is not None
+            else _env_float(ENV_DEADLINE, 5.0),
+            join=self.join if self.join is not None
+            else _env_bool(ENV_JOIN, False))
 
 
 class HierarchicalAllReduce:
@@ -108,39 +138,107 @@ class HierarchicalAllReduce:
     def __init__(self, config: HierarchicalGradientSharing):
         self.config = config.resolve()
         self._exchange = None          # CompressedGradientExchange
-        self._mesh = None              # TcpGradientMesh
+        self._mesh = None              # TcpGradientMesh | ElasticGradientMesh
         self._ready = False
         self._instr = None
+        self._template = None          # gradient tree shape template
+        self._resume_step_provider = None
         self._last_wire_bytes = 0
         self._last_ratio = 1.0
         self.exchanges = 0
 
     @property
     def rank(self) -> int:
-        return self.config.rank
+        # elastic reformation can remap the rank in place
+        return self._mesh.rank if self._mesh is not None \
+            else self.config.rank
 
     @property
     def world(self) -> int:
-        return self.config.world
+        return self._mesh.world if self._mesh is not None \
+            else self.config.world
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def set_resume_step_provider(self, fn) -> None:
+        """Coordinator-side callable returning the checkpoint step every
+        member must resume from after a reformation (wired by
+        ElasticTrainer to `CheckpointManager.latest_step`)."""
+        self._resume_step_provider = fn
+        if self._mesh is not None and hasattr(self._mesh,
+                                              "resume_step_provider"):
+            self._mesh.resume_step_provider = fn
 
     def _ensure(self, grads: PyTree) -> None:
         if self._ready:
             return
         from deeplearning4j_tpu.monitor.instrument import comms_instruments
         self._instr = comms_instruments()
+        self._template = jax.tree_util.tree_map(
+            lambda g: np.zeros(np.shape(g), np.float32), grads)
         if self.config.compressed:
-            from deeplearning4j_tpu.parallel.compression import (
-                CompressedGradientExchange)
-            self._exchange = CompressedGradientExchange(
-                grads, threshold=self.config.threshold,
-                adaptive_target_density=self.config.adaptive_target_density)
-        if self.config.world > 1:
+            self._build_exchange()
+        if self.config.elastic:
+            from deeplearning4j_tpu.parallel.transport import (
+                ElasticGradientMesh, GangReformed)
+            self._mesh = ElasticGradientMesh(
+                rank=self.config.rank, world=self.config.world,
+                port=self.config.port, host=self.config.host,
+                timeout=self.config.timeout,
+                heartbeat_interval=self.config.heartbeat_interval_s,
+                failure_deadline=self.config.failure_deadline_s,
+                join=bool(self.config.join),
+                resume_step_provider=self._resume_step_provider)
+            if self.config.join and self._mesh.join_info is not None:
+                # a replacement worker learns its resume point only at
+                # admission — surface it as a reformation so the trainer
+                # restores the SAME checkpoint the survivors rewound to
+                # (the pre-join restore may be stale by now)
+                self._ready = True
+                raise GangReformed({
+                    "generation": self._mesh.generation,
+                    "world": self._mesh.world,
+                    "rank": self._mesh.rank,
+                    "rank_map": {self._mesh.rank: self._mesh.rank},
+                    "lost": [], "cause": "join",
+                    "resume_step": self._mesh.join_info.get(
+                        "resume_step", 0)})
+        elif self.config.world > 1:
             from deeplearning4j_tpu.parallel.transport import TcpGradientMesh
             self._mesh = TcpGradientMesh(
                 rank=self.config.rank, world=self.config.world,
                 port=self.config.port, host=self.config.host,
                 timeout=self.config.timeout)
         self._ready = True
+
+    def _build_exchange(self) -> None:
+        from deeplearning4j_tpu.parallel.compression import (
+            CompressedGradientExchange)
+        self._exchange = CompressedGradientExchange(
+            self._template, threshold=self.config.threshold,
+            adaptive_target_density=self.config.adaptive_target_density)
+
+    def rebuild(self, flush_residuals: bool = False) -> None:
+        """Reset codec state after a gang reformation.
+
+        Default (`flush_residuals=False`) builds FRESH codecs — zero
+        residuals, thresholds back at the configured start — which is
+        what checkpoint-rewind resume requires: the parked residual and
+        the adapted thresholds were accumulated from steps the rewind
+        discards, and every survivor resetting identically is what makes
+        the resumed run bitwise-match a clean run from that checkpoint.
+        `flush_residuals=True` instead carries the old error-feedback
+        mass into the new codecs (forward, non-rewind semantics — no
+        gradient silently lost when membership changes without a
+        rewind)."""
+        if self._template is None or not self.config.compressed:
+            return
+        old = self._exchange
+        self._build_exchange()
+        if flush_residuals and old is not None:
+            self._exchange.flush_into(old.residuals())
 
     def exchange(self, grads: PyTree) -> PyTree:
         """ICI-reduced gradient tree in, DCN-combined tree out (numpy
@@ -160,8 +258,9 @@ class HierarchicalAllReduce:
         if mesh is not None:
             self._last_wire_bytes = (mesh.bytes_sent + mesh.bytes_received
                                      - sent0)
-        if self.config.combine == "mean" and self.config.world > 1:
-            inv = np.float32(1.0 / self.config.world)
+        w = self.world                 # dynamic under elastic membership
+        if self.config.combine == "mean" and w > 1:
+            inv = np.float32(1.0 / w)
             total = jax.tree_util.tree_map(lambda a: a * inv, total)
         self.exchanges += 1
         self._instr.record_exchange(
@@ -198,12 +297,32 @@ class HierarchicalAllReduce:
         from deeplearning4j_tpu.parallel.compression import allreduce_dense
         return allreduce_dense(self._mesh, host_grads)
 
+    # ---- elastic joiner admission passthroughs (coordinator only) ----
+    def has_pending_joiner(self) -> bool:
+        return self._mesh is not None and \
+            getattr(self._mesh, "has_pending_joiner", lambda: False)()
+
+    def wait_for_joiner(self, timeout: float) -> bool:
+        if self._mesh is None or not hasattr(self._mesh,
+                                             "wait_for_joiner"):
+            return False
+        return self._mesh.wait_for_joiner(timeout)
+
+    def admit_joiners(self, resume_step: int):
+        """Admit parked replacement workers (bumps the generation; the
+        peers raise GangReformed).  Returns the reform info dict or None.
+        The caller (ElasticTrainer) rebuilds codecs and restores the
+        checkpoint inline on the coordinator."""
+        if self._mesh is None or not hasattr(self._mesh, "admit_joiners"):
+            return None
+        return self._mesh.admit_joiners(resume_step)
+
     def stats(self) -> dict:
         """Last-exchange numbers (what BENCH_comms.json aggregates)."""
         mesh = self._mesh
-        return {
-            "rank": self.config.rank,
-            "world": self.config.world,
+        out = {
+            "rank": self.rank,
+            "world": self.world,
             "compressed": self.config.compressed,
             "exchanges": self.exchanges,
             "last_wire_bytes": self._last_wire_bytes,
@@ -211,6 +330,11 @@ class HierarchicalAllReduce:
             "bytes_sent_total": mesh.bytes_sent if mesh else 0,
             "bytes_received_total": mesh.bytes_received if mesh else 0,
         }
+        if self.config.elastic and mesh is not None:
+            out["generation"] = mesh.generation
+            out["reformations"] = mesh.reformations
+            out["stale_frames"] = mesh.stale_frames
+        return out
 
     def close(self) -> None:
         if self._mesh is not None:

@@ -21,10 +21,13 @@ with its own XLA CPU client, forming one global device mesh over the
 from __future__ import annotations
 
 import os
+import random
 import socket
 import subprocess
 import sys
-from typing import Dict, List, Optional, Sequence
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -114,10 +117,28 @@ def allgather_params(tree):
 # localhost launcher (SURVEY §4: "multi-node without a cluster")
 # ---------------------------------------------------------------------------
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+def free_port(max_tries: int = 16) -> int:
+    """Pick a currently-free localhost port.
+
+    The OS can hand the probed port to another process between the probe
+    socket closing and the caller's bind — so verify the port is still
+    bindable with a second bind and re-probe when it is not, instead of
+    letting the caller's server raise EADDRINUSE."""
+    last_err: Optional[OSError] = None
+    for _ in range(max_tries):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        try:
+            with socket.socket() as v:
+                v.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                v.bind(("127.0.0.1", port))
+            return port
+        except OSError as e:
+            last_err = e
+    raise OSError(
+        f"free_port: no bindable port after {max_tries} probes"
+    ) from last_err
 
 
 def child_env(coordinator: str, num_processes: int, process_id: int,
@@ -164,7 +185,8 @@ class ElasticLocalRunner:
 
     def __init__(self, num_processes: int, devices_per_process: int = 1,
                  platform: str = "cpu", max_restarts: int = 2,
-                 backoff_base_s: float = 1.0, backoff_cap_s: float = 30.0):
+                 backoff_base_s: float = 1.0, backoff_cap_s: float = 30.0,
+                 jitter_seed: Optional[int] = None):
         self.num_processes = num_processes
         self.devices_per_process = devices_per_process
         self.platform = platform
@@ -172,6 +194,10 @@ class ElasticLocalRunner:
         self.restarts = 0
         self.backoff_base_s = backoff_base_s
         self.backoff_cap_s = backoff_cap_s
+        # decorrelated-jitter state: a seeded PRNG (NOT wall-clock) so
+        # tests are deterministic while real fleets still spread out
+        self._rng = random.Random(jitter_seed)
+        self._prev_backoff: Optional[float] = None
         # (attempt, kind, message-tail) per failure — kind in
         # crash | hang | peer-loss (see _classify_failure)
         self.failure_history: List[tuple] = []
@@ -197,9 +223,20 @@ class ElasticLocalRunner:
         return "crash"
 
     def backoff_s(self, attempt: int) -> float:
-        """Exponential backoff before restart `attempt` (1-based)."""
-        return min(self.backoff_base_s * (2 ** (attempt - 1)),
-                   self.backoff_cap_s)
+        """Decorrelated-jitter backoff before restart `attempt`
+        (1-based): sleep ~ U(base, 3 * previous-sleep), capped.  Unlike
+        plain exponential, simultaneous relaunches on one host draw
+        different sleeps and stop thundering-herding the coordinator
+        port; the jitter PRNG is seeded (`jitter_seed`), so no
+        wall-clock dependence leaks into tests."""
+        if attempt <= 1 or self._prev_backoff is None:
+            self._prev_backoff = self.backoff_base_s
+            return self._prev_backoff
+        v = self._rng.uniform(
+            self.backoff_base_s,
+            max(self._prev_backoff * 3.0, self.backoff_base_s))
+        self._prev_backoff = min(v, self.backoff_cap_s)
+        return self._prev_backoff
 
     def run(self, script: str, args: Sequence[str] = (),
             timeout: float = 300.0,
@@ -246,6 +283,138 @@ class ElasticLocalRunner:
         raise RuntimeError(
             f"training failed after {self.max_restarts} restarts "
             f"(failure kinds: {kinds})") from last_error
+
+    # ------------------------------------------------------------------
+    # per-worker elastic supervision (gang survives member loss)
+    # ------------------------------------------------------------------
+    def run_elastic(self, script: str, args: Sequence[str] = (),
+                    timeout: float = 600.0,
+                    checkpoint_dir: Optional[str] = None,
+                    policy: str = "shrink",
+                    heartbeat_s: float = 0.25,
+                    failure_deadline_s: float = 2.0,
+                    max_replacements: int = 2,
+                    relaunch: bool = True,
+                    extra_env: Optional[Dict[str, str]] = None
+                    ) -> Dict[str, Tuple[int, str]]:
+        """Supervise an ELASTIC gang: per-worker monitoring instead of
+        whole-gang relaunch.
+
+        Workers run `HierarchicalGradientSharing(elastic=True)` +
+        `ElasticTrainer`; when a non-coordinator worker dies the gang
+        itself re-forms and keeps training (shrink-and-continue), and —
+        with `relaunch=True` — this supervisor launches a REPLACEMENT
+        worker after a jittered backoff with ``DL4J_TPU_JOIN=1`` on the
+        SAME gradient port and checkpoint dir: it joins the coordinator's
+        listening socket, parks until admitted (immediately under the
+        ``"block"`` policy, at the next epoch boundary under
+        ``"shrink"``), and enters at a fresh generation.  Coordinator
+        (rank 0) death is gang-fatal — the star has no other hub — and
+        raises with rank 0's output tail; use :meth:`run` around an
+        elastic worker script when whole-gang restart is the desired
+        recovery for that.
+
+        Returns ``{label: (returncode, output)}`` per worker, labels
+        ``"r<rank>"`` for the initial gang and ``"r<rank>+j<n>"`` for
+        replacements.  The run succeeds when rank 0 exits 0 — peer
+        deaths are recorded in `failure_history`, not fatal."""
+        if policy not in ("shrink", "block"):
+            raise ValueError(
+                f"policy must be 'shrink' or 'block', got {policy!r}")
+        port = free_port()
+        base_env = {
+            ENV_GRAD_PORT: str(port),
+            "DL4J_TPU_HEARTBEAT_S": str(heartbeat_s),
+            "DL4J_TPU_FAILURE_DEADLINE_S": str(failure_deadline_s),
+            "DL4J_TPU_ELASTIC_POLICY": policy,
+        }
+        if checkpoint_dir is not None:
+            base_env[ENV_CKPT] = checkpoint_dir
+        if extra_env:
+            base_env.update(extra_env)
+        coordinator = f"127.0.0.1:{free_port()}"   # unused by elastic
+        logdir = tempfile.mkdtemp(prefix="elastic-gang-")
+
+        def spawn(rank: int, label: str, join: bool):
+            env = child_env(coordinator, self.num_processes, rank,
+                            self.devices_per_process, self.platform)
+            env.update(base_env)
+            if join:
+                env["DL4J_TPU_JOIN"] = "1"
+            path = os.path.join(logdir, f"{label}.log")
+            f = open(path, "w")
+            p = subprocess.Popen(
+                [sys.executable, "-u", script, *map(str, args)],
+                stdout=f, stderr=subprocess.STDOUT, text=True, env=env)
+            return (p, f, path)
+
+        alive: Dict[str, tuple] = {}
+        for rank in range(self.num_processes):
+            alive[f"r{rank}"] = spawn(rank, f"r{rank}", join=False)
+        results: Dict[str, Tuple[int, str]] = {}
+        replacements = 0
+        rank0_rc: Optional[int] = None
+        deadline = time.monotonic() + timeout
+        grace_deadline: Optional[float] = None
+
+        def reap(label: str, p, f, path) -> Tuple[int, str]:
+            f.close()
+            with open(path, "r") as rf:
+                out = rf.read()
+            results[label] = (p.returncode, out)
+            return results[label]
+
+        try:
+            while alive:
+                now = time.monotonic()
+                if now > deadline or (grace_deadline is not None
+                                      and now > grace_deadline):
+                    for label, (p, f, path) in alive.items():
+                        p.kill()
+                        p.wait()
+                        rc, out = reap(label, p, f, path)
+                        results[label] = (rc, out + "\n<rank timed out>")
+                    alive.clear()
+                    if now > deadline:
+                        raise RuntimeError(
+                            f"elastic gang timed out after {timeout:.0f}s"
+                            f" (still running: {sorted(results)})")
+                    break
+                exited = [(label, t) for label, t in alive.items()
+                          if t[0].poll() is not None]
+                for label, (p, f, path) in exited:
+                    del alive[label]
+                    rc, out = reap(label, p, f, path)
+                    if label == "r0":
+                        rank0_rc = rc
+                        if rc != 0:
+                            raise RuntimeError(
+                                f"elastic gang coordinator (rank 0) "
+                                f"failed (rc={rc}):\n{out[-4000:]}")
+                        # coordinator done: peers must wind down on
+                        # their own within the failure deadline
+                        grace_deadline = time.monotonic() + max(
+                            failure_deadline_s * 3, 5.0)
+                    elif rc != 0:
+                        kind = self._classify_failure(out)
+                        self.failure_history.append(
+                            (replacements, kind, out[-500:]))
+                        if relaunch and rank0_rc is None \
+                                and replacements < max_replacements:
+                            replacements += 1
+                            time.sleep(self.backoff_s(replacements))
+                            jl = f"{label.split('+')[0]}+j{replacements}"
+                            alive[jl] = spawn(
+                                int(label.split('+')[0][1:]), jl,
+                                join=True)
+                time.sleep(0.05)
+        finally:
+            for label, (p, f, path) in alive.items():
+                p.kill()
+                p.wait()
+                reap(label, p, f, path)
+        self.restarts = replacements
+        return results
 
 
 class LocalLauncher:

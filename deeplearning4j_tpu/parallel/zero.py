@@ -283,6 +283,32 @@ def disable_zero1(model) -> None:
     _invalidate_steps(model)
 
 
+def reshard_zero1(model, new_mesh: Mesh, axis: str = "data",
+                  rules: Optional[ShardingRules] = None) -> Zero1Transform:
+    """Re-shard a ZeRO-1 model to a DIFFERENT mesh (elastic world-size
+    change: a gang member left or joined, so the data axis shrank or
+    grew).  Tears down the old transform through `disable_zero1` — which
+    un-pads the moments to their true shapes, the portable layout — and
+    re-enables on `new_mesh`, where `build_plans` re-derives shard/repl
+    decisions and padding for the new axis size.  The same
+    unpad-then-replan route the sharded-checkpoint loader takes when a
+    restore lands on a differently-sized mesh, but in-process and without
+    a disk round-trip.  Returns the new transform."""
+    disable_zero1(model)
+    zt = enable_zero1(model, new_mesh, axis=axis, rules=rules)
+    # Step OUTPUTS (rng, device-resident counters) are committed to the
+    # old mesh's devices; left in place they poison the re-traced step
+    # with mixed device sets.  Pull them to host — the next step re-places
+    # them on the new mesh like a fresh model's first step would.
+    rng = getattr(model, "_rng", None)
+    if rng is not None:
+        model._rng = jnp.asarray(np.asarray(rng))
+    for cached in ("_iter_dev", "_epoch_dev", "_iter_sync", "_epoch_sync"):
+        if hasattr(model, cached):
+            setattr(model, cached, None)
+    return zt
+
+
 def opt_state_bytes_per_replica(opt_state: PyTree) -> int:
     """Optimizer-state bytes resident on ONE device: replicated leaves
     count in full, leaves sharded N ways count 1/N — the quantity the

@@ -101,20 +101,50 @@ class DeviceSlice:
 
 
 class Replica:
-    """One ModelServer pinned to one slice, serving one member."""
+    """One ModelServer pinned to one slice, serving one member.
+
+    Tracks dispatch health: `unhealthy_after` consecutive dispatch
+    failures mark the replica unhealthy and the router stops picking it
+    (except as a probe) until one success clears it — the serving
+    mirror of the elastic gang's heartbeat-deadline semantics."""
 
     def __init__(self, name: str, server: ModelServer, slice_: DeviceSlice):
         self.name = name
         self.server = server
         self.slice = slice_
+        self.healthy = True
+        self.consecutive_failures = 0
+        self.failures = 0
+        self.probes = 0
 
     @property
     def queue_depth(self) -> int:
         return self.server.batcher.queue_depth
 
+    def record_failure(self, unhealthy_after: int) -> bool:
+        """Count one dispatch failure; returns True when this failure
+        flipped the replica unhealthy."""
+        self.failures += 1
+        self.consecutive_failures += 1
+        if self.healthy and self.consecutive_failures >= unhealthy_after:
+            self.healthy = False
+            return True
+        return False
+
+    def record_success(self) -> bool:
+        """One served request; returns True when it cleared an unhealthy
+        mark (the probe passed)."""
+        self.consecutive_failures = 0
+        if not self.healthy:
+            self.healthy = True
+            return True
+        return False
+
     def describe(self) -> Dict[str, Any]:
         return {"name": self.name, "slice": self.slice.index,
-                "queue_depth": self.queue_depth}
+                "queue_depth": self.queue_depth,
+                "healthy": self.healthy,
+                "consecutive_failures": self.consecutive_failures}
 
 
 class ReplicaGroup:
@@ -168,6 +198,7 @@ class FleetMember:
     preferred_slices: List[int] = dataclasses.field(default_factory=list)
     _obs: int = 0
     _probe: int = 0
+    _health_probe: int = 0
 
     def describe(self, now: float) -> Dict[str, Any]:
         return {
@@ -260,8 +291,21 @@ class FleetRouter:
         if not snap:
             raise RejectedError(
                 f"'{member.name}' has no live replica (evicted mid-route)")
-        lo = min(r.queue_depth for r in snap)
-        ties = [r for r in snap if r.queue_depth == lo]
+        healthy = [r for r in snap if r.healthy]
+        unhealthy = [r for r in snap if not r.healthy]
+        if unhealthy:
+            member._health_probe += 1
+            if not healthy \
+                    or member._health_probe % self.probe_every == 0:
+                # route ONE live request to an unhealthy replica so a
+                # recovered server can pass its probe and re-enter (and
+                # when every replica is down, probing is all we can do)
+                r = unhealthy[member._health_probe % len(unhealthy)]
+                r.probes += 1
+                self.fleet.instruments.replica_probes.inc()
+                return r
+        lo = min(r.queue_depth for r in healthy)
+        ties = [r for r in healthy if r.queue_depth == lo]
         return ties[next(group._rr) % len(ties)]
 
 
@@ -766,7 +810,7 @@ class ModelFleet:
             (time.monotonic() - t0) * 1000.0)
         self.instruments.requests(name).inc()
         member.requests += 1
-        fut.add_done_callback(self._make_observer(member, t0))
+        fut.add_done_callback(self._make_observer(member, replica, t0))
         return fut
 
     def output(self, name: str, x, priority: Optional[int] = None,
@@ -776,10 +820,20 @@ class ModelFleet:
         return self.submit(name, x, priority=priority,
                            deadline_ms=deadline_ms).result(timeout=timeout)
 
-    def _make_observer(self, member: FleetMember, t0: float):
+    def _make_observer(self, member: FleetMember, replica: Replica,
+                       t0: float):
         def _done(fut: Future) -> None:
-            if isinstance(fut.exception(), RejectedError):
+            exc = fut.exception()
+            if isinstance(exc, RejectedError):
                 return                      # never dispatched: no latency
+            if exc is not None:
+                # dispatch blew up: health accounting, no latency sample
+                # (a crashed request has no meaningful service time)
+                thr = getattr(self.router.policy, "unhealthy_after", 3)
+                if replica.record_failure(thr):
+                    self.instruments.replica_unhealthy.inc()
+                return
+            replica.record_success()    # a passed probe re-enters routing
             member.latency.observe((time.monotonic() - t0) * 1000.0)
             member._obs += 1
             if member._obs % self.observe_every == 0:

@@ -69,6 +69,10 @@ class FleetPolicy:
     deepest replica queue reaches this.
     `shrink_idle_after_s` — reconcile reclaims a slice from a member
     whose group has been idle (zero queue, no breach) this long.
+    `unhealthy_after` — consecutive dispatch FAILURES (exceptions, not
+    SLO breaches) before a replica is marked unhealthy and removed from
+    routing; it re-enters only after passing a probe request (the
+    serving mirror of the gang heartbeat deadline).
     """
 
     breach_after: int = 3
@@ -76,6 +80,7 @@ class FleetPolicy:
     mode: str = "shed"                      # shed | deprioritize
     grow_at_queue: int = 8
     shrink_idle_after_s: float = 30.0
+    unhealthy_after: int = 3
 
     def __post_init__(self):
         if self.mode not in ("shed", "deprioritize"):
@@ -83,6 +88,8 @@ class FleetPolicy:
                 f"mode must be 'shed' or 'deprioritize', got {self.mode!r}")
         if self.breach_after < 1 or self.clear_after < 1:
             raise ValueError("breach_after/clear_after must be >= 1")
+        if self.unhealthy_after < 1:
+            raise ValueError("unhealthy_after must be >= 1")
 
 
 class SLOTracker:

@@ -345,7 +345,8 @@ class CheckpointManager:
         return removed
 
     # ---- restore ----
-    def restore(self, model) -> Optional[Dict[str, Any]]:
+    def restore(self, model,
+                step: Optional[int] = None) -> Optional[Dict[str, Any]]:
         """Restore the newest *intact* checkpoint into `model` (which
         supplies the target tree structure and sharding — for ZeRO-1 /
         ParallelWrapper runs, place the model on its mesh FIRST so the
@@ -355,9 +356,16 @@ class CheckpointManager:
         holds no checkpoints at all.  A torn or checksum-corrupt newest
         checkpoint is skipped (counted as a fallback) in favor of the next
         older intact one; if every checkpoint is damaged, raises
-        :class:`NoIntactCheckpointError` chained to the last failure."""
+        :class:`NoIntactCheckpointError` chained to the last failure.
+
+        `step` pins the restore to that checkpoint (falling back only to
+        OLDER intact ones) — the elastic gang uses it so every member
+        rewinds to the identical coordinated resume point even if a newer
+        checkpoint landed meanwhile."""
         self.wait()
         candidates = sorted(self.steps(), reverse=True)
+        if step is not None:
+            candidates = [s for s in candidates if s <= int(step)]
         # torn dirs (no manifest) are not candidates, but count the skip
         # over them as observable debris only — restore never reads them.
         last_err: Optional[Exception] = None
@@ -631,6 +639,7 @@ class FaultTolerantTrainer:
                     self.manager.maybe_save(
                         self.model, metadata=self._save_meta(0),
                         **self._checkpoint_kwargs())
+                self._epoch_boundary()
             return self.model
         finally:
             self._restore_signals()
@@ -664,6 +673,11 @@ class FaultTolerantTrainer:
             self.manager.save(self.model, metadata=self._save_meta(0),
                               block=True, **self._checkpoint_kwargs())
         return 0
+
+    def _epoch_boundary(self) -> None:
+        """Hook between epochs (after the boundary checkpoint) — the
+        safe point where :class:`ElasticTrainer` admits replacement
+        workers.  No-op here."""
 
     def _run_epoch(self, data, skip: int, fused_steps: int) -> None:
         if fused_steps > 1:
@@ -755,3 +769,133 @@ class FaultTolerantTrainer:
         meta = self.manager.restore(self.model)
         self._ins.rollbacks.inc()
         raise _Rollback(skip=int(meta.get("batch_in_epoch", 0)))
+
+
+# ---------------------------------------------------------------------------
+# Elastic trainer: gang reformation -> checkpoint-coordinated resume
+# ---------------------------------------------------------------------------
+
+class ElasticTrainer(FaultTolerantTrainer):
+    """Fault-tolerant fit loop that survives gang membership changes.
+
+    Runs on every member of an elastic gradient-sharing gang
+    (``HierarchicalGradientSharing(elastic=True)``).  When a peer dies,
+    partitions or straggles, the mesh reforms under a new generation and
+    the exchange raises ``GangReformed`` — this trainer catches it,
+    rebuilds the codec state (fresh error-feedback residuals and
+    thresholds: the rewind discards the steps that accumulated them, so
+    flushing would double-count gradient mass), restores the coordinated
+    checkpoint step every member was told to rewind to, fast-forwards the
+    iterator, and continues at the new world size.  ZeRO-1 optimizer
+    moments re-shard to the new layout through the resharding loader the
+    restore already uses.
+
+    Policies (coordinator-side, `policy=`):
+
+    * ``"shrink"`` (default) — keep training at the reduced world; parked
+      replacement workers are admitted at the next EPOCH BOUNDARY after a
+      fresh blocking checkpoint, so the grown gang starts from identical
+      state.
+    * ``"block"`` — immediately after a shrink reformation, the
+      coordinator waits up to `rejoin_wait_s` for a replacement and
+      admits it at the same resume step; peers' heartbeats keep flowing
+      from the reactor thread, so their blocked exchanges never
+      false-positive while the coordinator waits.
+
+    Only the coordinator (rank 0) should own a WRITING manager
+    (`save_every_steps` set); peers pass a manager on the same shared
+    directory with ``save_every_steps=None`` and ``save_initial=False``
+    so they restore from it but never race rank 0's writes.
+    """
+
+    def __init__(self, model, manager: Optional[CheckpointManager] = None,
+                 *, policy: str = "shrink", rejoin_wait_s: float = 30.0,
+                 **kwargs):
+        super().__init__(model, manager, **kwargs)
+        if policy not in ("shrink", "block"):
+            raise ValueError(
+                f"policy must be 'shrink' or 'block', got {policy!r}")
+        self.policy = policy
+        self.rejoin_wait_s = float(rejoin_wait_s)
+        self.reformations: List[Dict[str, Any]] = []
+        from deeplearning4j_tpu.monitor.instrument import gang_instruments
+        self._gang = gang_instruments()
+        sharing = self._sharing()
+        if sharing is not None and manager is not None \
+                and hasattr(sharing, "set_resume_step_provider"):
+            # the REFORM frame carries rank 0's newest checkpoint step so
+            # every survivor rewinds to the same state
+            sharing.set_resume_step_provider(manager.latest_step)
+
+    def _sharing(self):
+        return getattr(self.model, "_grad_sharing", None)
+
+    # ---- reformation handling ----
+    def _run_epoch(self, data, skip: int, fused_steps: int) -> None:
+        from deeplearning4j_tpu.parallel.transport import GangReformed
+        try:
+            super()._run_epoch(data, skip, fused_steps)
+        except GangReformed as e:
+            new_skip = self._on_reform(e)
+            raise _Rollback(skip=new_skip)
+
+    def _on_reform(self, e) -> int:
+        """Rebuild sharing state and rewind to the coordinated resume
+        step; returns the iterator fast-forward count."""
+        t0 = time.perf_counter()
+        sharing = self._sharing()
+        if sharing is not None:
+            sharing.rebuild(flush_residuals=False)
+        skip = self._restore_at(e.resume_step)
+        if self.policy == "block" and sharing is not None \
+                and sharing.rank == 0 and e.cause != "join":
+            if sharing.wait_for_joiner(self.rejoin_wait_s) \
+                    and sharing.admit_joiners(e.resume_step) is not None:
+                # admission bumped the generation again; start the grown
+                # gang from fresh codec state like everyone else
+                sharing.rebuild(flush_residuals=False)
+        resume_ms = (time.perf_counter() - t0) * 1000.0
+        self._gang.resume_ms.observe(resume_ms)
+        self.reformations.append({
+            "cause": e.cause, "generation": e.generation,
+            "world": e.world, "rank": e.rank,
+            "resume_step": e.resume_step,
+            "detection_ms": e.detection_ms, "resume_ms": resume_ms})
+        return skip
+
+    def _restore_at(self, step: int) -> int:
+        if self.manager is None:
+            return 0
+        meta = self.manager.restore(self.model, step=step)
+        if meta is None:
+            return 0
+        self.resumed_from = meta
+        if self.normalizer is None and meta.get("normalizer"):
+            self.normalizer = normalizer_from_meta(meta["normalizer"])
+        if self.normalizer is not None \
+                and hasattr(self.model, "set_normalizer"):
+            self.model.set_normalizer(self.normalizer)
+        self.batch_in_epoch = int(meta.get("batch_in_epoch", 0))
+        return self.batch_in_epoch
+
+    # ---- joiner admission (shrink policy: epoch boundary) ----
+    def _epoch_boundary(self) -> None:
+        sharing = self._sharing()
+        if sharing is None or not sharing.has_pending_joiner() \
+                or sharing.rank != 0 or self.manager is None:
+            return
+        # fresh blocking checkpoint = the exact state the grown gang
+        # (including the joiner) starts from
+        self.manager.save(self.model, metadata=self._save_meta(0),
+                          block=True, **self._checkpoint_kwargs())
+        step = self.manager.latest_step()
+        info = sharing.admit_joiners(int(step))
+        if info is None:
+            return
+        sharing.rebuild(flush_residuals=False)
+        skip = self._restore_at(int(step))
+        self.batch_in_epoch = skip
+        self.reformations.append({
+            "cause": "join", "generation": info["generation"],
+            "world": info["world"], "rank": 0, "resume_step": int(step),
+            "detection_ms": None, "resume_ms": None})

@@ -7,6 +7,8 @@ radius is observable next to the recovery counters it should trigger.
 
     KillSwitch           kill-at-step-N hook for FaultTolerantTrainer
                          (SIGTERM / hard-kill / in-process exception)
+    PeerKiller           kill/hang/partition/slow ONE gang rank at step N
+                         (elastic-gang detection/reformation scenarios)
     corrupt_checkpoint   flip payload bytes, tear or truncate the manifest
     FlakyIterator        data producer that raises at batch K (N times)
     SlowIterator         data producer with a fixed per-batch stall
@@ -83,6 +85,82 @@ class KillSwitch:
         else:
             raise ChaosError(
                 f"KillSwitch fired at iteration {model.iteration}")
+
+
+class PeerKiller:
+    """Step hook that injects a GANG-LEVEL fault into one chosen rank.
+
+    Runs as an `ElasticTrainer` hook on EVERY worker; only the worker
+    whose elastic mesh currently holds `rank` fires (the rank is read
+    live from the model's gradient sharing, so reformations that remap
+    ranks are honored).  `mode`:
+
+      * ``"kill"``      — `os._exit(9)`: the coordinator sees EOF and
+        reforms with cause ``crash``;
+      * ``"hang"``      — sleep `duration_s` WITHOUT heartbeating pause
+        (the HB thread keeps running): the peer stays live but ships no
+        data, so the coordinator reforms with cause ``straggler``;
+      * ``"partition"`` — pause the mesh's heartbeat thread and sleep
+        `duration_s`: full silence on a healthy socket, the coordinator
+        reforms with cause ``partition`` and the victim — if it wakes —
+        finds itself evicted (:class:`GangEvictedError`);
+      * ``"slow"``      — sleep `delay_s` once (bounded, below the
+        failure deadline): NO reformation may occur — the
+        detection-threshold negative control.
+
+    `marker` (file path) makes it one-shot across relaunches, exactly
+    like :class:`KillSwitch` — a relaunched replacement of the killed
+    rank must not re-fire."""
+
+    def __init__(self, rank: int, at_step: int, mode: str = "kill",
+                 duration_s: float = 5.0, delay_s: float = 0.2,
+                 marker: Optional[str] = None):
+        if mode not in ("kill", "hang", "partition", "slow"):
+            raise ValueError(f"unknown PeerKiller mode {mode!r}")
+        self.rank = int(rank)
+        self.at_step = int(at_step)
+        self.mode = mode
+        self.duration_s = float(duration_s)
+        self.delay_s = float(delay_s)
+        self.marker = marker
+        self.fired = False
+
+    def armed(self) -> bool:
+        if self.fired:
+            return False
+        return self.marker is None or not os.path.exists(self.marker)
+
+    @staticmethod
+    def _mesh_of(trainer):
+        model = getattr(trainer, "model", trainer)
+        sharing = getattr(model, "_grad_sharing", None)
+        return getattr(sharing, "mesh", None) if sharing is not None \
+            else None
+
+    def __call__(self, trainer) -> None:
+        model = getattr(trainer, "model", trainer)
+        mesh = self._mesh_of(trainer)
+        rank = mesh.rank if mesh is not None else 0
+        if not self.armed() or rank != self.rank \
+                or int(model.iteration) < self.at_step:
+            return
+        self.fired = True
+        if self.marker is not None:
+            with open(self.marker, "w") as f:
+                f.write(str(int(model.iteration)))
+        _count(f"peer-{self.mode}")
+        if self.mode == "kill":
+            os._exit(9)
+        elif self.mode == "hang":
+            time.sleep(self.duration_s)
+        elif self.mode == "partition":
+            if mesh is not None and hasattr(mesh, "pause_heartbeats"):
+                mesh.pause_heartbeats(True)
+            time.sleep(self.duration_s)
+            if mesh is not None and hasattr(mesh, "pause_heartbeats"):
+                mesh.pause_heartbeats(False)
+        else:                       # "slow": bounded, below the deadline
+            time.sleep(self.delay_s)
 
 
 def corrupt_checkpoint(directory: str, what: str = "payload") -> str:

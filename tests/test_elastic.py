@@ -1,0 +1,600 @@
+"""Elastic gang membership: heartbeats, generation fencing, coordinator
+re-formation, joiner admission, and checkpoint-coordinated resume.
+
+Fast tests exercise `ElasticGradientMesh` in-process (each member on a
+thread over loopback TCP — deterministic, no subprocess spin-up) plus the
+codec/trainer/zero1 pieces the reformation path composes.  The `slow`
+tests run the real multi-process chaos scenarios through
+`ElasticLocalRunner.run_elastic` and hold the bitwise kill-and-resume
+parity bar.
+"""
+import json
+import os
+import shutil
+import socket
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel.multihost import free_port
+from deeplearning4j_tpu.parallel.transport import (
+    KIND_DATA, ElasticGradientMesh, GangEvictedError, GangReformed,
+    PeerUnreachableError, TcpGradientMesh, _frame_bytes, _FrameReader)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+# ---------------------------------------------------------------------------
+# helpers: form a gang on threads, run allgathers asynchronously
+# ---------------------------------------------------------------------------
+
+def _spawn_gang(world, port, **kw):
+    kw.setdefault("timeout", 20.0)
+    meshes = [None] * world
+    errors = []
+
+    def make(r):
+        try:
+            meshes[r] = ElasticGradientMesh(r, world, port, **kw)
+        except Exception as e:                      # pragma: no cover
+            errors.append((r, e))
+
+    threads = [threading.Thread(target=make, args=(r,), daemon=True)
+               for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+    assert all(m is not None for m in meshes)
+    return meshes
+
+
+def _allgather_async(mesh, payload):
+    box = {}
+
+    def run():
+        try:
+            box["result"] = mesh.allgather(payload)
+        except Exception as e:
+            box["error"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t, box
+
+
+def _round(meshes, payloads):
+    """One full allgather round; every member must see every payload."""
+    started = [_allgather_async(m, p) for m, p in zip(meshes, payloads)]
+    for t, box in started:
+        t.join(timeout=20)
+        assert "error" not in box, box.get("error")
+        assert box["result"] == list(payloads)
+
+
+def _wait_until(cond, timeout=8.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return cond()
+
+
+def _close_all(*meshes):
+    for m in meshes:
+        if m is not None:
+            m.close()
+
+
+# ---------------------------------------------------------------------------
+# frame protocol
+# ---------------------------------------------------------------------------
+
+def test_frame_reader_partial_and_pipelined_feeds():
+    frame = _frame_bytes(3, KIND_DATA, b"hello")
+    reader = _FrameReader()
+    # byte-at-a-time: nothing surfaces until the final byte
+    for b in frame[:-1]:
+        assert reader.feed(bytes([b])) == []
+    assert reader.feed(frame[-1:]) == [(3, KIND_DATA, b"hello")]
+    # two frames in one recv: both surface, in order
+    two = _frame_bytes(7, KIND_DATA, b"a") + _frame_bytes(7, KIND_DATA, b"b")
+    assert _FrameReader().feed(two) == [(7, KIND_DATA, b"a"),
+                                        (7, KIND_DATA, b"b")]
+
+
+# ---------------------------------------------------------------------------
+# formation, rounds, close
+# ---------------------------------------------------------------------------
+
+def test_elastic_mesh_round_and_idempotent_close():
+    meshes = _spawn_gang(3, free_port())
+    try:
+        _round(meshes, [b"p0", b"p1", b"p2"])
+        _round(meshes, [b"q0", b"q1", b"q2"])
+        for m in meshes:
+            s = m.stats()
+            assert s["generation"] == 1 and s["reformations"] == 0
+    finally:
+        _close_all(*meshes)
+        _close_all(*meshes)         # close() must be idempotent
+
+
+def test_tcp_mesh_close_idempotent_and_formation_cleanup():
+    port = free_port()
+    meshes = [None, None]
+    errs = []
+
+    def make(r):
+        try:
+            meshes[r] = TcpGradientMesh(r, 2, port, timeout=15.0)
+        except Exception as e:                      # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=make, args=(r,), daemon=True)
+          for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=20)
+    assert not errs and all(meshes)
+    try:
+        t0, b0 = _allgather_async(meshes[0], b"x")
+        t1, b1 = _allgather_async(meshes[1], b"y")
+        t0.join(10), t1.join(10)
+        assert b0["result"] == [b"x", b"y"] == b1["result"]
+    finally:
+        for m in meshes:
+            m.close()
+            m.close()               # second close: no-op, no raise
+    # a failed formation must not leak its socket: the same port is
+    # immediately bindable again
+    dead_port = free_port()
+    with pytest.raises(PeerUnreachableError):
+        ElasticGradientMesh(1, 2, dead_port, timeout=0.3)
+    with socket.create_server(("127.0.0.1", dead_port)):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# crash detection + generation fencing
+# ---------------------------------------------------------------------------
+
+def test_crash_reformation_fences_inflight_data():
+    meshes = _spawn_gang(3, free_port(), heartbeat_interval=0.05,
+                         failure_deadline=1.0)
+    m0, m1, m2 = meshes
+    try:
+        _round(meshes, [b"a0", b"a1", b"a2"])
+        # rank 2 ships a DATA frame for the next round, then crashes:
+        # the frame is already in flight when the EOF is detected, so the
+        # reformation must fence (not gather) it
+        m2._peer_send(KIND_DATA, b"doomed")
+        m2._sock.close()
+        assert _wait_until(lambda: m0.generation == 2)
+        assert m0.world == 2 and m0.reformations == 1
+        assert m0.stale_frames == 1
+        with pytest.raises(GangReformed) as ei:
+            m0.allgather(b"x")
+        e0 = ei.value
+        assert e0.cause == "crash" and e0.generation == 2
+        assert e0.world == 2 and e0.lost == [2]
+        assert e0.detection_ms is not None
+        t1, box1 = _allgather_async(m1, b"y")
+        t1.join(10)
+        e1 = box1["error"]
+        assert isinstance(e1, GangReformed)
+        assert e1.cause == "crash" and e1.rank == 1   # relative order kept
+        # the shrunk gang keeps working under the new generation
+        _round([m0, m1], [b"b0", b"b1"])
+        assert m0.stats()["generation"] == 2
+    finally:
+        _close_all(*meshes)
+
+
+def test_stale_generation_data_is_fenced_never_gathered():
+    meshes = _spawn_gang(2, free_port())
+    m0, m1 = meshes
+    try:
+        _round(meshes, [b"a0", b"a1"])
+        # a straggler waking up replays a frame from a dead generation
+        m1._peer_send(KIND_DATA, b"ghost", generation=0)
+        assert _wait_until(lambda: m0.stale_frames == 1)
+        assert m0.generation == 1          # fenced, NOT a reformation
+        # the next round sees only current-generation payloads
+        t0, b0 = _allgather_async(m0, b"c0")
+        t1, b1 = _allgather_async(m1, b"c1")
+        t0.join(10), t1.join(10)
+        assert b0["result"] == [b"c0", b"c1"] == b1["result"]
+        assert b"ghost" not in b0["result"]
+        assert m0.stats()["stale_frames"] == 1
+    finally:
+        _close_all(*meshes)
+
+
+# ---------------------------------------------------------------------------
+# partition / straggler detection, eviction
+# ---------------------------------------------------------------------------
+
+def test_partition_detection_and_eviction():
+    meshes = _spawn_gang(3, free_port(), heartbeat_interval=0.05,
+                         failure_deadline=0.5)
+    m0, m1, m2 = meshes
+    try:
+        _round(meshes, [b"a0", b"a1", b"a2"])
+        m2.pause_heartbeats(True)          # full silence, socket healthy
+        assert _wait_until(lambda: m0.generation == 2)
+        with pytest.raises(GangReformed) as ei:
+            m0.allgather(b"x")
+        assert ei.value.cause == "partition" and ei.value.world == 2
+        # detection latency is the silence at declaration: bounded below
+        # by the deadline, and not wildly above it
+        assert 500.0 * 0.9 <= ei.value.detection_ms <= 10_000.0
+        t1, b1 = _allgather_async(m1, b"y")
+        t1.join(10)
+        assert isinstance(b1["error"], GangReformed)
+        # the partitioned rank finds the eviction notice when it wakes
+        m2.pause_heartbeats(False)
+        with pytest.raises(GangEvictedError):
+            m2.allgather(b"z")
+        _round([m0, m1], [b"b0", b"b1"])
+    finally:
+        _close_all(*meshes)
+
+
+def test_straggler_reformed_out_mid_round():
+    meshes = _spawn_gang(3, free_port(), heartbeat_interval=0.05,
+                         failure_deadline=0.6)
+    m0, m1, m2 = meshes
+    try:
+        _round(meshes, [b"a0", b"a1", b"a2"])
+        # rank 2 heartbeats (stays "alive") but never ships round data
+        t0, b0 = _allgather_async(m0, b"x")
+        t1, b1 = _allgather_async(m1, b"y")
+        t0.join(15), t1.join(15)
+        e0 = b0["error"]
+        assert isinstance(e0, GangReformed) and e0.cause == "straggler"
+        assert e0.world == 2 and e0.lost == [2]
+        assert isinstance(b1["error"], GangReformed)
+        with pytest.raises(GangEvictedError):
+            m2.allgather(b"late")
+        _round([m0, m1], [b"b0", b"b1"])
+    finally:
+        _close_all(*meshes)
+
+
+# ---------------------------------------------------------------------------
+# joiner admission
+# ---------------------------------------------------------------------------
+
+def test_joiner_parked_until_admitted_then_gang_grows():
+    port = free_port()
+    meshes = _spawn_gang(2, port)
+    m0, m1 = meshes
+    jbox = {}
+
+    def join():
+        try:
+            jbox["mesh"] = ElasticGradientMesh(0, 0, port, join=True,
+                                               join_timeout=20.0)
+        except Exception as e:                      # pragma: no cover
+            jbox["error"] = e
+
+    jt = threading.Thread(target=join, daemon=True)
+    mj = None
+    try:
+        _round(meshes, [b"a0", b"a1"])
+        jt.start()
+        assert m0.wait_for_joiner(10.0)
+        assert m0.has_pending_joiner()
+        info = m0.admit_joiners(resume_step=42)
+        assert info["cause"] == "join" and info["world"] == 3
+        assert info["generation"] == 2
+        jt.join(timeout=10)
+        mj = jbox.get("mesh")
+        assert mj is not None, jbox.get("error")
+        assert (mj.rank, mj.world, mj.generation) == (2, 3, 2)
+        assert mj.join_info["resume_step"] == 42
+        # the pre-existing peer reforms into the new generation with the
+        # SAME resume step, keeping its rank
+        t1, b1 = _allgather_async(m1, b"x")
+        t1.join(10)
+        e1 = b1["error"]
+        assert isinstance(e1, GangReformed)
+        assert e1.cause == "join" and e1.resume_step == 42 and e1.rank == 1
+        _round([m0, m1, mj], [b"b0", b"b1", b"b2"])
+    finally:
+        _close_all(m0, m1, mj)
+
+
+# ---------------------------------------------------------------------------
+# codec residuals (reformation rebuild semantics)
+# ---------------------------------------------------------------------------
+
+def test_residual_reset_take_flush_roundtrip():
+    from deeplearning4j_tpu.parallel.compression import (
+        CompressedGradientExchange)
+    template = {"w": np.zeros(8, np.float32)}
+    ex = CompressedGradientExchange(template, threshold=1.0)
+    ex.encode({"w": np.full(8, 0.5, np.float32)})   # all below threshold
+    norm = ex.residual_norm()
+    assert norm > 0
+    taken = ex.take_residuals()
+    assert ex.residual_norm() == 0.0
+    ex.flush_into(taken)
+    assert ex.residual_norm() == pytest.approx(norm)
+    ex.reset_residuals()
+    assert ex.residual_norm() == 0.0
+    with pytest.raises(ValueError):
+        ex.flush_into([np.zeros(3, np.float32)])
+
+
+def test_hierarchical_rebuild_reset_vs_flush():
+    from deeplearning4j_tpu.parallel.hierarchical import (
+        HierarchicalAllReduce, HierarchicalGradientSharing)
+    h = HierarchicalAllReduce(HierarchicalGradientSharing(
+        threshold=1.0, rank=0, world=1))
+    try:
+        h.exchange({"w": np.full(8, 0.5, np.float32)})
+        norm = h._exchange.residual_norm()
+        assert norm > 0
+        # forward (non-rewind) membership change: residual mass carried
+        h.rebuild(flush_residuals=True)
+        assert h._exchange.residual_norm() == pytest.approx(norm)
+        # checkpoint-rewind resume: fresh codecs, zero residuals
+        h.rebuild(flush_residuals=False)
+        assert h._exchange.residual_norm() == 0.0
+    finally:
+        h.close()
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 re-shard for a changed world size
+# ---------------------------------------------------------------------------
+
+def test_reshard_zero1_replans_for_new_world():
+    import jax
+
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh, zero
+    from deeplearning4j_tpu.train import Adam
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-2))
+            .list([DenseLayer(n_out=16, activation="relu"),
+                   OutputLayer(n_out=3, loss="mcxent",
+                               activation="softmax")])
+            .set_input_type(InputType.feed_forward(8)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 8).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[
+        (x[:, 0] > 0).astype(int) + (x[:, 1] > 0).astype(int)]
+    mesh4 = make_mesh({"data": 4}, jax.devices()[:4])
+    ParallelWrapper(net, mesh4, optimizer_sharding=True).fit(x, y)
+    t4 = net._step_transform
+    assert t4 is not None and t4.mesh.shape["data"] == 4
+    # the gang shrank: re-plan the optimizer shards for world 2
+    mesh2 = make_mesh({"data": 2}, jax.devices()[:2])
+    t2 = zero.reshard_zero1(net, mesh2)
+    assert net._step_transform is t2 and t2 is not t4
+    assert t2.mesh.shape["data"] == 2
+    # training continues at the new layout
+    ParallelWrapper(net, mesh2, optimizer_sharding=True).fit(x, y)
+    assert np.isfinite(np.asarray(net.params())).all()
+
+
+# ---------------------------------------------------------------------------
+# chaos hook + trainer policy + env knobs + free_port
+# ---------------------------------------------------------------------------
+
+def _fake_trainer(rank, iteration):
+    mesh = types.SimpleNamespace(rank=rank)
+    sharing = types.SimpleNamespace(mesh=mesh)
+    model = types.SimpleNamespace(iteration=iteration,
+                                  _grad_sharing=sharing)
+    return types.SimpleNamespace(model=model)
+
+
+def test_peer_killer_targets_live_rank_and_marker(tmp_path):
+    from deeplearning4j_tpu.utils.chaos import PeerKiller
+    with pytest.raises(ValueError, match="mode"):
+        PeerKiller(0, 0, mode="nuke")
+    marker = str(tmp_path / "fired")
+    pk = PeerKiller(rank=1, at_step=6, mode="slow", delay_s=0.0,
+                    marker=marker)
+    pk(_fake_trainer(rank=1, iteration=5))     # before at_step: no fire
+    assert not pk.fired
+    pk(_fake_trainer(rank=0, iteration=6))     # wrong live rank: no fire
+    assert not pk.fired
+    pk(_fake_trainer(rank=1, iteration=6))
+    assert pk.fired and os.path.exists(marker)
+    # a relaunched replacement of the killed rank must not re-fire
+    relaunched = PeerKiller(rank=1, at_step=6, mode="slow", delay_s=0.0,
+                            marker=marker)
+    assert not relaunched.armed()
+    relaunched(_fake_trainer(rank=1, iteration=9))
+    assert not relaunched.fired
+
+
+def test_peer_killer_partition_pauses_and_resumes_heartbeats():
+    from deeplearning4j_tpu.utils.chaos import PeerKiller
+    calls = []
+    mesh = types.SimpleNamespace(
+        rank=1, pause_heartbeats=lambda p: calls.append(p))
+    model = types.SimpleNamespace(
+        iteration=3, _grad_sharing=types.SimpleNamespace(mesh=mesh))
+    trainer = types.SimpleNamespace(model=model)
+    pk = PeerKiller(rank=1, at_step=3, mode="partition", duration_s=0.0)
+    pk(trainer)
+    assert calls == [True, False]
+
+
+def test_elastic_trainer_policy_validation():
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.train import Sgd
+    from deeplearning4j_tpu.train.resilience import ElasticTrainer
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.1))
+            .list([DenseLayer(n_out=4, activation="tanh"),
+                   OutputLayer(n_out=2, loss="mcxent",
+                               activation="softmax")])
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    with pytest.raises(ValueError, match="policy"):
+        ElasticTrainer(net, policy="wait")
+    t = ElasticTrainer(net, policy="block", rejoin_wait_s=1.5)
+    assert t.policy == "block" and t.rejoin_wait_s == 1.5
+
+
+def test_elastic_env_knob_resolution(monkeypatch):
+    from deeplearning4j_tpu.parallel.hierarchical import (
+        HierarchicalGradientSharing)
+    monkeypatch.setenv("DL4J_TPU_HEARTBEAT_S", "0.125")
+    monkeypatch.setenv("DL4J_TPU_FAILURE_DEADLINE_S", "3.5")
+    monkeypatch.setenv("DL4J_TPU_JOIN", "1")
+    cfg = HierarchicalGradientSharing(elastic=True).resolve()
+    assert cfg.heartbeat_interval_s == 0.125
+    assert cfg.failure_deadline_s == 3.5
+    assert cfg.join is True
+    monkeypatch.delenv("DL4J_TPU_JOIN")
+    assert HierarchicalGradientSharing(elastic=True).resolve().join is False
+
+
+def test_free_port_survives_probe_vs_bind_race(monkeypatch):
+    from deeplearning4j_tpu.parallel import multihost as mh
+    state = {"raced": False}
+
+    class RacySocket(socket.socket):
+        def bind(self, addr):
+            # fail the first VERIFY bind (explicit port) — the window
+            # where another process grabbed the probed port
+            if addr[1] != 0 and not state["raced"]:
+                state["raced"] = True
+                raise OSError(98, "Address already in use")
+            return super().bind(addr)
+
+    monkeypatch.setattr(mh.socket, "socket", RacySocket)
+    port = mh.free_port()
+    assert state["raced"] and 0 < port < 65536
+    monkeypatch.undo()
+    with socket.socket() as s:                      # genuinely bindable
+        s.bind(("127.0.0.1", port))
+
+    class AlwaysLoses(socket.socket):
+        def bind(self, addr):
+            if addr[1] != 0:
+                raise OSError(98, "Address already in use")
+            return super().bind(addr)
+
+    monkeypatch.setattr(mh.socket, "socket", AlwaysLoses)
+    with pytest.raises(OSError, match="no bindable port"):
+        mh.free_port(max_tries=3)
+
+
+# ---------------------------------------------------------------------------
+# multi-process chaos scenarios (slow: real subprocess gangs)
+# ---------------------------------------------------------------------------
+
+def _prune_checkpoints_above(directory, step):
+    from deeplearning4j_tpu.train.resilience import CheckpointManager
+    for name in os.listdir(directory):
+        path = os.path.join(directory, name)
+        if not (os.path.isdir(path)
+                and name.startswith(CheckpointManager.PREFIX)):
+            continue
+        if int(name[len(CheckpointManager.PREFIX):]) > step:
+            shutil.rmtree(path)
+
+
+@pytest.mark.slow
+def test_elastic_gang_kill_shrink_and_bitwise_resume_parity(tmp_path):
+    """The acceptance bar: a 3-process gang loses rank 2 mid-run, detects
+    within the deadline, re-forms at world 2 under a new generation and
+    resumes from the coordinated checkpoint — and the survivors' final
+    params BITWISE-match an uninterrupted world-2 run started from that
+    same checkpoint."""
+    from deeplearning4j_tpu.parallel.multihost import ElasticLocalRunner
+    script = os.path.join(HERE, "mh_worker_elastic_gang.py")
+    steps, deadline_s = 8, 2.0
+    ckpt_a, out_a = tmp_path / "ckpt_a", tmp_path / "out_a"
+    out_a.mkdir()
+    runner = ElasticLocalRunner(num_processes=3, backoff_base_s=0.2)
+    results = runner.run_elastic(
+        script, [str(out_a), str(steps), "1", "2", "3"], timeout=420,
+        checkpoint_dir=str(ckpt_a), policy="shrink", heartbeat_s=0.1,
+        failure_deadline_s=deadline_s, relaunch=False)
+    assert results["r0"][0] == 0, results["r0"][1][-2000:]
+    assert results["r1"][0] == 0, results["r1"][1][-2000:]
+    assert results["r2"][0] != 0                       # the victim died
+    with open(out_a / "elastic_0.json") as f:
+        info0 = json.load(f)
+    reforms = info0["reformations"]
+    assert len(reforms) == 1
+    assert reforms[0]["cause"] in ("crash", "partition", "straggler")
+    assert reforms[0]["world"] == 2
+    # detection within the configured deadline (reactor-tick slack)
+    assert reforms[0]["detection_ms"] is not None
+    assert reforms[0]["detection_ms"] <= deadline_s * 1000.0 + 2000.0
+    assert info0["stats"]["generation"] == 2
+    final0 = np.load(out_a / "final_0.npz")
+    final1 = np.load(out_a / "final_1.npz")
+    np.testing.assert_array_equal(final0["params"], final1["params"])
+    assert int(final0["iteration"]) == steps
+
+    # comparator: copy the checkpoint dir, drop everything NEWER than the
+    # coordinated resume step, and run an uninterrupted world-2 gang from
+    # it — bitwise-identical final params prove nothing was lost or
+    # double-counted across the reformation
+    resume_step = int(reforms[0]["resume_step"])
+    ckpt_b, out_b = tmp_path / "ckpt_b", tmp_path / "out_b"
+    shutil.copytree(ckpt_a, ckpt_b)
+    _prune_checkpoints_above(str(ckpt_b), resume_step)
+    out_b.mkdir()
+    runner_b = ElasticLocalRunner(num_processes=2, backoff_base_s=0.2)
+    results_b = runner_b.run_elastic(
+        script, [str(out_b), str(steps), "1", "-1", "0"], timeout=420,
+        checkpoint_dir=str(ckpt_b), policy="shrink", heartbeat_s=0.1,
+        failure_deadline_s=deadline_s, relaunch=False)
+    assert results_b["r0"][0] == 0, results_b["r0"][1][-2000:]
+    final_b = np.load(out_b / "final_0.npz")
+    assert int(final_b["iteration"]) == steps
+    np.testing.assert_array_equal(final0["params"], final_b["params"])
+    np.testing.assert_array_equal(final0["score"], final_b["score"])
+
+
+@pytest.mark.slow
+def test_elastic_gang_block_policy_relaunch_and_rejoin(tmp_path):
+    """relaunch=True + block policy: the supervisor spawns a replacement
+    with DL4J_TPU_JOIN=1; the coordinator admits it at the coordinated
+    resume step; the gang finishes back at world 3 with every member
+    holding identical params."""
+    from deeplearning4j_tpu.parallel.multihost import ElasticLocalRunner
+    script = os.path.join(HERE, "mh_worker_elastic_gang.py")
+    ckpt, out = tmp_path / "ckpt", tmp_path / "out"
+    out.mkdir()
+    runner = ElasticLocalRunner(num_processes=3, backoff_base_s=0.2)
+    results = runner.run_elastic(
+        script, [str(out), "10", "1", "2", "3"], timeout=420,
+        checkpoint_dir=str(ckpt), policy="block", heartbeat_s=0.1,
+        failure_deadline_s=2.0, relaunch=True, max_replacements=1)
+    assert results["r0"][0] == 0, results["r0"][1][-2000:]
+    assert results["r1"][0] == 0, results["r1"][1][-2000:]
+    assert results["r2"][0] != 0                       # original victim
+    assert "r2+j1" in results, sorted(results)
+    assert results["r2+j1"][0] == 0, results["r2+j1"][1][-2000:]
+    with open(out / "elastic_0.json") as f:
+        info0 = json.load(f)
+    # crash reform (shrink to 2) then joiner admission (back to 3)
+    assert info0["stats"]["world"] == 3
+    assert info0["stats"]["generation"] >= 3
+    finals = [np.load(out / f"final_{r}.npz") for r in range(3)]
+    for f2 in finals[1:]:
+        np.testing.assert_array_equal(finals[0]["params"], f2["params"])
+    assert int(finals[0]["iteration"]) == 10

@@ -20,7 +20,7 @@ from deeplearning4j_tpu.nn import (DenseLayer, InputType, MultiLayerNetwork,
 from deeplearning4j_tpu.serving import (ContinuousBatcher, FleetPolicy,
                                         LatencySLO, ModelFleet,
                                         ModelRegistry, RejectedError,
-                                        ServingMetrics, SLOTracker)
+                                        Replica, ServingMetrics, SLOTracker)
 from deeplearning4j_tpu.train.updaters import Sgd
 
 
@@ -368,6 +368,82 @@ def test_deprioritize_mode_admits_at_floor(tmp_path):
         assert fleet.output("lo", _x()).shape == (2, 3)
         assert fleet.member("lo").deprioritized == 1
         assert fleet.member("lo").sheds == 0
+
+
+# ---------------------------------------------------------------------------
+# fleet: replica dispatch health
+# ---------------------------------------------------------------------------
+
+def test_replica_health_state_machine():
+    import types
+    r = Replica("m", types.SimpleNamespace(), types.SimpleNamespace(index=0))
+    assert r.healthy
+    assert not r.record_failure(3) and not r.record_failure(3)
+    assert r.record_failure(3)              # third consecutive: flips
+    assert not r.healthy
+    assert not r.record_failure(3)          # already down: no re-flip
+    assert r.failures == 4
+    assert r.record_success()               # probe passed: clears
+    assert r.healthy and r.consecutive_failures == 0
+    assert not r.record_success()           # steady state: no event
+    # a success between failures resets the consecutive count
+    r.record_failure(3), r.record_success(), r.record_failure(3)
+    assert r.healthy and r.consecutive_failures == 1
+
+
+def test_flaky_replica_marked_unhealthy_probed_and_readmitted(tmp_path):
+    from deeplearning4j_tpu.utils import chaos
+    with _fleet(tmp_path, max_resident=2, n_slices=2) as fleet:
+        m = fleet.deploy("m", _net(seed=1), replicas=2, warm=True)
+        assert len(m.group.replicas) == 2
+        good, bad = m.group.replicas
+        flaky = chaos.FlakyDispatch(bad.server.cache.run, times=10_000)
+        bad.server.cache.run = flaky
+        # drive traffic: every request the router hands the flaky replica
+        # fails, and unhealthy_after consecutive failures flip it
+        for i in range(32):
+            try:
+                fleet.output("m", _x(seed=i), timeout=10)
+            except chaos.ChaosError:
+                pass
+            if not bad.healthy:
+                break
+        deadline = time.monotonic() + 5     # observer runs on done-callback
+        while bad.healthy and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not bad.healthy and good.healthy
+        assert bad.consecutive_failures >= fleet.policy.unhealthy_after
+        assert fleet.instruments.replica_unhealthy.value >= 1
+        # routing now avoids it except for probe admissions: over two full
+        # probe windows, exactly 2 picks land on the sick replica
+        picks = [fleet.router.pick(m)
+                 for _ in range(2 * fleet.router.probe_every)]
+        assert picks.count(bad) == 2
+        assert all(r is good for r in picks if r is not bad)
+        # while the probe keeps failing, it stays out — and the member
+        # keeps serving through the healthy replica the whole time
+        served = failed = 0
+        for i in range(2 * fleet.router.probe_every):
+            try:
+                fleet.output("m", _x(seed=i), timeout=10)
+                served += 1
+            except chaos.ChaosError:
+                failed += 1
+        assert failed == 2 and served == 2 * fleet.router.probe_every - 2
+        assert not bad.healthy
+        # the server recovers: the next probe succeeds and the replica
+        # re-enters normal rotation
+        bad.server.cache.run = flaky.fn
+        for i in range(4 * fleet.router.probe_every):
+            fleet.output("m", _x(seed=i), timeout=10)
+            if bad.healthy:
+                break
+        deadline = time.monotonic() + 5
+        while not bad.healthy and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert bad.healthy and bad.probes >= 1
+        assert fleet.instruments.replica_probes.value >= 1
+        assert bad in [fleet.router.pick(m) for _ in range(4)]
 
 
 # ---------------------------------------------------------------------------

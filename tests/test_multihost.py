@@ -143,8 +143,21 @@ def test_elastic_runner_failure_taxonomy_and_backoff():
     assert r._classify_failure("fatal: peer task 0 died") == "peer-loss"
     assert r._classify_failure("rank 0 failed (rc=1):\nTraceback ...") \
         == "crash"
-    assert [r.backoff_s(a) for a in (1, 2, 3, 4, 5)] == \
-        [0.5, 1.0, 2.0, 4.0, 4.0]
+    # decorrelated jitter: seeded (deterministic, no wall clock), first
+    # sleep is the base, every sleep stays in [base, cap], and two
+    # runners with the same seed draw identical schedules while
+    # different seeds decorrelate (no thundering herd)
+    r = ElasticLocalRunner(2, backoff_base_s=0.5, backoff_cap_s=4.0,
+                           jitter_seed=7)
+    seq = [r.backoff_s(a) for a in (1, 2, 3, 4, 5)]
+    assert seq[0] == 0.5
+    assert all(0.5 <= v <= 4.0 for v in seq)
+    twin = ElasticLocalRunner(2, backoff_base_s=0.5, backoff_cap_s=4.0,
+                              jitter_seed=7)
+    assert [twin.backoff_s(a) for a in (1, 2, 3, 4, 5)] == seq
+    other = ElasticLocalRunner(2, backoff_base_s=0.5, backoff_cap_s=4.0,
+                               jitter_seed=8)
+    assert [other.backoff_s(a) for a in (1, 2, 3, 4, 5)] != seq
     # a doomed gang records a history entry per attempt
     import pytest as _pytest
     fail = ElasticLocalRunner(1, max_restarts=1, backoff_base_s=0.01)
