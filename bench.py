@@ -68,6 +68,15 @@ always-resident posture: models served per fixed device-memory budget
 cache) and an overload phase where low-priority traffic is shed while
 the high-priority p99 stays within its SLO (gate: both); detail to
 stderr + `BENCH_fleet.json`, one stdout JSON line.
+
+`python bench.py --quant [--quick]` A/Bs post-training-quantized serving
+(`deeplearning4j_tpu.quant`: calibrate → int8 per-channel weights → fused
+quantized forward) against the f32 model through the bucketed serving
+cache, and round-trips the quantized executables through the persistent
+AOT cache in a second subprocess — gates: >=2x throughput per byte
+resident OR >=1.5x QPS, parity delta <=1%, warm restart with zero
+compiles, quantized fingerprint distinct from f32; detail to stderr +
+`BENCH_quant.json`, one stdout JSON line.
 """
 import json
 import sys
@@ -1493,6 +1502,170 @@ def main_aot(quick: bool):
         sys.exit(1)
 
 
+def quant_child(cache_dir: str, steps: int, batch: int, n_in: int,
+                hidden: int):
+    """`--quant-child` worker: ONE process's f32-vs-int8 serving A/B.
+
+    Builds a deterministic MLP, calibrates + quantizes it, warms both the
+    f32 and the quantized bucket ladders through a BucketedCompileCache
+    backed by the persistent executable cache at `cache_dir`, then times
+    steady-state serving QPS for each.  Prints one JSON line; the parent
+    (`bench_quant`) runs this twice against the same directory — the warm
+    run must deserialize every executable (0 compiles), under a quantized
+    fingerprint distinct from the f32 one."""
+    from deeplearning4j_tpu.compile import (PersistentExecutableCache,
+                                            model_fingerprint)
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.quant import (calibrate, parity_check,
+                                          quantize_model)
+    from deeplearning4j_tpu.serving import BucketedCompileCache
+    from deeplearning4j_tpu.train.updaters import Sgd
+    import jax
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(1e-1))
+            .list([DenseLayer(n_out=hidden, activation="relu"),
+                   DenseLayer(n_out=hidden, activation="relu"),
+                   OutputLayer(n_out=10, loss="mcxent",
+                               activation="softmax")])
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, n_in).astype(np.float32)
+    # train briefly: parity on an untrained net is all near-tied logits,
+    # where a single int8 rounding flip misreads as an accuracy loss
+    xt = rng.randn(256, n_in).astype(np.float32)
+    yt = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 256)]
+    for _ in range(8):
+        net.fit(xt, yt)
+    stats = calibrate(net, [rng.randn(batch, n_in).astype(np.float32)
+                            for _ in range(4)], observer="percentile")
+    qm = quantize_model(net, calibration=stats)
+    x_eval = rng.randn(512, n_in).astype(np.float32)
+
+    cache = PersistentExecutableCache(cache_dir)
+    scache = BucketedCompileCache(max_batch=batch, persistent=cache)
+    scache.warmup("f32:v1", net, (n_in,), np.float32)
+    scache.warmup("int8:v1", qm, (n_in,), np.float32)
+
+    def qps(key, model):
+        scache.run(key, model, x)            # touch the exact bucket
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = scache.run(key, model, x)
+        np.asarray(out)
+        return steps * batch / (time.perf_counter() - t0)
+
+    bytes_f32 = sum(l.nbytes
+                    for l in jax.tree_util.tree_leaves(net.params_))
+    print(json.dumps({
+        "qps_f32": qps("f32:v1", net),
+        "qps_int8": qps("int8:v1", qm),
+        "bytes_f32": bytes_f32,
+        "bytes_int8": qm.bytes_resident(),
+        "parity_delta": parity_check(net, qm, x_eval)["delta"],
+        "fp_f32": model_fingerprint(net),
+        "fp_quant": model_fingerprint(qm),
+        "compiles": cache.stats["compiles"],
+        "disk_hits": cache.stats["disk_hits"],
+        "stores": cache.stats["stores"],
+    }))
+
+
+def bench_quant(steps=200, batch=64, n_in=512, hidden=1024):
+    """f32 vs int8 serving A/B plus the quantized warm-restart contract:
+    two identical subprocesses share one persistent cache directory — the
+    first compiles and persists the f32 AND quantized bucket ladders, the
+    second must start warm with zero compiles."""
+    import os
+    import shutil
+    import subprocess
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="bench-quant-")
+    try:
+        def child(tag):
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--quant-child", cache_dir, str(steps), str(batch),
+                   str(n_in), str(hidden)]
+            p = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=1200, env=dict(os.environ))
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"{tag} quant child failed:\n{p.stderr[-2000:]}")
+            return json.loads(p.stdout.strip().splitlines()[-1])
+
+        cold = child("cold")
+        warm = child("warm")
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    qps_ratio = warm["qps_int8"] / max(warm["qps_f32"], 1e-9)
+    bytes_ratio = cold["bytes_f32"] / max(cold["bytes_int8"], 1)
+    tpb_ratio = qps_ratio * bytes_ratio      # throughput per byte resident
+    return {
+        "cold": cold, "warm": warm,
+        "qps_speedup": qps_ratio,
+        "bytes_resident_ratio": bytes_ratio,
+        "throughput_per_byte_ratio": tpb_ratio,
+        "parity_delta": cold["parity_delta"],
+        "fp_distinct": cold["fp_quant"] != cold["fp_f32"],
+        "fp_stable": warm["fp_quant"] == cold["fp_quant"],
+        "warm_compiles": warm["compiles"],
+        "warm_zero_compiles": warm["compiles"] == 0,
+        "steps": steps, "batch": batch, "n_in": n_in, "hidden": hidden,
+    }
+
+
+def main_quant(quick: bool):
+    """`--quant` mode: A/B detail to stderr + BENCH_quant.json, ONE
+    stdout JSON line.  Gates (exit 1 on any failure): >=2x throughput per
+    byte resident OR >=1.5x QPS, parity delta <=1%, warm restart with
+    zero compiles, quantized fingerprint distinct from f32."""
+    import os
+    if not os.environ.get("JAX_PLATFORMS"):
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from __graft_entry__ import _probe_backend_device_count
+        if _probe_backend_device_count() < 1:
+            print("[bench] TPU backend unreachable; quant bench on CPU",
+                  file=sys.stderr, flush=True)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = (bench_quant(steps=25, batch=32, n_in=128, hidden=256)
+             if quick else bench_quant())
+    except Exception as e:
+        print(json.dumps({"metric": "quant_throughput_per_byte_ratio",
+                          "value": None, "unit": "x",
+                          "error": repr(e)[:300]}))
+        sys.exit(1)
+    for k, v in r.items():      # detail to stderr: stdout stays one line
+        print(f"[quant] {k} = {v}", file=sys.stderr, flush=True)
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_quant.json"), "w") as f:
+        json.dump(r, f, indent=2)
+    perf_gate = (r["throughput_per_byte_ratio"] >= 2.0
+                 or r["qps_speedup"] >= 1.5)
+    gates = {
+        "perf": perf_gate,
+        "parity": r["parity_delta"] <= 0.01,
+        "warm_zero_compiles": r["warm_zero_compiles"],
+        "fp_distinct": r["fp_distinct"] and r["fp_stable"],
+    }
+    print(json.dumps({
+        "metric": "quant_throughput_per_byte_ratio",
+        "value": round(r["throughput_per_byte_ratio"], 2),
+        "unit": "x",
+        "qps_speedup": round(r["qps_speedup"], 3),
+        "bytes_resident_ratio": round(r["bytes_resident_ratio"], 2),
+        "parity_delta": round(r["parity_delta"], 5),
+        "warm_compiles": r["warm_compiles"],
+        "gates": gates,
+        "pass": all(gates.values()),
+    }))
+    if not all(gates.values()):
+        sys.exit(1)
+
+
 def bench_autotune(n_batches=64, batch=64, n_in=256, quick=False):
     """Schedule-autotuner search over the execution-config space on the
     pipeline fixture, then persist → load → re-apply the winner and
@@ -1666,6 +1839,15 @@ def main():
         return
     if "--aot" in sys.argv:
         main_aot(quick)
+        return
+    if "--quant-child" in sys.argv:
+        i = sys.argv.index("--quant-child")
+        quant_child(sys.argv[i + 1], int(sys.argv[i + 2]),
+                    int(sys.argv[i + 3]), int(sys.argv[i + 4]),
+                    int(sys.argv[i + 5]))
+        return
+    if "--quant" in sys.argv:
+        main_quant(quick)
         return
     if "--autotune" in sys.argv:
         main_autotune(quick)

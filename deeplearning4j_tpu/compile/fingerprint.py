@@ -183,6 +183,13 @@ def model_fingerprint(model) -> str:
     parts["state"] = tree_spec(getattr(model, "state_", None))
     parts["device_norm"] = _device_norm_fingerprint(
         getattr(model, "_device_norm", None))
+    # a QuantizedModel folds its quant config + calibration-stat crc32s
+    # into the key: an int8 program and its f32 base (or two quantizations
+    # from different calibration data) must never collide on one
+    # persisted executable
+    qfp = getattr(model, "quant_fingerprint", None)
+    if callable(qfp):
+        parts["quant"] = qfp()
     return digest(parts)
 
 

@@ -26,7 +26,7 @@ from deeplearning4j_tpu.data.datasets import (  # noqa: F401
     IrisDataSetIterator, MnistDataSetIterator, SyntheticCifar10,
     SyntheticImdb, SyntheticMnist, read_idx)
 from deeplearning4j_tpu.data.analysis import (  # noqa: F401
-    AnalyzeLocal, DataAnalysis, Join)
+    AnalyzeLocal, DataAnalysis, Histogram, Join)
 from deeplearning4j_tpu.data.audio import (  # noqa: F401
     SpectrogramRecordReader, WavFileRecordReader, read_wav, spectrogram)
 from deeplearning4j_tpu.data.arrow import (  # noqa: F401

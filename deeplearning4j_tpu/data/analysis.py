@@ -132,6 +132,65 @@ class Join:
 # analysis (reference AnalyzeLocal / DataAnalysis)
 # ---------------------------------------------------------------------------
 
+class Histogram:
+    """Fixed-range accumulating histogram with linear-interpolated
+    percentiles (reference `HistogramAnalysis` counts; the interpolation
+    matches numpy's 'linear' within bucket resolution).
+
+    Built once with a [lo, hi] range and fed arrays incrementally —
+    the accumulation form both `AnalyzeLocal` (column histograms over a
+    record list) and the quant percentile calibration observer need:
+    the observer sees one activation batch at a time and can never hold
+    the full stream."""
+
+    def __init__(self, lo: float, hi: float, bins: int = 2048):
+        if not (bins >= 1 and math.isfinite(lo) and math.isfinite(hi)):
+            raise ValueError(f"bad histogram spec lo={lo} hi={hi} "
+                             f"bins={bins}")
+        if hi <= lo:                       # degenerate column: widen a hair
+            hi = lo + max(abs(lo), 1.0) * 1e-9 + 1e-30
+        self.lo = float(lo)
+        self.hi = float(hi)
+        self.bins = int(bins)
+        self.counts = np.zeros(self.bins, np.int64)
+        self.total = 0
+
+    def add(self, values) -> "Histogram":
+        v = np.asarray(values, np.float64).ravel()
+        v = v[np.isfinite(v)]
+        if v.size == 0:
+            return self
+        idx = ((v - self.lo) / (self.hi - self.lo) * self.bins).astype(
+            np.int64)
+        np.add.at(self.counts, np.clip(idx, 0, self.bins - 1), 1)
+        self.total += int(v.size)
+        return self
+
+    @property
+    def bin_width(self) -> float:
+        return (self.hi - self.lo) / self.bins
+
+    def edges(self) -> np.ndarray:
+        return np.linspace(self.lo, self.hi, self.bins + 1)
+
+    def percentile(self, p: float) -> float:
+        """Value at percentile `p` in [0, 100], linearly interpolated
+        within the containing bucket."""
+        if not 0.0 <= p <= 100.0:
+            raise ValueError(f"percentile {p} outside [0, 100]")
+        if self.total == 0:
+            return float("nan")
+        target = p / 100.0 * self.total
+        cum = np.cumsum(self.counts)
+        i = int(np.searchsorted(cum, target, side="left"))
+        i = min(i, self.bins - 1)
+        prev = cum[i - 1] if i > 0 else 0
+        in_bucket = self.counts[i]
+        frac = ((target - prev) / in_bucket) if in_bucket else 0.0
+        return float(self.lo +
+                     (i + min(max(frac, 0.0), 1.0)) * self.bin_width)
+
+
 @dataclasses.dataclass
 class NumericalColumnAnalysis:
     count: int
@@ -140,6 +199,16 @@ class NumericalColumnAnalysis:
     max: float
     mean: float
     stdev: float
+    histogram: Optional[Histogram] = None
+
+    def percentile(self, p: float) -> float:
+        """Column percentile from the histogram (requires analyze() to
+        have been run with histogram_bins > 0)."""
+        if self.histogram is None:
+            raise ValueError(
+                "no histogram collected — pass histogram_bins to "
+                "AnalyzeLocal.analyze")
+        return self.histogram.percentile(p)
 
     def __str__(self):
         return (f"count={self.count} missing={self.count_missing} "
@@ -192,7 +261,11 @@ class AnalyzeLocal:
     """Single-pass local analysis (reference `AnalyzeLocal.analyze`)."""
 
     @staticmethod
-    def analyze(schema: Schema, records: Sequence[Record]) -> DataAnalysis:
+    def analyze(schema: Schema, records: Sequence[Record],
+                histogram_bins: int = 0) -> DataAnalysis:
+        """Single-pass per-column stats; with `histogram_bins` > 0 numeric
+        columns additionally carry a `Histogram` over [min, max] (the
+        percentile source the quant calibration observers build on)."""
         analyses: Dict[str, Any] = {}
         for idx, col in enumerate(schema.columns):
             values = [r[idx] for r in records]
@@ -201,13 +274,18 @@ class AnalyzeLocal:
                            if v is not None
                            and not (isinstance(v, float) and math.isnan(v))]
                 arr = np.asarray(present, np.float64)
+                hist = None
+                if histogram_bins and len(arr):
+                    hist = Histogram(float(arr.min()), float(arr.max()),
+                                     histogram_bins).add(arr)
                 analyses[col.name] = NumericalColumnAnalysis(
                     count=len(present),
                     count_missing=len(values) - len(present),
                     min=float(arr.min()) if len(arr) else float("nan"),
                     max=float(arr.max()) if len(arr) else float("nan"),
                     mean=float(arr.mean()) if len(arr) else float("nan"),
-                    stdev=float(arr.std(ddof=1)) if len(arr) > 1 else 0.0)
+                    stdev=float(arr.std(ddof=1)) if len(arr) > 1 else 0.0,
+                    histogram=hist)
             elif col.kind == "categorical":
                 cnt = Counter(str(v) for v in values if v is not None)
                 analyses[col.name] = CategoricalColumnAnalysis(

@@ -88,6 +88,17 @@ docs/observability.md):
   gang_stale_frames_total            stale-generation data frames fenced
                                      and dropped (never summed into
                                      gradients)
+  quant_calibration_batches_total    batches consumed by PTQ calibration
+                                     passes (quant.calibrate)
+  quant_models_total{dtype=}         models quantized, by produced dtype
+                                     (int8 vs bf16-fallback-dominant)
+  quant_bytes_saved                  param bytes saved by the most recent
+                                     quantizations (f32 resident bytes
+                                     minus quantized resident bytes)
+  quant_accuracy_delta               f32-vs-quantized accuracy delta of
+                                     the most recent parity check
+                                     (fraction of disagreeing top-1
+                                     predictions / relative error)
 """
 from __future__ import annotations
 
@@ -514,9 +525,69 @@ class FleetInstruments:
         return c
 
 
+class QuantInstruments:
+    """Quantized-inference handles (quant.calibrate / quant.ptq).
+    Per-dtype model counters are created lazily and memoized, matching
+    the fleet bundle's labeled-child pattern."""
+
+    def __init__(self, registry_: Optional[MetricsRegistry] = None):
+        reg = registry_ if registry_ is not None else registry()
+        self._reg = reg
+        self.calibration_batches = reg.counter(
+            "quant_calibration_batches_total",
+            help="batches consumed by PTQ calibration passes (percentile "
+            "observers replay the iterator, so each pass counts)")
+        self.bytes_saved = reg.gauge(
+            "quant_bytes_saved",
+            help="param bytes saved by quantization: f32 resident bytes "
+            "minus quantized resident bytes, summed over quantized models")
+        self.accuracy_delta = reg.gauge(
+            "quant_accuracy_delta",
+            help="f32-vs-quantized disagreement of the most recent parity "
+            "check (top-1 disagreement fraction, or relative error for "
+            "regression heads)")
+        self._models: dict = {}
+
+    def record_calibration_batch(self) -> None:
+        if not enabled():
+            return
+        self.calibration_batches.inc()
+
+    def models(self, dtype: str):
+        c = self._models.get(dtype)
+        if c is None:
+            c = self._reg.counter(
+                "quant_models_total",
+                help="models quantized, labeled by the dominant produced "
+                "dtype (int8, or bf16 when range-hostile fallback won)",
+                labels={"dtype": dtype})
+            self._models[dtype] = c
+        return c
+
+    def record_model(self, dtype: str, bytes_saved: int) -> None:
+        if not enabled():
+            return
+        self.models(dtype).inc()
+        self.bytes_saved.inc(bytes_saved)
+
+    def record_accuracy_delta(self, delta: float) -> None:
+        if not enabled():
+            return
+        self.accuracy_delta.set(float(delta))
+
+
 _pipeline: Optional[PipelineInstruments] = None
 _resilience: Optional[ResilienceInstruments] = None
 _aot: Optional[AotCacheInstruments] = None
+_quant: Optional[QuantInstruments] = None
+
+
+def quant_instruments() -> QuantInstruments:
+    """Process-wide quant handle bundle (lazy singleton)."""
+    global _quant
+    if _quant is None:
+        _quant = QuantInstruments()
+    return _quant
 
 
 def aot_instruments() -> AotCacheInstruments:

@@ -519,3 +519,41 @@ def fused_attention(q, k, v, mask=None, causal=False, scale=None):
     if score_bytes <= _XLA_SCORE_BYTES_MAX:
         return mha_reference(q, k, v, mask, causal, scale)
     return blockwise_attention(q, k, v, mask, causal, scale)
+
+
+# ---------------------------------------------------------------------------
+# Quantized inference projections (quant/ subsystem hot path)
+# ---------------------------------------------------------------------------
+
+def quantized_projection(x, qt, b=None, acc_dtype=None):
+    """[B, T, F] @ int8 [F, O] projection with per-output-channel scales —
+    the q/k/v/out projections are where an attention block's weight bytes
+    live, so they are what quantization shrinks; the [T, T] score math
+    keeps the accumulating dtype untouched.  Dequantization (the scale
+    multiply) happens after the matmul, inside the jitted program."""
+    from deeplearning4j_tpu.ops.quant_kernels import quantized_matmul
+    y = quantized_matmul(x, qt, acc_dtype=acc_dtype)
+    if b is not None:
+        y = y + b.astype(y.dtype)
+    return y
+
+
+def quantized_mha(x, w_qkv, w_out, n_heads: int, b_qkv=None, b_out=None,
+                  mask=None, causal=False, acc_dtype=None):
+    """Self-attention with all four projections served from int8 weights
+    (`w_qkv`: QTensor [F, 3F']; `w_out`: QTensor [F', F_out]) and the
+    score/softmax/value math in the accumulating dtype via
+    `fused_attention` — the quantized counterpart of the nn attention
+    layers' forward for serving."""
+    B, T, _ = x.shape
+    qkv = quantized_projection(x, w_qkv, b=b_qkv, acc_dtype=acc_dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    d = q.shape[-1] // n_heads
+
+    def heads(a):          # [B, T, H*D] -> [B, H, T, D]
+        return a.reshape(B, T, n_heads, d).transpose(0, 2, 1, 3)
+
+    o = fused_attention(heads(q), heads(k), heads(v), mask=mask,
+                        causal=causal)
+    o = o.transpose(0, 2, 1, 3).reshape(B, T, n_heads * d)
+    return quantized_projection(o, w_out, b=b_out, acc_dtype=acc_dtype)

@@ -234,3 +234,30 @@ def _c33_bwd(res, dy):
 
 
 conv3x3_same.defvjp(_c33_fwd, _c33_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Quantized inference conv (quant/ subsystem hot path)
+# ---------------------------------------------------------------------------
+
+def quantized_conv2d(x, qt, stride=(1, 1), padding="SAME",
+                     dilation=(1, 1), acc_dtype=None,
+                     feature_group_count=1):
+    """NHWC/HWIO conv against int8 weights with per-output-channel scales:
+    the conv consumes `qt.q` cast to the accumulating dtype and the scales
+    apply to the product — `conv(x, dequant(W)) == conv(x, W_q) * s[co]`
+    exactly, because each output channel is a sum over one channel's
+    weights only.  The int8 HWIO buffer is what stays device-resident;
+    no f32 copy of the filter exists in the compiled program."""
+    if qt.axis != qt.ndim - 1:
+        raise ValueError(
+            f"quantized_conv2d needs per-output-channel scales "
+            f"(axis={qt.ndim - 1}), got axis={qt.axis}")
+    acc = jnp.dtype(acc_dtype) if acc_dtype is not None else x.dtype
+    y = jax.lax.conv_general_dilated(
+        x.astype(acc), qt.q.astype(acc),
+        tuple(stride), padding, rhs_dilation=tuple(dilation),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=feature_group_count,
+        preferred_element_type=acc)
+    return y * qt.scale.astype(acc).reshape(1, 1, 1, -1)

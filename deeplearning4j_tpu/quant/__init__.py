@@ -1,0 +1,26 @@
+"""Post-training quantization: calibrate → int8/bf16 → serve
+(docs/quantization.md).
+
+The cuDNN→TVM argument (PAPERS.md): inference throughput lives in
+low-precision primitives, and quantized programs must be first-class
+compiled artifacts.  This package is the user surface:
+
+    calibrate   — observers (minmax / percentile-histogram) over a
+                  representative iterator → `CalibrationStats` (+ crc32
+                  for the executable-cache key)
+    ptq         — `quantize_model` → `QuantizedModel`: int8 per-channel
+                  weights with bf16 fallback for range-hostile tensors,
+                  served through the stock serving stack; the parity
+                  harness (`parity_check`) is the accuracy gate
+
+Kernels live in `ops.quant_kernels` (+ quantized conv/attention variants
+in their home modules); fingerprint folding in `compile.fingerprint`;
+fleet integration (`ModelFleet.quantize`, quantized-bytes residency
+accounting) in `serving.fleet`.
+"""
+from deeplearning4j_tpu.quant.calibrate import (  # noqa: F401
+    CalibrationStats, MinMaxObserver, PercentileObserver, calibrate)
+from deeplearning4j_tpu.quant.ptq import (  # noqa: F401
+    QuantConfig, QuantizedModel, parity_check, quantize_model)
+from deeplearning4j_tpu.ops.quant_kernels import (  # noqa: F401
+    QTensor, dequantize, quantize_tensor)

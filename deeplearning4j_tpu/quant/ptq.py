@@ -1,0 +1,364 @@
+"""Post-training quantizer: f32 model -> int8/bf16 `QuantizedModel`.
+
+`quantize_model` walks a model's parameter tree and rewrites every
+weight-bearing leaf into one of three forms:
+
+- **int8 `QTensor`** (the normal case): symmetric per-output-channel
+  scales, 4x smaller resident than f32 — the bytes the fleet's warm-pool
+  accounting gets back.
+- **bf16 fallback** for range-hostile tensors: when a channel's typical
+  magnitude falls below one int8 quantization step
+  (`ops.quant_kernels.range_hostility` > threshold), int8 would zero out
+  most of the channel's mass; bf16 keeps f32's dynamic range at half the
+  bytes.
+- **untouched** for small/1-D leaves (biases, norm gains): quantizing
+  them saves nothing and costs accuracy.
+
+The wrapper, `QuantizedModel`, is a serving-shaped model: it exposes
+`conf` / `params_` / `state_` and a `_forward(params, state, x,
+train=, rng=)` with the exact contract `serving.compile_cache._forward_fn`
+dispatches on, so the whole serving stack (ModelServer, BucketedCompileCache,
+ModelFleet) serves it unmodified.  Its forward dequantizes *inside the
+jitted program* into the accumulating dtype (`compute_dtype` when the base
+model configured one): dense-family layers take the fused
+`quantized_matmul` hot path (scale applied after the matmul, optionally
+int8x-int8 with static calibration scales), everything else dequantizes
+its layer params and runs the stock layer apply — either way the int8
+buffers are the ones resident on device.
+
+`QuantizedModel.quant_fingerprint()` feeds
+`compile.fingerprint.model_fingerprint`: quant config + calibration-stat
+crc32 + the per-leaf dtype report fold into the executable-cache key, so
+f32 and int8 programs can never collide on one persisted artifact and a
+warm restart of a quantized server stays zero-compile.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.ops.quant_kernels import (
+    QTensor, dequantize, quantize_tensor, quantized_dense,
+    quantized_matmul_static, range_hostility)
+from deeplearning4j_tpu.quant.calibrate import CalibrationStats
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantConfig:
+    """Knobs for `quantize_model` (docs/quantization.md has the table)."""
+
+    dtype: str = "int8"              # target weight dtype
+    fallback_dtype: str = "bfloat16"  # range-hostile escape hatch
+    hostility_threshold: float = 127.0  # range_hostility above -> fallback
+    min_ndim: int = 2                # 1-D leaves (biases, gains) stay f32
+    min_size: int = 256              # tiny leaves stay f32
+    quantize_activations: bool = False  # static int8 input scales (MLN)
+    acc_dtype: Optional[str] = None  # accumulator; default compute_dtype/f32
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+
+def _leaf_plan(leaf, config: QuantConfig) -> str:
+    """Which form this leaf takes: 'int8' | 'bf16' | 'keep'."""
+    if isinstance(leaf, QTensor):
+        raise ValueError("model is already quantized")
+    dt = getattr(leaf, "dtype", None)
+    if dt is None or not np.issubdtype(np.dtype(dt), np.floating):
+        return "keep"
+    shape = np.shape(leaf)
+    if len(shape) < config.min_ndim or np.prod(shape) < config.min_size:
+        return "keep"
+    if range_hostility(leaf) > config.hostility_threshold:
+        return "bf16"
+    return "int8"
+
+
+def _quantize_tree(tree, config: QuantConfig):
+    """Rewrite a params pytree; returns (new_tree, report) where report
+    maps leaf path -> produced dtype."""
+    import jax
+    import jax.numpy as jnp
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    report: Dict[str, str] = {}
+    leaves = []
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        plan = _leaf_plan(leaf, config)
+        if plan == "int8":
+            leaves.append(quantize_tensor(leaf, axis=-1))
+            report[key] = "int8"
+        elif plan == "bf16":
+            leaves.append(jnp.asarray(leaf, jnp.dtype(config.fallback_dtype)))
+            report[key] = config.fallback_dtype
+        else:
+            leaves.append(leaf)
+            report[key] = str(getattr(leaf, "dtype", type(leaf).__name__))
+    return jax.tree_util.tree_unflatten(treedef, leaves), report
+
+
+def _deq_tree(tree, dtype):
+    """Dequantize every QTensor (and cast floating leaves) to `dtype` —
+    traced, so inside a jit this is the in-program dequantization."""
+    import jax
+    import jax.numpy as jnp
+
+    def deq(v):
+        if isinstance(v, QTensor):
+            return dequantize(v, dtype)
+        if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
+            return v.astype(dtype)
+        return v
+    return jax.tree_util.tree_map(
+        deq, tree, is_leaf=lambda v: isinstance(v, QTensor))
+
+
+def _tree_bytes(tree) -> int:
+    import jax
+    return sum(int(getattr(leaf, "nbytes", 0) or 0)
+               for leaf in jax.tree_util.tree_leaves(tree))
+
+
+class QuantizedModel:
+    """Serving-shaped wrapper holding a quantized parameter tree.
+
+    Wraps a MultiLayerNetwork, a single-input ComputationGraph, or an
+    imported SameDiff graph (ONNX).  `params_` contains `QTensor` /
+    bf16 leaves; `_forward` matches the serving contract and never
+    widens past the accumulating dtype."""
+
+    is_quantized = True
+
+    def __init__(self, base, params, config: QuantConfig,
+                 calibration: Optional[CalibrationStats],
+                 report: Dict[str, str]):
+        self.base = base
+        self.conf = getattr(base, "conf", None)
+        self.params_ = params
+        self.state_ = getattr(base, "state_", None) or {}
+        self.config = config
+        self.calibration = calibration
+        self.report = report
+        self._device_norm = getattr(base, "_device_norm", None)
+        self._output_fn = None
+        if hasattr(base, "_cast_compute") and \
+                getattr(self.conf, "layers", None) is not None:
+            self.kind = "mln"
+        elif hasattr(base, "_as_input_dict"):
+            self.kind = "graph"
+        elif hasattr(base, "_nodes"):
+            self.kind = "samediff"
+        else:
+            raise TypeError(
+                f"cannot serve a quantized {type(base).__name__}: need a "
+                "MultiLayerNetwork, ComputationGraph or SameDiff model")
+        if self.kind == "samediff":
+            from deeplearning4j_tpu.autodiff.samediff import RNG_FEED
+            nodes = base._nodes
+            self._sd_inputs = [n for n, node in nodes.items()
+                               if node.kind == "placeholder"
+                               and n != RNG_FEED]
+            consumed = {i for node in nodes.values() if node.kind == "op"
+                        for i in node.inputs}
+            self._sd_outputs = [n for n, node in nodes.items()
+                                if node.kind == "op" and n not in consumed]
+
+    # ---- dtype plumbing ----
+    def acc_dtype(self):
+        """The accumulating dtype every matmul/dequantize lands in: the
+        configured override, else the base model's compute_dtype, else
+        f32.  Nothing in the compiled forward widens past it."""
+        import jax.numpy as jnp
+        if self.config.acc_dtype is not None:
+            return jnp.dtype(self.config.acc_dtype)
+        cd = getattr(self.conf, "compute_dtype", None)
+        return jnp.dtype(cd) if cd is not None else jnp.dtype(jnp.float32)
+
+    # ---- forward (the serving contract) ----
+    def _forward(self, params, state, x, *, train: bool = False,
+                 rng=None, mask=None) -> Tuple[Any, Any]:
+        if self.kind == "mln":
+            return self._forward_mln(params, state, x, mask=mask)
+        if self.kind == "graph":
+            names = list(self.conf.network_inputs)
+            if len(names) != 1:
+                raise ValueError(
+                    f"quantized serving handles single-input graphs; this "
+                    f"one has inputs {names}")
+            deq = _deq_tree(params, np.float32)
+            acts, st = self.base._forward(deq, state, {names[0]: x},
+                                          train=False, rng=None)
+            return acts[self.conf.network_outputs[0]], st
+        # samediff
+        if len(self._sd_inputs) != 1 or len(self._sd_outputs) < 1:
+            raise ValueError(
+                f"quantized serving needs one placeholder and at least "
+                f"one output; graph has inputs {self._sd_inputs}, "
+                f"outputs {self._sd_outputs}")
+        deq = _deq_tree(params, np.float32)
+        out = self.base._eval_graph({self._sd_inputs[0]: x}, deq,
+                                    [self._sd_outputs[0]])
+        return out[self._sd_outputs[0]], state
+
+    def _forward_mln(self, params, state, x, mask=None):
+        from deeplearning4j_tpu.nn.layers import DenseLayer
+        import jax.numpy as jnp
+        acc = self.acc_dtype()
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(acc)
+        new_state = dict(state)
+        for i, layer in enumerate(self.conf.layers):
+            name = self.conf.layer_name(i)
+            lp = params[name]
+            w = lp.get("W") if isinstance(lp, dict) else None
+            if isinstance(layer, DenseLayer) and isinstance(w, QTensor):
+                # fused hot path: int8 matmul, scale applied post-matmul
+                if x.ndim > 2 and not layer._is_recurrent_input(x):
+                    x = x.reshape(x.shape[0], -1)
+                b = lp.get("b")
+                akey = f"{name}:in"
+                if (self.config.quantize_activations
+                        and self.calibration is not None
+                        and akey in self.calibration.ranges):
+                    y = quantized_matmul_static(
+                        x, w, self.calibration.scale(akey), acc_dtype=acc)
+                    if b is not None:
+                        y = y + b.astype(acc)
+                else:
+                    y = quantized_dense(x, w, b, acc_dtype=acc)
+                x = layer.act_fn()(y)
+            else:
+                deq = _deq_tree(lp, acc)
+                x, s = layer.apply(deq, state[name], x, train=False,
+                                   rng=None, mask=mask)
+                new_state[name] = s
+        return x, new_state
+
+    # ---- convenience inference ----
+    def output(self, x):
+        """Jitted quantized inference (one executable per call signature,
+        via jit's own cache)."""
+        import jax
+        import jax.numpy as jnp
+        if self._output_fn is None:
+            def f(p, s, xv):
+                return self._forward(p, s, xv, train=False, rng=None)[0]
+            self._output_fn = jax.jit(f)
+        return self._output_fn(self.params_, self.state_, jnp.asarray(x))
+
+    # ---- identity / accounting ----
+    def quant_fingerprint(self) -> Dict[str, Any]:
+        """The quant component `compile.fingerprint.model_fingerprint`
+        folds into the executable-cache key: config + calibration crc +
+        the per-leaf dtype plan.  Distinct from (and absent in) the f32
+        base model's fingerprint by construction."""
+        return {
+            "config": json.loads(self.config.to_json()),
+            "calibration_crc": (self.calibration.crc32()
+                                if self.calibration is not None else None),
+            "report": dict(sorted(self.report.items())),
+            "base_class": type(self.base).__name__,
+        }
+
+    def bytes_resident(self) -> int:
+        """Bytes the quantized params+state occupy (int8 + scales)."""
+        return _tree_bytes(self.params_) + _tree_bytes(self.state_)
+
+    def dominant_dtype(self) -> str:
+        n_int8 = sum(1 for v in self.report.values() if v == "int8")
+        n_fb = sum(1 for v in self.report.values()
+                   if v == self.config.fallback_dtype)
+        return "int8" if n_int8 >= n_fb else self.config.fallback_dtype
+
+    def describe(self) -> Dict[str, Any]:
+        counts: Dict[str, int] = {}
+        for v in self.report.values():
+            counts[v] = counts.get(v, 0) + 1
+        return {
+            "kind": self.kind,
+            "dtypes": counts,
+            "bytes_resident": self.bytes_resident(),
+            "acc_dtype": str(self.acc_dtype()),
+            "calibration": (self.calibration.to_dict()
+                            if self.calibration is not None else None),
+        }
+
+
+def quantize_model(model, calibration: Optional[CalibrationStats] = None,
+                   config: Optional[QuantConfig] = None) -> QuantizedModel:
+    """Quantize a trained/imported model for inference.  Pure function of
+    (weights, calibration, config) — quantizing the same model twice
+    yields bit-identical `QTensor`s, which is what keeps the executable
+    fingerprint stable across processes (the warm-restart contract)."""
+    if getattr(model, "is_quantized", False):
+        raise ValueError("model is already quantized")
+    config = config if config is not None else QuantConfig()
+    params = getattr(model, "params_", None)
+    if params is None:
+        params = getattr(model, "variables_", None)
+    if params is None:
+        raise TypeError(
+            f"{type(model).__name__} has no params_/variables_ to quantize")
+    f32_bytes = _tree_bytes(params)
+    qparams, report = _quantize_tree(params, config)
+    qm = QuantizedModel(model, qparams, config, calibration, report)
+    saved = f32_bytes - _tree_bytes(qparams)
+    from deeplearning4j_tpu.monitor.instrument import quant_instruments
+    quant_instruments().record_model(qm.dominant_dtype(), max(saved, 0))
+    return qm
+
+
+# ---------------------------------------------------------------------------
+# parity harness
+# ---------------------------------------------------------------------------
+
+def _base_forward(model, x) -> np.ndarray:
+    """f32 reference forward for any of the three servable model kinds."""
+    import jax.numpy as jnp
+    if getattr(model, "is_quantized", False):
+        return np.asarray(model.output(x))
+    if hasattr(model, "_as_input_dict"):            # ComputationGraph
+        names = list(model.conf.network_inputs)
+        acts, _ = model._forward(model.params_, model.state_,
+                                 {names[0]: jnp.asarray(x)},
+                                 train=False, rng=None)
+        return np.asarray(acts[model.conf.network_outputs[0]])
+    if hasattr(model, "_nodes"):                    # SameDiff
+        from deeplearning4j_tpu.autodiff.samediff import RNG_FEED
+        consumed = {i for node in model._nodes.values()
+                    if node.kind == "op" for i in node.inputs}
+        outs = [n for n, node in model._nodes.items()
+                if node.kind == "op" and n not in consumed]
+        ins = [n for n, node in model._nodes.items()
+               if node.kind == "placeholder" and n != RNG_FEED]
+        return np.asarray(model.output({ins[0]: x}, outs[0])[outs[0]])
+    return np.asarray(model._forward(model.params_, model.state_,
+                                     jnp.asarray(x), train=False,
+                                     rng=None)[0])
+
+
+def parity_check(base, quantized: QuantizedModel, x,
+                 task: str = "auto") -> Dict[str, Any]:
+    """f32-vs-quantized accuracy delta on one batch: top-1 disagreement
+    for classification-shaped outputs, relative L2 error otherwise.
+    Records the `quant_accuracy_delta` gauge; the acceptance gate is
+    delta <= 0.01 (1%)."""
+    ref = np.asarray(_base_forward(base, x), np.float32)
+    got = np.asarray(quantized.output(x), np.float32)
+    if got.shape != ref.shape:
+        raise ValueError(
+            f"parity shape mismatch: f32 {ref.shape} vs quant {got.shape}")
+    if task == "auto":
+        task = ("classification"
+                if ref.ndim == 2 and ref.shape[-1] > 1 else "regression")
+    if task == "classification":
+        delta = float(np.mean(np.argmax(ref, -1) != np.argmax(got, -1)))
+    else:
+        denom = float(np.linalg.norm(ref)) or 1.0
+        delta = float(np.linalg.norm(got - ref)) / denom
+    from deeplearning4j_tpu.monitor.instrument import quant_instruments
+    quant_instruments().record_accuracy_delta(delta)
+    return {"task": task, "delta": delta, "n": int(ref.shape[0])}

@@ -70,7 +70,14 @@ def bucket_for(n: int, max_batch: int, min_bucket: int = 1) -> int:
 def _forward_fn(model) -> Callable:
     """Pure (params, state, x) -> output forward for the model kinds the
     registry serves.  MultiLayerNetwork returns its head output;
-    single-input ComputationGraph returns its first network output."""
+    single-input ComputationGraph returns its first network output.
+
+    A `quant.QuantizedModel` takes the default branch regardless of what
+    it wraps: its `_forward` IS the fused quantized inference step
+    (int8 params in, dequantize-in-program), and its fingerprint — which
+    keys the persistent tier via `_disk_parts` — folds the quant config +
+    calibration crc32s, so int8 and f32 executables of the same
+    architecture live under distinct disk keys."""
     if hasattr(model, "_as_input_dict"):          # ComputationGraph
         names = list(model.conf.network_inputs)
         if len(names) != 1:

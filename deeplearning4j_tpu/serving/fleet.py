@@ -745,6 +745,35 @@ class ModelFleet:
         with self._admission_lock:
             return self.pool.evict(member, reason=reason)
 
+    def quantize(self, name: str, calibration=None, config=None,
+                 version: Optional[int] = None):
+        """Re-admit a fleet member quantized: quantize its newest
+        registered version, roll the `QuantizedModel` in as the next
+        version (new submits serve int8, in-flight requests finish on
+        f32), warm its buckets on every live replica, then demote the
+        f32 predecessors' device buffers to host.  All under the
+        per-name version lock, so the PR 8 WarmPool eviction path can
+        never tear the roll apart — and the member's residency cost
+        drops to the int8 bytes (`resident_bytes` skips host-demoted
+        versions)."""
+        member = self.member(name)
+        with self.registry.name_lock(name):
+            old_entries = self.registry.entries(name)
+            entry = self.registry.register_quantized(
+                name, calibration=calibration, config=config,
+                version=version)
+            group = member.group
+            if member.state == "resident" and group is not None \
+                    and self.warmup and entry.input_shape is not None:
+                for replica in group.snapshot():
+                    self.registry.warmup(name, replica.server.cache,
+                                         version=entry.version,
+                                         input_shape=entry.input_shape)
+            for old in old_entries:     # f32 predecessors off the device
+                _to_host(old.model)
+        self._note_resident_bytes()
+        return entry
+
     def set_default_schedule(self, schedule) -> "ModelFleet":
         """Install a fleet-default `compile.Schedule`, applied on
         admission to members that have no per-model schedule (the
@@ -860,7 +889,10 @@ class ModelFleet:
     def resident_bytes(self) -> int:
         """Device bytes held by resident models' params/state — the
         memory the warm pool is budgeting (peak tracked across
-        admissions)."""
+        admissions).  Counts only device-placed buffers: versions pulled
+        back to host numpy (an evicted entry, or the f32 predecessor a
+        `quantize()` roll demoted) cost no device memory, so a quantized
+        member is budgeted at its int8 bytes, not its old f32 bytes."""
         import jax
         total = 0
         for m in self.pool.resident():
@@ -868,6 +900,8 @@ class ModelFleet:
                 for tree in (getattr(entry.model, "params_", None),
                              getattr(entry.model, "state_", None)):
                     for leaf in jax.tree_util.tree_leaves(tree):
+                        if isinstance(leaf, np.ndarray):   # host-demoted
+                            continue
                         total += getattr(leaf, "nbytes", 0) or 0
         return total
 

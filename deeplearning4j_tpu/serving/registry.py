@@ -144,6 +144,27 @@ class ModelRegistry:
         model = import_onnx_model(src, trainable=False)
         return self.register(name, model, version=version, source="onnx")
 
+    def register_quantized(self, name: str, calibration=None, config=None,
+                           base_version: Optional[int] = None,
+                           version: Optional[int] = None) -> ModelEntry:
+        """Quantized-version roll: quantize an already-registered version
+        (the newest, unless `base_version` is given) and register the
+        `QuantizedModel` as the next version of the same name.  Because
+        `get(name)` resolves the highest version, new submits serve int8
+        while in-flight requests finish on the f32 entry they resolved —
+        the stock zero-downtime roll, with a dtype change instead of a
+        weight change.  Runs under the per-name version lock like any
+        other roll."""
+        from deeplearning4j_tpu.quant import quantize_model
+        with self.name_lock(name):
+            base = self.get(name, base_version)
+            qm = quantize_model(base.model, calibration=calibration,
+                                config=config)
+            return self.register(
+                name, qm, version=version, source="quant",
+                input_shape=base.input_shape,
+                input_dtype=base.input_dtype)
+
     # ---- resolution ----
     def get(self, name: str, version: Optional[int] = None) -> ModelEntry:
         with self._lock:

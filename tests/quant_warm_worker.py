@@ -1,0 +1,61 @@
+"""Subprocess worker for test_quant.test_quantized_warm_restart_subprocess.
+
+Builds a deterministic MLN, calibrates + quantizes it, and serves it
+through a `BucketedCompileCache` backed by the persistent executable
+cache at $DL4J_TPU_TEST_CACHE.  Prints one JSON line: cache stats, the
+f32 and quantized model fingerprints, and an output checksum.  Run twice
+against the same directory, the second run must report 0 compiles — the
+quantized executables round-tripping the persistent AOT tier — and the
+identical fingerprints/checksum (quantization is a pure function of
+weights + calibration + config).
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.compile import (PersistentExecutableCache,  # noqa: E402
+                                        model_fingerprint)
+from deeplearning4j_tpu.nn import (DenseLayer, InputType,  # noqa: E402
+                                   MultiLayerNetwork, NeuralNetConfiguration,
+                                   OutputLayer)
+from deeplearning4j_tpu.quant import calibrate, quantize_model  # noqa: E402
+from deeplearning4j_tpu.serving import BucketedCompileCache  # noqa: E402
+from deeplearning4j_tpu.train.updaters import Sgd  # noqa: E402
+
+
+def main():
+    cache = PersistentExecutableCache(os.environ["DL4J_TPU_TEST_CACHE"])
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(1e-1))
+            .list([DenseLayer(n_out=32, activation="relu"),
+                   OutputLayer(n_out=3, loss="mcxent",
+                               activation="softmax")])
+            .set_input_type(InputType.feed_forward(8)).build())
+    net = MultiLayerNetwork(conf).init()
+
+    rs = np.random.RandomState(0)
+    calib = [rs.randn(8, 8).astype(np.float32) for _ in range(4)]
+    stats = calibrate(net, calib, observer="percentile", percentile=99.5)
+    qm = quantize_model(net, calibration=stats)
+
+    scache = BucketedCompileCache(max_batch=8, persistent=cache)
+    scache.warmup("q:v1", qm, (8,), np.float32)
+    out = scache.run("q:v1", qm, rs.randn(5, 8).astype(np.float32))
+
+    print(json.dumps({
+        "compiles": cache.stats["compiles"],
+        "disk_hits": cache.stats["disk_hits"],
+        "stores": cache.stats["stores"],
+        "fp_f32": model_fingerprint(net),
+        "fp_quant": model_fingerprint(qm),
+        "calibration_crc": stats.crc32(),
+        "checksum": float(np.asarray(out, np.float64).sum()),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

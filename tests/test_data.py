@@ -390,6 +390,48 @@ def test_analyze_local_column_stats():
     assert "x (double)" in str(an)
 
 
+def test_histogram_percentile_matches_numpy():
+    from deeplearning4j_tpu.data import Histogram
+    rs = np.random.RandomState(0)
+    data = rs.randn(50_000)
+    h = Histogram(data.min(), data.max(), bins=2048)
+    h.add(data[:20_000])
+    h.add(data[20_000:])                       # streaming accumulation
+    assert h.total == 50_000
+    for p in (1.0, 25.0, 50.0, 99.0, 99.9):
+        want = np.percentile(data, p)
+        # binned estimate: within one bucket width of the exact value
+        assert abs(h.percentile(p) - want) < 2 * h.bin_width, p
+    # edges clip, never drop
+    h.add(np.array([data.min() - 100.0, data.max() + 100.0]))
+    assert h.total == 50_002
+
+
+def test_histogram_degenerate_range():
+    from deeplearning4j_tpu.data import Histogram
+    h = Histogram(2.0, 2.0, bins=16)           # constant column
+    h.add(np.full(10, 2.0))
+    assert abs(h.percentile(50.0) - 2.0) < 1e-6
+
+
+def test_analyze_local_histogram_bins():
+    from deeplearning4j_tpu.data import AnalyzeLocal
+    from deeplearning4j_tpu.data.transform import Schema
+    schema = Schema.builder().add_column_double("x").build()
+    rs = np.random.RandomState(1)
+    vals = rs.uniform(-10.0, 10.0, 2000)
+    records = [[float(v)] for v in vals]
+    an = AnalyzeLocal.analyze(schema, records, histogram_bins=256)
+    xa = an.get_column_analysis("x")
+    assert xa.histogram is not None and xa.histogram.total == 2000
+    assert abs(xa.percentile(50.0) - np.percentile(vals, 50.0)) < 0.5
+    assert abs(xa.percentile(99.0) - np.percentile(vals, 99.0)) < 0.5
+    # without histogram_bins, percentile() is an explicit error
+    plain = AnalyzeLocal.analyze(schema, records)
+    with pytest.raises(ValueError, match="histogram"):
+        plain.get_column_analysis("x").percentile(50.0)
+
+
 # ---------------------------------------------------------------------------
 # Audio readers (reference datavec-data-audio): tests author real PCM WAV
 # files with the stdlib wave module and read them back.

@@ -631,6 +631,32 @@ def test_fleet_instruments_record_admissions(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# quantized re-admission (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+def test_fleet_quantize_rolls_and_shrinks_residency(tmp_path):
+    """`fleet.quantize(name)` rolls a QuantizedModel in as the next
+    version and demotes the f32 predecessor to host — warm-pool memory
+    accounting drops to the int8 bytes while outputs stay equivalent."""
+    from deeplearning4j_tpu.quant import QuantizedModel
+    with _fleet(tmp_path) as fleet:
+        fleet.deploy("m", _net(hidden=128))
+        before_out = fleet.output("m", _x())
+        before_bytes = fleet.resident_bytes()
+        entry = fleet.quantize("m")
+        assert entry.source == "quant" and entry.version == 2
+        assert isinstance(entry.model, QuantizedModel)
+        assert fleet.registry.versions("m") == [1, 2]
+        after_bytes = fleet.resident_bytes()
+        assert after_bytes < before_bytes / 2, (before_bytes, after_bytes)
+        after_out = fleet.output("m", _x())          # served by v2 (int8)
+        np.testing.assert_allclose(after_out, before_out,
+                                   rtol=5e-2, atol=5e-3)
+        assert np.argmax(after_out, -1).tolist() == \
+            np.argmax(before_out, -1).tolist()
+
+
+# ---------------------------------------------------------------------------
 # slow: long-tail soak
 # ---------------------------------------------------------------------------
 

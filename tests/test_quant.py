@@ -1,0 +1,431 @@
+"""Quantized inference subsystem (ISSUE 10 acceptance): int8 kernel
+conformance, calibration observers, bf16 fallback for range-hostile
+tensors, f32-vs-quantized parity over the import-corpus model shapes,
+dtype plumbing under `compute_dtype` mixed precision and TP sharding
+rules (lowered-program dtype checks — no silent f32 upcast), serving
+integration (compile cache, registry quantized-version roll), distinct
+f32/int8 executable fingerprints, and the cross-process warm-restart
+round trip through the persistent AOT cache."""
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.compile import model_fingerprint
+from deeplearning4j_tpu.nn import (ComputationGraph, DenseLayer,
+                                   GraphBuilder, InputType,
+                                   MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.ops.attention_kernels import (mha_reference,
+                                                      quantized_mha,
+                                                      quantized_projection)
+from deeplearning4j_tpu.ops.conv_kernels import quantized_conv2d
+from deeplearning4j_tpu.ops.quant_kernels import (QTensor, dequantize,
+                                                  quantization_error,
+                                                  quantize_tensor,
+                                                  quantized_matmul,
+                                                  quantized_matmul_static,
+                                                  range_hostility)
+from deeplearning4j_tpu.quant import (CalibrationStats, MinMaxObserver,
+                                      PercentileObserver, QuantConfig,
+                                      QuantizedModel, calibrate,
+                                      parity_check, quantize_model)
+from deeplearning4j_tpu.train.updaters import Sgd
+
+rs = np.random.RandomState(7)
+
+
+def _mlp(seed=0, n_in=32, hidden=64, n_out=10, compute_dtype=None):
+    b = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(1e-1)))
+    if compute_dtype:
+        b = b.compute_dtype(compute_dtype)
+    conf = (b.list([DenseLayer(n_out=hidden, activation="relu"),
+                    DenseLayer(n_out=hidden, activation="relu"),
+                    OutputLayer(n_out=n_out, loss="mcxent",
+                                activation="softmax")])
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _fit(net, n=64, steps=3, n_in=32, n_out=10, seed=0):
+    r = np.random.RandomState(seed)
+    x = r.randn(n, n_in).astype(np.float32)
+    y = np.eye(n_out, dtype=np.float32)[r.randint(0, n_out, n)]
+    for _ in range(steps):
+        net.fit(x, y)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+def test_quantize_dequantize_roundtrip():
+    w = rs.randn(64, 48).astype(np.float32)
+    qt = quantize_tensor(w)
+    assert qt.q.dtype == jnp.int8 and qt.scale.shape == (1, 48)
+    deq = np.asarray(dequantize(qt))
+    # symmetric per-channel int8: worst-case error is half a step
+    step = np.asarray(qt.scale)
+    assert np.all(np.abs(deq - w) <= 0.5 * step + 1e-7)
+    assert quantization_error(w) < 0.01
+
+
+def test_qtensor_is_a_pytree():
+    qt = quantize_tensor(rs.randn(8, 16).astype(np.float32))
+    leaves = jax.tree_util.tree_leaves(qt)
+    assert len(leaves) == 2                      # q + scale travel as leaves
+    doubled = jax.tree_util.tree_map(lambda a: a, qt)
+    assert isinstance(doubled, QTensor) and doubled.axis == qt.axis
+    assert qt.nbytes == qt.q.nbytes + qt.scale.nbytes
+
+
+def test_quantized_matmul_matches_dequantized():
+    x = rs.randn(16, 64).astype(np.float32)
+    w = rs.randn(64, 32).astype(np.float32)
+    qt = quantize_tensor(w)
+    want = x @ np.asarray(dequantize(qt))
+    got = np.asarray(quantized_matmul(jnp.asarray(x), qt))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    # 3-D (time-distributed / attention projection shape)
+    x3 = rs.randn(4, 7, 64).astype(np.float32)
+    got3 = np.asarray(quantized_projection(jnp.asarray(x3), qt))
+    np.testing.assert_allclose(got3, x3 @ np.asarray(dequantize(qt)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_quantized_matmul_static_int8_activations():
+    x = rs.uniform(-3, 3, (16, 64)).astype(np.float32)
+    w = rs.randn(64, 32).astype(np.float32)
+    qt = quantize_tensor(w)
+    got = np.asarray(quantized_matmul_static(jnp.asarray(x), qt,
+                                             x_scale=3.0 / 127.0))
+    rel = np.linalg.norm(got - x @ w) / np.linalg.norm(x @ w)
+    assert rel < 0.02, rel
+
+
+def test_quantized_conv2d_matches_dequantized():
+    x = rs.randn(2, 8, 8, 3).astype(np.float32)
+    w = rs.randn(3, 3, 3, 8).astype(np.float32)
+    qt = quantize_tensor(w)           # HWIO, per-output-channel
+    want = jax.lax.conv_general_dilated(
+        jnp.asarray(x), dequantize(qt), (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    got = quantized_conv2d(jnp.asarray(x), qt)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_quantized_mha_close_to_f32():
+    B, T, F, H = 2, 6, 32, 4
+    x = rs.randn(B, T, F).astype(np.float32)
+    w_qkv = rs.randn(F, 3 * F).astype(np.float32) * 0.2
+    w_out = rs.randn(F, F).astype(np.float32) * 0.2
+    got = np.asarray(quantized_mha(jnp.asarray(x), quantize_tensor(w_qkv),
+                                   quantize_tensor(w_out), n_heads=H))
+    qkv = x @ w_qkv
+    q, k, v = np.split(qkv, 3, axis=-1)
+    heads = lambda a: a.reshape(B, T, H, F // H).transpose(0, 2, 1, 3)
+    o = np.asarray(mha_reference(*(jnp.asarray(heads(a))
+                                   for a in (q, k, v))))
+    want = o.transpose(0, 2, 1, 3).reshape(B, T, F) @ w_out
+    rel = np.linalg.norm(got - want) / np.linalg.norm(want)
+    assert rel < 0.03, rel
+
+
+def test_range_hostility_flags_sub_step_mass():
+    ok = rs.randn(32, 32).astype(np.float32)
+    assert range_hostility(ok) < 127.0
+    hostile = np.full((512, 32), 1e-5, np.float32)
+    hostile[0, 0] = 10.0                     # channel mass below one step
+    assert range_hostility(hostile) > 127.0
+
+
+# ---------------------------------------------------------------------------
+# calibration
+# ---------------------------------------------------------------------------
+
+def test_minmax_vs_percentile_observer():
+    data = np.concatenate([rs.uniform(-1, 1, 10_000),
+                           [1000.0]])         # one outlier
+    mm = MinMaxObserver()
+    mm.observe(data)
+    assert mm.range()[1] == 1000.0
+    po = PercentileObserver(percentile=99.9)
+    po.observe(data, phase=0)
+    po.observe(data, phase=1)
+    lo, hi = po.range()
+    assert hi < 5.0 and lo < -0.9            # outlier tail clipped
+
+
+def test_calibration_stats_crc_is_stable_and_sensitive():
+    a = CalibrationStats({"l0:in": (-1.0, 1.0), "l1:in": (0.0, 2.0)})
+    b = CalibrationStats({"l1:in": (0.0, 2.0), "l0:in": (-1.0, 1.0)})
+    assert a.crc32() == b.crc32()            # order-insensitive
+    c = CalibrationStats({"l0:in": (-1.0, 1.0), "l1:in": (0.0, 2.5)})
+    assert a.crc32() != c.crc32()
+    rt = CalibrationStats.from_dict(a.to_dict())
+    assert rt.crc32() == a.crc32()
+
+
+def test_calibrate_mln_collects_per_layer_ranges_and_metric():
+    from deeplearning4j_tpu.monitor.instrument import quant_instruments
+    net = _mlp()
+    x = _fit(net)
+    before = quant_instruments().calibration_batches.value
+    stats = calibrate(net, [x[:16], x[16:32]], observer="percentile")
+    assert {"layer_0:in", "layer_1:in", "layer_2:in",
+            "__output__"} <= set(stats.ranges)
+    assert stats.batches == 2
+    # percentile observers replay the iterator: both passes count
+    assert quant_instruments().calibration_batches.value - before == 4
+    lo, hi = stats.range("layer_0:in")
+    assert lo < 0 < hi
+
+
+# ---------------------------------------------------------------------------
+# parity over the import-corpus model shapes
+# ---------------------------------------------------------------------------
+
+def test_mln_parity_within_one_percent():
+    net = _mlp()
+    x = _fit(net)
+    stats = calibrate(net, x)
+    qm = quantize_model(net, calibration=stats)
+    assert qm.dominant_dtype() == "int8"
+    r = parity_check(net, qm, x)
+    assert r["task"] == "classification" and r["delta"] <= 0.01, r
+    # static int8 activations stay within the same gate
+    q2 = quantize_model(net, calibration=stats,
+                        config=QuantConfig(quantize_activations=True))
+    assert parity_check(net, q2, x)["delta"] <= 0.01
+
+
+def test_graph_parity_within_one_percent():
+    conf = (GraphBuilder().seed(0).updater(Sgd(1e-1))
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=48, activation="relu"), "in")
+            .add_layer("d2", DenseLayer(n_out=48, activation="relu"), "d1")
+            .add_layer("out", OutputLayer(n_out=5, loss="mcxent",
+                                          activation="softmax"), "d2")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(16)).build())
+    cg = ComputationGraph(conf).init()
+    x = rs.randn(32, 16).astype(np.float32)
+    qm = quantize_model(cg)
+    assert qm.kind == "graph"
+    assert parity_check(cg, qm, x)["delta"] <= 0.01
+
+
+def test_onnx_import_parity_within_one_percent():
+    """ONNX corpus shape: Gemm -> Relu -> Gemm authored with the in-repo
+    onnx_proto codec, imported to SameDiff, quantized, parity-checked."""
+    from deeplearning4j_tpu.modelimport.onnx_import import import_onnx_model
+    from tests.test_onnx_import import _N, _model, _vi
+
+    r = np.random.RandomState(3)
+    w1 = r.randn(16, 32).astype(np.float32) * 0.3
+    b1 = np.zeros(32, np.float32)
+    w2 = r.randn(32, 8).astype(np.float32) * 0.3
+    b2 = np.zeros(8, np.float32)
+    x = r.randn(12, 16).astype(np.float32)
+    nodes = [_N("Gemm", ["x", "w1", "b1"], ["h"]),
+             _N("Relu", ["h"], ["a"]),
+             _N("Gemm", ["a", "w2", "b2"], ["y"])]
+    model = _model(nodes, [_vi("x", x.shape)], [_vi("y", ())],
+                   {"w1": w1, "b1": b1, "w2": w2, "b2": b2})
+    sd = import_onnx_model(model)
+    qm = quantize_model(sd)
+    assert qm.kind == "samediff"
+    ref = np.asarray(sd.output({"x": x}, "y")["y"])
+    got = np.asarray(qm.output(x))
+    rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+    assert rel <= 0.01, rel
+    # regression-style parity through the shared harness
+    assert parity_check(sd, qm, x)["delta"] <= 0.01
+
+
+@pytest.mark.slow
+def test_keras_import_parity_within_one_percent(tmp_path):
+    """Keras corpus shape: sequential dense import -> quantize -> parity."""
+    tf = pytest.importorskip("tensorflow")
+    from deeplearning4j_tpu.modelimport import KerasModelImport
+    km = tf.keras.Sequential([
+        tf.keras.layers.Input((12,)),
+        tf.keras.layers.Dense(32, activation="relu"),
+        tf.keras.layers.Dense(6, activation="softmax")])
+    r = np.random.RandomState(5)   # keras global-seed init is not stable
+    km.set_weights([r.randn(*w.shape).astype(np.float32) * 0.3
+                    for w in km.get_weights()])
+    p = str(tmp_path / "m.h5")
+    km.save(p)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(p)
+    x = r.randn(64, 12).astype(np.float32)
+    qm = quantize_model(net, calibration=calibrate(net, x))
+    # untrained import: logits are near-tied, so gate on relative L2
+    # (the trained-model top-1 gate is test_mln_parity_within_one_percent)
+    assert parity_check(net, qm, x, task="regression")["delta"] <= 0.01
+
+
+def test_bf16_fallback_for_range_hostile_layer():
+    net = _mlp(hidden=256)
+    _fit(net)
+    w = np.asarray(net.params_["layer_1"]["W"]).copy()
+    w[:] = 1e-5
+    w[0, 0] = 50.0                          # hostile: mass below one step
+    net.params_["layer_1"]["W"] = jnp.asarray(w)
+    qm = quantize_model(net)
+    rep = {k: v for k, v in qm.report.items()}
+    assert rep["['layer_1']['W']"] == "bfloat16"
+    assert rep["['layer_0']['W']"] == "int8"
+    # forward still runs through the fallback leaf
+    assert np.asarray(qm.output(np.zeros((2, 32), np.float32))).shape == (2, 10)
+
+
+def test_quantize_model_shrinks_resident_bytes():
+    net = _mlp(hidden=128)
+    qm = quantize_model(net)
+    f32 = sum(l.nbytes for l in jax.tree_util.tree_leaves(net.params_))
+    assert qm.bytes_resident() < f32 / 3     # ~4x on W, biases stay f32
+    with pytest.raises(ValueError, match="already quantized"):
+        quantize_model(qm)
+
+
+# ---------------------------------------------------------------------------
+# dtype plumbing: compiled-program checks
+# ---------------------------------------------------------------------------
+
+def _lowered_text(qm, n_in, batch=8):
+    def fwd(p, s, xv):
+        return qm._forward(p, s, xv, train=False, rng=None)[0]
+    x = jnp.zeros((batch, n_in), jnp.float32)
+    return jax.jit(fwd).lower(qm.params_, qm.state_, x).as_text()
+
+
+def test_compiled_program_keeps_int8_params():
+    qm = quantize_model(_mlp())
+    txt = _lowered_text(qm, 32)
+    assert "xi8>" in txt                     # int8 weights enter the program
+
+
+def test_no_silent_f32_upcast_under_bf16_compute():
+    """Mixed precision: with compute_dtype=bfloat16 every matmul in the
+    lowered program must consume/produce bf16 — the quantized path must
+    not widen back to f32."""
+    net = _mlp(compute_dtype="bfloat16")
+    qm = quantize_model(net)
+    assert str(qm.acc_dtype()) == "bfloat16"
+    txt = _lowered_text(qm, 32)
+    assert "xi8>" in txt
+    dots = [l for l in txt.splitlines() if "dot_general" in l]
+    assert dots, "no matmuls in lowered program?"
+    for l in dots:
+        out_ty = l.split("->")[-1]
+        assert "xf32>" not in out_ty, f"f32 matmul leaked into program: {l}"
+
+
+def test_quantized_inference_under_tp_sharding_rules():
+    """ParallelWrapper TP rules: the Megatron-style default splits 2-D
+    kernels' output dim over the model axis — QTensor leaves (int8 q and
+    its per-output-channel scale) shard the same way and the sharded
+    quantized forward matches the unsharded one."""
+    from deeplearning4j_tpu.parallel import (ShardingRules, make_mesh,
+                                             shard_model_params)
+    net = _mlp()
+    x = _fit(net)
+    qm = quantize_model(net)
+    want = np.asarray(qm.output(x))
+    mesh = make_mesh({"data": 4, "model": 2})
+    sharded = shard_model_params(qm.params_, mesh, ShardingRules())
+    q0 = sharded["layer_0"]["W"].q
+    assert q0.dtype == jnp.int8
+    assert q0.sharding.spec == P(None, "model")    # stayed int8 AND sharded
+    assert sharded["layer_0"]["W"].scale.sharding.spec == P(None, "model")
+    qm.params_ = sharded
+    qm._output_fn = None
+    with mesh:
+        got = np.asarray(qm.output(x))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# serving integration
+# ---------------------------------------------------------------------------
+
+def test_quantized_model_serves_through_compile_cache():
+    from deeplearning4j_tpu.serving import BucketedCompileCache
+    net = _mlp()
+    x = _fit(net)
+    qm = quantize_model(net)
+    cache = BucketedCompileCache(max_batch=16)
+    out = cache.run("q:v1", qm, x[:5])
+    np.testing.assert_allclose(out, np.asarray(qm.output(x[:5])),
+                               rtol=1e-5, atol=1e-6)
+    assert cache.counters.misses.value == 1
+    cache.run("q:v1", qm, x[:5])
+    assert cache.counters.hits.value == 1
+
+
+def test_registry_quantized_version_roll():
+    from deeplearning4j_tpu.serving import ModelRegistry
+    reg = ModelRegistry()
+    net = _mlp()
+    _fit(net)
+    reg.register("m", net)
+    entry = reg.register_quantized("m")
+    assert entry.version == 2 and entry.source == "quant"
+    assert isinstance(entry.model, QuantizedModel)
+    assert reg.get("m").version == 2               # new submits serve int8
+    assert reg.get("m", 1).model is net            # f32 still resolvable
+    assert entry.input_shape == (32,)
+
+
+def test_f32_and_int8_fingerprints_are_distinct():
+    net = _mlp()
+    x = _fit(net)
+    stats = calibrate(net, x)
+    qm = quantize_model(net, calibration=stats)
+    assert model_fingerprint(net) != model_fingerprint(qm)
+    # different calibration data -> different quantized program identity
+    stats2 = calibrate(net, x * 2.0)
+    assert stats.crc32() != stats2.crc32()
+    qm2 = quantize_model(net, calibration=stats2)
+    assert model_fingerprint(qm) != model_fingerprint(qm2)
+    # same inputs -> bit-stable fingerprint (the warm-restart premise)
+    qm3 = quantize_model(net, calibration=stats)
+    assert model_fingerprint(qm) == model_fingerprint(qm3)
+
+
+@pytest.mark.slow
+def test_quantized_warm_restart_subprocess(tmp_path):
+    """ISSUE 10 acceptance: quantized executables round-trip the
+    persistent AOT cache — a warm subprocess restart serves the quantized
+    model with zero fresh compiles, under a fingerprint distinct from
+    the f32 program's."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(here, "quant_warm_worker.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(here),
+               DL4J_TPU_TEST_CACHE=str(tmp_path))
+
+    def run():
+        p = subprocess.run([sys.executable, worker], env=env,
+                           capture_output=True, text=True, timeout=600)
+        assert p.returncode == 0, p.stderr[-2000:]
+        return json.loads(p.stdout.strip().splitlines()[-1])
+
+    cold = run()
+    warm = run()
+    assert cold["fp_quant"] != cold["fp_f32"]
+    assert warm["fp_quant"] == cold["fp_quant"]
+    assert warm["calibration_crc"] == cold["calibration_crc"]
+    assert cold["compiles"] >= 1 and cold["stores"] >= 1
+    assert warm["compiles"] == 0                   # pure deserialization
+    assert warm["disk_hits"] >= cold["stores"]
+    assert warm["checksum"] == cold["checksum"]
