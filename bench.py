@@ -69,6 +69,15 @@ cache) and an overload phase where low-priority traffic is shed while
 the high-priority p99 stays within its SLO (gate: both); detail to
 stderr + `BENCH_fleet.json`, one stdout JSON line.
 
+`python bench.py --fleetchaos [--quick]` gates serving fault tolerance
+(`serving/resilience.py`): `ReplicaChaos` kills one replica and hangs
+another mid-flood — gates: zero lost accepted requests, hi-priority p99
+within SLO through the failure, every controller respawn compile-free
+(`fresh_compiles == 0`), detection->respawn bounded, and a fleet restart
+from the crc-guarded topology snapshot reconverging to the pre-crash
+shape with zero cold compiles; detail to stderr +
+`BENCH_fleetchaos.json`, one stdout JSON line.
+
 `python bench.py --quant [--quick]` A/Bs post-training-quantized serving
 (`deeplearning4j_tpu.quant`: calibrate → int8 per-channel weights → fused
 quantized forward) against the f32 model through the bucketed serving
@@ -1374,6 +1383,306 @@ def main_fleet(quick: bool):
         sys.exit(1)
 
 
+def bench_fleetchaos(quick=False):
+    """`--fleetchaos` gate: serving fault tolerance under injected
+    replica failure (serving/resilience.py).
+
+    Phase A (chaos flood): a hi-priority and a lo-priority member, two
+    replicas each, flooded from client threads while `ReplicaChaos`
+    KILLS one hi replica (every dispatch raises `ReplicaKilledError` —
+    poison + failover) and HANGS one lo replica (a dispatch sleeps
+    inside the compiled run — hedges cover the stuck requests, the
+    controller declares it hung).  The reconcile loop must detect both,
+    tear them down (remove-from-routing-first, bounded concurrent
+    drain) and respawn them on the SAME slice through the persistent
+    AOT cache.  Gates: zero lost accepted requests, hi-priority p99
+    within its SLO through the failure, every respawn
+    `fresh_compiles == 0`, detection->respawn bounded, and the
+    degraded-mode ladder back at `full` once healed.
+
+    Phase B (snapshot restart): the fleet commits a topology snapshot
+    and shuts down; a NEW fleet process deploys the same models against
+    the same cache dir and calls `restore_snapshot()`.  Gate: the
+    pre-crash resident set and slice placements reconverge with zero
+    cold compiles."""
+    import itertools
+    import os
+    import shutil
+    import tempfile
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.serving import (FleetPolicy, LatencySLO,
+                                            ModelFleet, RejectedError)
+    from deeplearning4j_tpu.train.updaters import Sgd
+    from deeplearning4j_tpu.utils.chaos import ReplicaChaos
+
+    n_in = 16
+    hi_slo_ms = 1500.0
+    # 3s budget: the hedge fires at 1.5s — INSIDE the 2.5s hang window,
+    # so requests stuck behind the hung dispatch resolve via their hedge
+    deadline_ms = 3000.0
+    flood = 60 if quick else 200            # requests per client thread
+    clients = 3
+
+    def make_net(seed, hidden=32):
+        conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(1e-1))
+                .list([DenseLayer(n_out=hidden, activation="relu"),
+                       OutputLayer(n_out=4, loss="mcxent",
+                                   activation="softmax")])
+                .set_input_type(InputType.feed_forward(n_in)).build())
+        return MultiLayerNetwork(conf).init()
+
+    work_dir = tempfile.mkdtemp(prefix="bench-fleetchaos-")
+    cache_dir = os.path.join(work_dir, "exec-cache")
+    snap_path = os.path.join(work_dir, "fleet-snapshot.json")
+    policy = FleetPolicy(respawn_after_s=0.3, hang_after_s=0.6,
+                         drain_timeout_s=1.0, max_failovers=3,
+                         ladder_down_after=4, ladder_up_after=3)
+
+    def build_fleet(interval):
+        return ModelFleet(max_resident=2, n_slices=4, max_batch=8,
+                          batch_timeout_ms=1.0, cache_dir=cache_dir,
+                          snapshot_path=snap_path, snapshot_interval_s=0.2,
+                          reconcile_interval_s=interval, policy=policy,
+                          observe_every=4)
+
+    try:
+        # ---- Phase A: chaos flood ----
+        fleet = build_fleet(0.05)
+        fleet.deploy("hi", make_net(1001),
+                     slo=LatencySLO(target_p99_ms=hi_slo_ms, priority=10),
+                     replicas=2, warm=True)
+        fleet.deploy("lo", make_net(1002),
+                     slo=LatencySLO(target_p99_ms=500.0, priority=0),
+                     replicas=2, warm=True)
+        # int8 standby for the ladder's quantized step; also makes every
+        # later respawn warm BOTH versions from the shared AOT cache
+        fleet.prepare_quantized("lo")
+        x0 = np.random.RandomState(0).rand(2, n_in).astype(np.float32)
+        for name in ("hi", "lo"):
+            fleet.output(name, x0, deadline_ms=60_000.0, timeout=120)
+
+        kill = ReplicaChaos(mode="kill", at_dispatch=0)
+        hang = ReplicaChaos(mode="hang", at_dispatch=0, duration_s=2.5)
+        armed = threading.Event()
+        progress = itertools.count()         # requests submitted so far
+        arm_at = flood * clients // 3        # fire MID-flood, data-driven
+
+        def client(spec):
+            name, seed = spec
+            rs = np.random.RandomState(seed)
+            served = failed = shed = 0
+            lat = []
+            for _ in range(flood):
+                if next(progress) == arm_at:
+                    # arm inside the flood, not on a wall clock — on a
+                    # fast backend a timed arm can miss the flood window
+                    kill.arm(fleet.member("hi").group.replicas[0])
+                    hang.arm(fleet.member("lo").group.replicas[0])
+                    armed.set()
+                x = rs.rand(2, n_in).astype(np.float32)
+                t0 = time.perf_counter()
+                try:
+                    f = fleet.submit(name, x, deadline_ms=deadline_ms)
+                except RejectedError:
+                    shed += 1
+                    continue
+                # accepted: this future MUST resolve — a kill/hang on
+                # its replica has to fail over, not lose it
+                if f.exception(timeout=60) is None:
+                    served += 1
+                    lat.append((time.perf_counter() - t0) * 1000.0)
+                else:
+                    failed += 1
+            return name, served, failed, shed, lat
+
+        specs = [("hi", 100 + i) for i in range(clients)] \
+            + [("lo", 200 + i) for i in range(clients)]
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(len(specs)) as ex:
+            results = list(ex.map(client, specs))
+        flood_dt = time.perf_counter() - t0
+        assert armed.wait(timeout=10), "chaos never armed"
+
+        # wait for the controller to heal both members
+        heal_deadline = time.monotonic() + 15.0
+        while time.monotonic() < heal_deadline:
+            healthy = all(
+                r.healthy and not r.poisoned
+                for name in ("hi", "lo")
+                for r in fleet.member(name).group.snapshot())
+            if healthy and fleet.member("hi").respawns >= 1 \
+                    and fleet.member("lo").respawns >= 1:
+                break
+            time.sleep(0.05)
+        # recovery: "lo" is in sustained SLO breach from the hang window
+        # (its p99 window still holds the stuck-request latencies), so
+        # it self-sheds all but every-8th probe.  Drive probe traffic
+        # until fresh under-target samples displace the hang latencies,
+        # the breach clears, and the ladder hysteresis walks back to
+        # `full` — the explicit recovery half of the degraded ladder.
+        lo_recovery_probes = 0
+        recover_deadline = time.monotonic() + 30.0
+        while time.monotonic() < recover_deadline:
+            try:
+                fleet.output("lo", x0, deadline_ms=60_000.0, timeout=120)
+                lo_recovery_probes += 1
+            except RejectedError:
+                pass
+            if not fleet.member("lo").tracker.breached \
+                    and fleet.ladder.level == 0:
+                break
+        fleet.output("hi", x0, deadline_ms=60_000.0, timeout=120)
+
+        respawn_actions = [a for rec in fleet.controller.history
+                           for a in rec["actions"]
+                           if a["action"] == "respawn"]
+        hi_p99 = fleet.member("hi").latency.percentiles((99,))["p99"]
+        served = {n: 0 for n, *_ in results}
+        failed = dict(served)
+        shed = dict(served)
+        for name, s, f_, sh, _ in results:
+            served[name] += s
+            failed[name] += f_
+            shed[name] += sh
+        inst = fleet.instruments
+        counters = {
+            "hedges": inst.hedges.value,
+            "hedge_wasted": inst.hedge_wasted.value,
+            "failovers": inst.failovers.value,
+            "drain_timeouts": inst.drain_timeouts.value,
+            "replica_probes": inst.replica_probes.value,
+        }
+        ladder_transitions = list(fleet.ladder.transitions)
+        ladder_level_end = fleet.ladder.level
+        topo_before = {
+            "resident": fleet.pool.resident_names(),
+            "slices": {name: sorted(r.slice.index
+                                    for r in fleet.member(name)
+                                    .group.snapshot())
+                       for name in ("hi", "lo")},
+        }
+        fleet.save_snapshot()
+        fleet.shutdown()                     # commits a final snapshot too
+        kill.restore()
+        hang.restore()
+
+        # ---- Phase B: restart from snapshot, zero cold compiles ----
+        fleet2 = build_fleet(None)
+        fleet2.deploy("hi", make_net(1001),
+                      slo=LatencySLO(target_p99_ms=hi_slo_ms, priority=10))
+        fleet2.deploy("lo", make_net(1002),
+                      slo=LatencySLO(target_p99_ms=500.0, priority=0))
+        restore = fleet2.restore_snapshot()
+        topo_after = {
+            "resident": fleet2.pool.resident_names(),
+            "slices": {name: sorted(r.slice.index
+                                    for r in fleet2.member(name)
+                                    .group.snapshot())
+                       for name in ("hi", "lo")},
+        }
+        for name in ("hi", "lo"):            # the restored fleet serves
+            # the snapshot restores lo's sustained-breach hysteresis, so
+            # its first probes may be shed exactly like pre-crash
+            for _ in range(256):
+                try:
+                    fleet2.output(name, x0, deadline_ms=60_000.0,
+                                  timeout=120)
+                    break
+                except RejectedError:
+                    time.sleep(0.02)
+            else:
+                raise RuntimeError(
+                    f"restored probe for '{name}' never admitted")
+        fleet2.shutdown()
+
+        return {
+            "flood_requests": flood * clients * 2,
+            "flood_duration_s": flood_dt,
+            "hi_slo_ms": hi_slo_ms,
+            "hi_p99_ms": hi_p99,
+            "served": served,
+            "failed": failed,
+            "shed": shed,
+            "lost_accepted": sum(failed.values()),
+            "respawns": respawn_actions,
+            "respawn_fresh_compiles": [a["fresh_compiles"]
+                                       for a in respawn_actions],
+            "detect_to_respawn_ms": [
+                round(a["detect_ms"] + a["respawn_ms"], 3)
+                for a in respawn_actions],
+            "counters": counters,
+            "lo_recovery_probes": lo_recovery_probes,
+            "ladder_transitions": ladder_transitions,
+            "ladder_level_end": ladder_level_end,
+            "topology_before": topo_before,
+            "topology_after": topo_after,
+            "restore": restore,
+        }
+    finally:
+        shutil.rmtree(work_dir, ignore_errors=True)
+
+
+def main_fleetchaos(quick: bool):
+    """`--fleetchaos` mode: chaos detail to stderr + BENCH_fleetchaos.json,
+    ONE stdout JSON line.  Gates: zero lost accepted requests through a
+    replica kill + hang, hi-priority p99 within SLO, every respawn
+    compile-free, detection->respawn bounded, snapshot restart
+    reconverges to the pre-crash topology with zero cold compiles."""
+    import os
+    if not os.environ.get("JAX_PLATFORMS"):
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from __graft_entry__ import _probe_backend_device_count
+        if _probe_backend_device_count() < 1:
+            print("[bench] TPU backend unreachable; fleetchaos bench on "
+                  "CPU", file=sys.stderr, flush=True)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = bench_fleetchaos(quick=quick)
+    except Exception as e:
+        print(json.dumps({"metric": "fleetchaos_lost_accepted",
+                          "value": None, "unit": "requests",
+                          "error": repr(e)[:300]}))
+        sys.exit(1)
+    for k, v in r.items():      # detail to stderr: stdout stays one line
+        print(f"[fleetchaos] {k} = {v}", file=sys.stderr, flush=True)
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_fleetchaos.json"), "w") as f:
+        json.dump(r, f, indent=2)
+    causes = {a["cause"] for a in r["respawns"]}
+    ok = (r["lost_accepted"] == 0
+          and r["hi_p99_ms"] <= r["hi_slo_ms"]
+          and len(r["respawns"]) >= 2
+          and {"poisoned", "hung"} <= causes
+          and all(c == 0 for c in r["respawn_fresh_compiles"])
+          and all(ms <= 10_000.0 for ms in r["detect_to_respawn_ms"])
+          and r["ladder_level_end"] == 0
+          and r["restore"]["fresh_compiles"] == 0
+          and r["topology_after"] == r["topology_before"])
+    print(json.dumps({
+        "metric": "fleetchaos_lost_accepted",
+        "value": r["lost_accepted"],
+        "unit": "requests",
+        "threshold": 0,
+        "pass": ok,
+        "hi_p99_ms": round(r["hi_p99_ms"], 2),
+        "hi_slo_ms": r["hi_slo_ms"],
+        "respawns": len(r["respawns"]),
+        "respawn_causes": sorted(causes),
+        "respawn_fresh_compiles": r["respawn_fresh_compiles"],
+        "detect_to_respawn_ms": r["detect_to_respawn_ms"],
+        "restore_fresh_compiles": r["restore"]["fresh_compiles"],
+        "ladder_level_end": r["ladder_level_end"],
+        "hedges": r["counters"]["hedges"],
+        "failovers": r["counters"]["failovers"],
+    }))
+    if not ok:
+        sys.exit(1)
+
+
 def aot_child(cache_dir: str, steps: int, batch: int, n_in: int):
     """`--aot-child` worker: ONE process's cold-or-warm measurement.
 
@@ -1854,6 +2163,9 @@ def main():
         return
     if "--serving" in sys.argv:
         main_serving(quick)
+        return
+    if "--fleetchaos" in sys.argv:
+        main_fleetchaos(quick)
         return
     if "--fleet" in sys.argv:
         main_fleet(quick)

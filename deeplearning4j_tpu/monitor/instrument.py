@@ -75,6 +75,25 @@ docs/observability.md):
                                      consecutive dispatch failures
   fleet_replica_probes_total         requests routed to an unhealthy
                                      replica as a recovery probe
+  serving_drain_timeouts_total       replica drains that blew the shared
+                                     concurrent-drain deadline
+  fleet_hedges_total                 speculative duplicate dispatches
+                                     (launched at hedge_fraction of the
+                                     deadline budget)
+  fleet_hedge_wasted_total           late duplicate completions suppressed
+                                     after the client future settled
+  fleet_failovers_total              failed attempts re-routed to the next
+                                     healthy replica
+  fleet_replica_respawns_total{cause=} replicas torn down + rebuilt by the
+                                     controller (poisoned|unhealthy|hung)
+  fleet_respawn_ms                   detection->routable wall time of one
+                                     replica self-heal
+  fleet_breaker_state{model=}        worst replica breaker state per model
+                                     (0=closed 1=half-open 2=open)
+  fleet_degraded_level               degraded-mode ladder level (0=full
+                                     1=hedges_off 2=quantized 3=shed_floor)
+  fleet_snapshot_age_s               seconds since the last committed
+                                     fleet topology snapshot (-1 = none)
   gang_generation                    current gang membership generation
   gang_members                       live ranks in the gradient-mesh gang
   gang_reformations_total{cause=}    membership reformations (cause=crash|
@@ -483,9 +502,41 @@ class FleetInstruments:
             "fleet_replica_probes_total",
             help="requests deliberately routed to an unhealthy replica "
             "as a recovery probe (one success restores routing)")
+        self.drain_timeouts = reg.counter(
+            "serving_drain_timeouts_total",
+            help="replica drains that did not finish inside the shared "
+            "concurrent-drain deadline (the drain keeps running on its "
+            "daemon thread; leftover futures fail over)")
+        self.hedges = reg.counter(
+            "fleet_hedges_total",
+            help="speculative duplicate dispatches launched after "
+            "hedge_fraction of a request's deadline budget elapsed")
+        self.hedge_wasted = reg.counter(
+            "fleet_hedge_wasted_total",
+            help="duplicate completions suppressed after the client "
+            "future was already settled (a late original or hedge — "
+            "never double-counted)")
+        self.failovers = reg.counter(
+            "fleet_failovers_total",
+            help="failed dispatch attempts re-routed to the next healthy "
+            "replica with the remaining deadline budget")
+        self.respawn_ms = reg.histogram(
+            "fleet_respawn_ms",
+            help="detection-to-routable wall time of one replica "
+            "self-heal (detect + drain + rebuild through the AOT cache)")
+        self.degraded_level = reg.gauge(
+            "fleet_degraded_level",
+            help="degraded-mode ladder level: 0=full 1=hedges_off "
+            "2=quantized 3=shed_floor")
+        self.snapshot_age = reg.gauge(
+            "fleet_snapshot_age_s",
+            help="seconds since the last committed fleet topology "
+            "snapshot (-1 before the first, or when snapshots are off)")
         self._requests: dict = {}
         self._sheds: dict = {}
         self._breaches: dict = {}
+        self._respawns: dict = {}
+        self._breaker_state: dict = {}
 
     def record_admission(self, warm: bool) -> None:
         if not enabled():
@@ -523,6 +574,28 @@ class FleetInstruments:
                 labels={"model": model})
             self._breaches[model] = c
         return c
+
+    def respawns(self, cause: str):
+        c = self._respawns.get(cause)
+        if c is None:
+            c = self._reg.counter(
+                "fleet_replica_respawns_total",
+                help="replicas torn down and rebuilt by the controller, "
+                "by cause (poisoned | unhealthy | hung)",
+                labels={"cause": cause})
+            self._respawns[cause] = c
+        return c
+
+    def breaker_state(self, model: str):
+        g = self._breaker_state.get(model)
+        if g is None:
+            g = self._reg.gauge(
+                "fleet_breaker_state",
+                help="worst replica circuit-breaker state per model: "
+                "0=closed 1=half-open 2=open",
+                labels={"model": model})
+            self._breaker_state[model] = g
+        return g
 
 
 class QuantInstruments:

@@ -16,6 +16,9 @@ AOT compiled-executable serving model (PAPERS.md).
     slo / fleet     — multi-model fleet: LatencySLO routing, mesh-slice
                       replica groups, warm-pool LRU eviction backed by the
                       persistent AOT cache (UI: /fleet endpoint)
+    resilience      — serving fault tolerance: per-replica circuit
+                      breaker, failover + hedged dispatch, degraded-mode
+                      ladder, crc-guarded fleet topology snapshot/restore
 """
 from deeplearning4j_tpu.serving.batcher import (  # noqa: F401
     ContinuousBatcher, DeadlineExceededError, RejectedError)
@@ -27,6 +30,10 @@ from deeplearning4j_tpu.serving.fleet import (  # noqa: F401
 from deeplearning4j_tpu.serving.metrics import ServingMetrics  # noqa: F401
 from deeplearning4j_tpu.serving.registry import (  # noqa: F401
     ModelEntry, ModelRegistry)
+from deeplearning4j_tpu.serving.resilience import (  # noqa: F401
+    LADDER_LEVELS, CircuitBreaker, DegradedLadder, FailoverRequest,
+    FatalReplicaError, FleetSnapshotter, ReplicaKilledError,
+    SnapshotCorruptError, classify_error, drain_replicas, load_snapshot)
 from deeplearning4j_tpu.serving.server import ModelServer  # noqa: F401
 from deeplearning4j_tpu.serving.slo import (  # noqa: F401
     FleetPolicy, LatencySLO, SLOTracker)

@@ -61,6 +61,13 @@ from deeplearning4j_tpu.monitor.registry import (Histogram, MetricsRegistry,
 from deeplearning4j_tpu.serving.batcher import RejectedError
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 from deeplearning4j_tpu.serving.registry import ModelRegistry
+from deeplearning4j_tpu.serving.resilience import (CircuitBreaker,
+                                                   DegradedLadder,
+                                                   FailoverRequest,
+                                                   FleetSnapshotter,
+                                                   _HedgeScheduler,
+                                                   drain_replicas,
+                                                   load_snapshot)
 from deeplearning4j_tpu.serving.server import ModelServer
 from deeplearning4j_tpu.serving.slo import FleetPolicy, LatencySLO, SLOTracker
 
@@ -103,19 +110,34 @@ class DeviceSlice:
 class Replica:
     """One ModelServer pinned to one slice, serving one member.
 
-    Tracks dispatch health: `unhealthy_after` consecutive dispatch
-    failures mark the replica unhealthy and the router stops picking it
-    (except as a probe) until one success clears it — the serving
-    mirror of the elastic gang's heartbeat-deadline semantics."""
+    Dispatch health is a per-replica `CircuitBreaker`
+    (closed/open/half-open): `unhealthy_after` consecutive dispatch
+    failures open it and the router stops picking the replica except as
+    an every-`probe_every`-th half-open probe; one probe success closes
+    it — the serving mirror of the elastic gang's heartbeat-deadline
+    semantics.  A `FatalReplicaError` poisons the replica instead
+    (breaker forced open, controller respawns it on the next tick)."""
 
     def __init__(self, name: str, server: ModelServer, slice_: DeviceSlice):
         self.name = name
         self.server = server
         self.slice = slice_
-        self.healthy = True
-        self.consecutive_failures = 0
-        self.failures = 0
+        self.breaker = CircuitBreaker()
+        self.poisoned = False
+        self.poison_exc: Optional[BaseException] = None
         self.probes = 0
+
+    @property
+    def healthy(self) -> bool:
+        return self.breaker.state == CircuitBreaker.CLOSED
+
+    @property
+    def consecutive_failures(self) -> int:
+        return self.breaker.consecutive_failures
+
+    @property
+    def failures(self) -> int:
+        return self.breaker.failures
 
     @property
     def queue_depth(self) -> int:
@@ -123,28 +145,29 @@ class Replica:
 
     def record_failure(self, unhealthy_after: int) -> bool:
         """Count one dispatch failure; returns True when this failure
-        flipped the replica unhealthy."""
-        self.failures += 1
-        self.consecutive_failures += 1
-        if self.healthy and self.consecutive_failures >= unhealthy_after:
-            self.healthy = False
-            return True
-        return False
+        opened the breaker (the replica left routing)."""
+        return self.breaker.record_failure(unhealthy_after)
 
     def record_success(self) -> bool:
-        """One served request; returns True when it cleared an unhealthy
-        mark (the probe passed)."""
-        self.consecutive_failures = 0
-        if not self.healthy:
-            self.healthy = True
-            return True
-        return False
+        """One served request; returns True when it closed an open
+        breaker (the probe passed, the replica re-enters routing)."""
+        return self.breaker.record_success()
+
+    def poison(self, exc: BaseException) -> bool:
+        """A fatal error class: trip the breaker immediately and flag
+        the replica for controller respawn.  Returns True when this
+        call flipped it out of routing."""
+        self.poisoned = True
+        self.poison_exc = exc
+        return self.breaker.force_open()
 
     def describe(self) -> Dict[str, Any]:
         return {"name": self.name, "slice": self.slice.index,
                 "queue_depth": self.queue_depth,
                 "healthy": self.healthy,
-                "consecutive_failures": self.consecutive_failures}
+                "poisoned": self.poisoned,
+                "consecutive_failures": self.consecutive_failures,
+                "breaker": self.breaker.describe()}
 
 
 class ReplicaGroup:
@@ -152,8 +175,10 @@ class ReplicaGroup:
     admission lock; the router reads an atomic snapshot, so a rebalance
     (append / remove) never torn-reads against a route."""
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, instruments: Optional[FleetInstruments]
+                 = None):
         self.name = name
+        self.instruments = instruments
         self.replicas: List[Replica] = []
         self._rr = itertools.count()
 
@@ -164,9 +189,17 @@ class ReplicaGroup:
         snap = self.snapshot()
         return max((r.queue_depth for r in snap), default=0)
 
-    def drain(self, timeout: float = 10.0) -> None:
-        for r in self.snapshot():
-            r.server.shutdown(drain=True, timeout=timeout)
+    def drain(self, timeout: float = 10.0) -> List[str]:
+        """Drain every replica CONCURRENTLY under one shared deadline —
+        a single hung replica must not burn the whole budget the way a
+        serial walk did.  Returns the names whose drain expired (each
+        counted in `serving_drain_timeouts_total`); expired drains keep
+        running on daemon threads and their leftover futures still fail
+        over."""
+        return drain_replicas(
+            self.snapshot(), timeout=timeout,
+            counter=(self.instruments.drain_timeouts
+                     if self.instruments is not None else None))
 
     def describe(self) -> List[Dict[str, Any]]:
         return [r.describe() for r in self.snapshot()]
@@ -194,8 +227,13 @@ class FleetMember:
     sheds: int = 0
     deprioritized: int = 0
     requests: int = 0
-    last_admission_fresh_compiles: Optional[int] = None
+    client_errors: int = 0               # malformed-input failures: never
+    last_admission_fresh_compiles: Optional[int] = None   # health-counted
     preferred_slices: List[int] = dataclasses.field(default_factory=list)
+    serving_version: Optional[int] = None    # None -> newest registered
+    quantized_version: Optional[int] = None  # int8 standby (ladder >= 2)
+    respawns: int = 0
+    last_respawn: Optional[Dict[str, Any]] = None
     _obs: int = 0
     _probe: int = 0
     _health_probe: int = 0
@@ -208,10 +246,15 @@ class FleetMember:
             "replicas": self.group.describe() if self.group else [],
             "replicas_target": self.replicas_target,
             "requests": self.requests,
+            "client_errors": self.client_errors,
             "admissions": self.admissions,
             "evictions": self.evictions,
+            "respawns": self.respawns,
+            "last_respawn": self.last_respawn,
             "sheds": self.sheds,
             "deprioritized": self.deprioritized,
+            "serving_version": self.serving_version,
+            "quantized_version": self.quantized_version,
             "last_admission_fresh_compiles":
                 self.last_admission_fresh_compiles,
             "idle_s": (round(now - self.last_used, 3)
@@ -272,6 +315,12 @@ class FleetRouter:
     def admission_priority(self, member: FleetMember) -> int:
         """The batcher priority this request is admitted at; raises
         `RejectedError` when the request is shed instead."""
+        if self.fleet.ladder.shed_floor() \
+                and member.slo.priority < self.max_priority():
+            # degraded-ladder floor: only the top priority class is
+            # admitted, breached or not — the last capacity-preserving
+            # step before the fleet falls over entirely
+            return self._refuse(member)
         level = self.shed_level()
         if level is None:
             return member.slo.priority
@@ -299,8 +348,11 @@ class FleetRouter:
                     or member._health_probe % self.probe_every == 0:
                 # route ONE live request to an unhealthy replica so a
                 # recovered server can pass its probe and re-enter (and
-                # when every replica is down, probing is all we can do)
+                # when every replica is down, probing is all we can do);
+                # the pick moves an open breaker to half-open — the
+                # probe is now in flight
                 r = unhealthy[member._health_probe % len(unhealthy)]
+                r.breaker.try_probe()
                 r.probes += 1
                 self.fleet.instruments.replica_probes.inc()
                 return r
@@ -371,7 +423,7 @@ class WarmPool:
         fleet = self.fleet
         cache = fleet.cache
         before = cache.stats["compiles"] if cache is not None else None
-        group = ReplicaGroup(member.name)
+        group = ReplicaGroup(member.name, instruments=fleet.instruments)
         for _ in range(member.replicas_target):
             slice_ = fleet._take_slice(member.preferred_slices)
             group.replicas.append(fleet._build_replica(member, slice_))
@@ -403,7 +455,8 @@ class WarmPool:
             member.state = "evicting"
             group, member.group = member.group, None
             try:
-                group.drain()                    # in-flight futures resolve
+                # in-flight futures resolve (concurrent, shared deadline)
+                group.drain(timeout=fleet.policy.drain_timeout_s)
             finally:
                 for r in group.snapshot():
                     r.server.cache.invalidate()
@@ -486,6 +539,17 @@ class FleetController:
         now = time.monotonic()
         with fleet._admission_lock:
             resident = fleet.pool.resident()
+            # self-healing first: a dead replica is worse than a slow one
+            self._heal(resident, actions, now)
+            # degraded-mode ladder: sustained breach or capacity still
+            # lost after healing steps the fleet down one named level
+            pressured_fleet = (
+                any(m.tracker.breached for m in resident)
+                or any(not r.healthy
+                       for m in resident if m.group is not None
+                       for r in m.group.snapshot()))
+            fleet.ladder.observe(pressured_fleet)
+            fleet.instruments.degraded_level.set(fleet.ladder.level)
             pressured = [m for m in resident
                          if m.tracker.breached
                          or m.group.queue_depth() >= policy.grow_at_queue]
@@ -516,7 +580,82 @@ class FleetController:
         self.history.append(record)
         if len(self.history) > 256:
             del self.history[:-256]
+        fleet._tick_snapshot()
         return record
+
+    # ---- self-healing ----
+    def _heal(self, resident: List[FleetMember],
+              actions: List[Dict[str, Any]], now: float) -> None:
+        """Caller holds the admission lock.  Tear down and respawn every
+        replica that is poisoned (fatal error class), unhealthy past the
+        respawn deadline (breaker open since its FIRST failure, across
+        failed probes), or hung inside a dispatch — rebuilt on the SAME
+        slice through the persistent AOT cache, so a respawn is
+        deserialize-not-recompile (`fresh_compiles == 0`)."""
+        policy = self.fleet.policy
+        for m in resident:
+            group = m.group
+            if group is None:
+                continue
+            for r in group.snapshot():
+                cause = detect_ms = None
+                if r.poisoned:
+                    cause = "poisoned"
+                    opened = r.breaker.opened_at
+                    detect_ms = ((now - opened) * 1000.0
+                                 if opened is not None else 0.0)
+                elif (r.breaker.state == CircuitBreaker.OPEN
+                      and r.breaker.opened_at is not None
+                      and now - r.breaker.opened_at
+                      >= policy.respawn_after_s):
+                    cause = "unhealthy"
+                    detect_ms = (now - r.breaker.opened_at) * 1000.0
+                else:
+                    age = r.server.batcher.inflight_age_s
+                    if age is not None and age >= policy.hang_after_s:
+                        cause = "hung"
+                        detect_ms = age * 1000.0
+                if cause is not None:
+                    self._respawn(m, r, cause, detect_ms, actions)
+
+    def _respawn(self, member: FleetMember, replica: Replica, cause: str,
+                 detect_ms: float, actions: List[Dict[str, Any]]) -> None:
+        """Caller holds the admission lock.  Same zero-downtime ordering
+        as a rebalance shrink: pop from routing FIRST (the router stops
+        picking it), bounded concurrent drain (a hung server expires and
+        its leftovers fail over), then rebuild on the SAME slice."""
+        fleet = self.fleet
+        group = member.group
+        if group is None or replica not in group.replicas:
+            return
+        t0 = time.monotonic()
+        group.replicas.remove(replica)           # routing-first
+        expired = drain_replicas(
+            [replica], timeout=fleet.policy.drain_timeout_s,
+            counter=fleet.instruments.drain_timeouts)
+        replica.server.cache.invalidate()
+        cache = fleet.cache
+        before = cache.stats["compiles"] if cache is not None else None
+        group.replicas.append(
+            fleet._build_replica(member, replica.slice))
+        fresh = (cache.stats["compiles"] - before
+                 if cache is not None else None)
+        respawn_ms = (time.monotonic() - t0) * 1000.0
+        member.respawns += 1
+        member.last_respawn = {
+            "cause": cause, "slice": replica.slice.index,
+            "fresh_compiles": fresh,
+            "detect_ms": round(detect_ms, 3),
+            "respawn_ms": round(respawn_ms, 3),
+            "drain_expired": expired}
+        fleet.instruments.respawns(cause).inc()
+        fleet.instruments.respawn_ms.observe(detect_ms + respawn_ms)
+        fleet._note_breaker(member)
+        actions.append({"action": "respawn", "model": member.name,
+                        "slice": replica.slice.index, "cause": cause,
+                        "fresh_compiles": fresh,
+                        "detect_ms": round(detect_ms, 3),
+                        "respawn_ms": round(respawn_ms, 3)})
 
     def _free_or_reclaimed_slice(self, needy: FleetMember,
                                  resident: List[FleetMember],
@@ -576,6 +715,10 @@ class ModelFleet:
       grow/shrink thresholds).
     * `reconcile_interval_s` — run the `FleetController` loop in a
       daemon thread (None: call `fleet.controller.reconcile()` yourself).
+    * `snapshot_path` / `snapshot_interval_s` — periodic crc-guarded
+      topology snapshot (serving/resilience.py); a restarted fleet calls
+      `restore_snapshot()` to rebuild its pre-crash shape through the
+      warm pool + AOT cache with zero cold compiles.
     """
 
     def __init__(self, max_resident: int = 4,
@@ -591,6 +734,8 @@ class ModelFleet:
                  policy: Optional[FleetPolicy] = None,
                  observe_every: int = 8,
                  reconcile_interval_s: Optional[float] = None,
+                 snapshot_path: Optional[str] = None,
+                 snapshot_interval_s: Optional[float] = None,
                  registry_: Optional[MetricsRegistry] = None):
         from deeplearning4j_tpu.compile import as_cache
         self.registry = ModelRegistry()
@@ -614,6 +759,14 @@ class ModelFleet:
         self._closed = False
         self._started = time.monotonic()
         self._resident_bytes_peak = 0
+        self.ladder = DegradedLadder(
+            down_after=self.policy.ladder_down_after,
+            up_after=self.policy.ladder_up_after)
+        self._hedge_scheduler = _HedgeScheduler()
+        self.snapshotter = (FleetSnapshotter(
+            self, snapshot_path, interval_s=snapshot_interval_s)
+            if snapshot_path is not None else None)
+        self.instruments.snapshot_age.set(-1.0)
         self.pool = WarmPool(self, max_resident)
         self.router = FleetRouter(self, self.policy)
         self.controller = FleetController(
@@ -774,6 +927,33 @@ class ModelFleet:
         self._note_resident_bytes()
         return entry
 
+    def prepare_quantized(self, name: str, calibration=None,
+                          config=None):
+        """Register an int8 STANDBY version for the degraded-mode
+        ladder, without changing what the member serves today: the
+        current newest version stays pinned as `serving_version`, the
+        freshly-quantized one is recorded as `quantized_version` and its
+        buckets are warmed on every live replica — so when the ladder
+        steps to its quantized level, routing flips to ~4x-capacity int8
+        with zero compiles, and recovery flips back to f32.  (Contrast
+        `quantize()`, which ROLLS the quantized version in as the new
+        default and demotes the f32 predecessors.)"""
+        member = self.member(name)
+        with self.registry.name_lock(name):
+            base = self.registry.get(name, member.serving_version)
+            entry = self.registry.register_quantized(
+                name, calibration=calibration, config=config)
+            member.serving_version = base.version
+            member.quantized_version = entry.version
+            group = member.group
+            if member.state == "resident" and group is not None \
+                    and self.warmup and entry.input_shape is not None:
+                for replica in group.snapshot():
+                    self.registry.warmup(name, replica.server.cache,
+                                         version=entry.version,
+                                         input_shape=entry.input_shape)
+        return entry
+
     def set_default_schedule(self, schedule) -> "ModelFleet":
         """Install a fleet-default `compile.Schedule`, applied on
         admission to members that have no per-model schedule (the
@@ -796,20 +976,53 @@ class ModelFleet:
             metrics=metrics, cache_dir=self.cache)
         if member.schedule is not None:
             member.schedule.apply(srv)
-        entry = self.registry.get(member.name)
+        entry = self.registry.get(member.name, member.serving_version)
         if self.warmup and entry.input_shape is not None:
             self.registry.warmup(member.name, srv.cache,
+                                 version=entry.version,
                                  input_shape=entry.input_shape)
+        if member.quantized_version is not None \
+                and member.quantized_version != entry.version:
+            # the int8 standby must be dispatch-ready too, or the
+            # degraded ladder's quantized step would pay a compile
+            # exactly when the fleet can least afford one
+            q = self.registry.get(member.name, member.quantized_version)
+            if self.warmup and q.input_shape is not None:
+                self.registry.warmup(member.name, srv.cache,
+                                     version=q.version,
+                                     input_shape=q.input_shape)
         return Replica(rname, srv, slice_)
 
     # ---- request path ----
+    def _route_version(self, member: FleetMember) -> Optional[int]:
+        """The registry version this submit dispatches: the pinned
+        serving version (None = newest), or the int8 standby when the
+        degraded ladder has stepped to quantized routing."""
+        if member.quantized_version is not None \
+                and self.ladder.quantized_routing():
+            return member.quantized_version
+        return member.serving_version
+
+    def _note_breaker(self, member: FleetMember) -> None:
+        """Export the member's worst replica breaker state
+        (`fleet_breaker_state{model=}`: 0=closed 1=half-open 2=open)."""
+        group = member.group
+        level = max((r.breaker.level() for r in group.snapshot())
+                    if group is not None and group.replicas else [0],
+                    default=0)
+        self.instruments.breaker_state(member.name).set(level)
+
     def submit(self, name: str, x, priority: Optional[int] = None,
                deadline_ms: Optional[float] = None) -> Future:
-        """Route one request: admission check (SLO shed ordering), warm-
-        pool admission if the model is cold (LRU-evicting as needed),
-        least-loaded replica pick, then the replica's continuous batcher.
-        Returns the request Future.  Raises `KeyError` (unknown model) or
-        `RejectedError` (shed / capacity)."""
+        """Route one request: admission check (SLO shed ordering + the
+        degraded ladder's priority floor), warm-pool admission if the
+        model is cold (LRU-evicting as needed), least-loaded replica
+        pick, then the replica's continuous batcher — wrapped in a
+        `FailoverRequest`, so a failed dispatch re-routes to the next
+        healthy replica with the remaining deadline budget and a slow
+        one is hedged speculatively.  Returns the request Future.
+        Raises `KeyError` (unknown model) or `RejectedError`
+        (shed / capacity)."""
         if self._closed:
             raise RejectedError("fleet is shut down")
         member = self.member(name)
@@ -825,9 +1038,9 @@ class ModelFleet:
             member.last_used = time.monotonic()
             try:
                 replica = self.router.pick(member)
-                fut = replica.server.submit(name, x,
-                                            priority=batch_priority,
-                                            deadline_ms=dl)
+                req = FailoverRequest(self, member, np.asarray(x),
+                                      batch_priority, dl, t0)
+                fut = req.start(replica)
                 break
             except RejectedError as e:
                 last_err = e
@@ -839,7 +1052,6 @@ class ModelFleet:
             (time.monotonic() - t0) * 1000.0)
         self.instruments.requests(name).inc()
         member.requests += 1
-        fut.add_done_callback(self._make_observer(member, replica, t0))
         return fut
 
     def output(self, name: str, x, priority: Optional[int] = None,
@@ -848,26 +1060,6 @@ class ModelFleet:
         """Blocking convenience form of `submit`."""
         return self.submit(name, x, priority=priority,
                            deadline_ms=deadline_ms).result(timeout=timeout)
-
-    def _make_observer(self, member: FleetMember, replica: Replica,
-                       t0: float):
-        def _done(fut: Future) -> None:
-            exc = fut.exception()
-            if isinstance(exc, RejectedError):
-                return                      # never dispatched: no latency
-            if exc is not None:
-                # dispatch blew up: health accounting, no latency sample
-                # (a crashed request has no meaningful service time)
-                thr = getattr(self.router.policy, "unhealthy_after", 3)
-                if replica.record_failure(thr):
-                    self.instruments.replica_unhealthy.inc()
-                return
-            replica.record_success()    # a passed probe re-enters routing
-            member.latency.observe((time.monotonic() - t0) * 1000.0)
-            member._obs += 1
-            if member._obs % self.observe_every == 0:
-                self._observe_member(member)
-        return _done
 
     # ---- SLO observation ----
     def _observe_member(self, member: FleetMember) -> None:
@@ -935,6 +1127,11 @@ class ModelFleet:
                                else 0),
             },
             "shed_level": self.router.shed_level(),
+            "degraded": self.ladder.describe(),
+            "snapshot": ({"path": self.snapshotter.path,
+                          "age_s": round(self.snapshotter.age_s(), 3),
+                          "saves": self.snapshotter.saves}
+                         if self.snapshotter is not None else None),
             "policy": dataclasses.asdict(self.policy),
             "resident_bytes": (self.resident_bytes()
                                if self._members else 0),
@@ -946,10 +1143,89 @@ class ModelFleet:
             "uptime_s": now - self._started,
         }
 
+    # ---- snapshot / restore ----
+    def _tick_snapshot(self) -> None:
+        """Reconcile-tick hook: periodic save + age-gauge refresh."""
+        snap = self.snapshotter
+        if snap is None:
+            return
+        try:
+            snap.maybe_save()
+        except Exception:           # a full disk must not kill reconcile
+            pass
+        self.instruments.snapshot_age.set(round(snap.age_s(), 3))
+
+    def save_snapshot(self) -> Optional[str]:
+        """Commit one topology snapshot now (crc-guarded, atomic)."""
+        if self.snapshotter is None:
+            return None
+        return self.snapshotter.save()
+
+    def restore_snapshot(self, path: Optional[str] = None
+                         ) -> Dict[str, Any]:
+        """Rebuild this fleet to a snapshotted topology.  The models
+        themselves must already be `deploy()`-ed (weights are
+        application state, not topology); this re-applies per-member
+        replica targets, slice placements, pinned serving / quantized
+        versions, SLO-tracker hysteresis and the degraded-ladder level,
+        then re-admits the snapshot's resident set in its original
+        order — through the warm pool and the shared persistent AOT
+        cache, so a restart on the same `cache_dir` reconverges with
+        ZERO cold compiles.  Returns a report: members restored /
+        missing (snapshotted but not deployed), and the fresh-compile
+        count the restore paid (0 on the warm path)."""
+        p = path if path is not None else (
+            self.snapshotter.path if self.snapshotter is not None else None)
+        if p is None:
+            raise ValueError("restore_snapshot: no path (fleet built "
+                             "without snapshot_path)")
+        body = load_snapshot(p)
+        restored, missing = [], []
+        before = self.cache.stats["compiles"] if self.cache else None
+        with self._admission_lock:
+            self.ladder.restore_state(body.get("degraded", {}))
+            self.instruments.degraded_level.set(self.ladder.level)
+            for name, rec in body.get("members", {}).items():
+                m = self._members.get(name)
+                if m is None:
+                    missing.append(name)
+                    continue
+                m.replicas_target = max(int(rec.get("replicas_target", 1)),
+                                        1)
+                versions = set(self.registry.versions(name))
+                sv = rec.get("serving_version")
+                qv = rec.get("quantized_version")
+                m.serving_version = sv if sv in versions else None
+                m.quantized_version = qv if qv in versions else None
+                m.tracker.restore_state(rec.get("tracker", {}))
+                # previous placements first: on device-pinned fleets the
+                # AOT key includes the mesh fingerprint, so same slice =
+                # zero-recompile re-admission
+                m.preferred_slices = [
+                    i for i in rec.get("slices", [])
+                    + rec.get("preferred_slices", [])
+                    if 0 <= i < len(self._slices)]
+                restored.append(name)
+            for name in body.get("resident", []):
+                m = self._members.get(name)
+                if m is not None:
+                    self.pool.ensure_resident(m)
+        fresh = (self.cache.stats["compiles"] - before
+                 if self.cache else None)
+        return {"restored": restored, "missing": missing,
+                "resident": self.pool.resident_names(),
+                "degraded_level": self.ladder.level,
+                "fresh_compiles": fresh}
+
     # ---- health ----
     def healthz(self) -> dict:
         return {"ok": True, "models": len(self._members),
                 "resident": len(self.pool.resident()),
+                "degraded_level": self.ladder.level,
+                "degraded_mode": self.ladder.name,
+                "snapshot_age_s": (round(self.snapshotter.age_s(), 3)
+                                   if self.snapshotter is not None
+                                   else None),
                 "uptime_s": time.monotonic() - self._started}
 
     def readyz(self) -> dict:
@@ -973,16 +1249,28 @@ class ModelFleet:
 
     # ---- lifecycle ----
     def shutdown(self, drain: bool = True, timeout: float = 10.0) -> None:
-        """Stop the controller, refuse new submits, drain every resident
-        replica so accepted Futures resolve.  Idempotent."""
+        """Stop the controller and hedge scheduler, refuse new submits,
+        commit a final topology snapshot (when configured), then drain
+        every resident replica CONCURRENTLY under one shared deadline so
+        accepted Futures resolve.  Idempotent."""
         self._closed = True
         self.controller.stop()
+        self._hedge_scheduler.stop()
+        if self.snapshotter is not None:
+            try:
+                self.snapshotter.save()
+            except Exception:       # best-effort: shutdown must finish
+                pass
         with self._admission_lock:
-            for m in self.pool.resident():
-                group = m.group
-                if group is not None:
-                    for r in group.snapshot():
-                        r.server.shutdown(drain=drain, timeout=timeout)
+            replicas = [r for m in self.pool.resident()
+                        if m.group is not None
+                        for r in m.group.snapshot()]
+            if drain:
+                drain_replicas(replicas, timeout=timeout,
+                               counter=self.instruments.drain_timeouts)
+            else:
+                for r in replicas:
+                    r.server.shutdown(drain=False, timeout=timeout)
 
     def __enter__(self) -> "ModelFleet":
         return self

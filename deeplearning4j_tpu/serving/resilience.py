@@ -1,0 +1,698 @@
+"""Serving-side fault tolerance: the mirror of `train/resilience.py`.
+
+`train/resilience.py` makes a killed training job finish; this module
+makes a fleet that loses a replica keep answering.  Four pieces, composed
+by `serving.fleet`:
+
+    classify_error      client-input errors (bad shape/dtype ValueErrors)
+                        never count toward replica health — only
+                        dispatch/runtime faults trip the breaker, and a
+                        `FatalReplicaError` poisons the replica for
+                        immediate respawn
+    CircuitBreaker      closed / open / half-open per replica, replacing
+                        the raw consecutive-failure flag; half-open probes
+                        ride the router's existing every-8th-probe
+                        admission machinery
+    FailoverRequest     one client request across N replica attempts:
+                        a failed dispatch re-routes to the next healthy
+                        replica (budget carried across attempts), a slow
+                        one is hedged speculatively, and the first
+                        completion wins — a late original and its hedge
+                        never both count (`fleet_hedge_wasted_total`)
+    DegradedLadder      full → hedges off → int8 quantized routing →
+                        priority shed floor; explicit named levels with
+                        hysteresis in both directions, exported via
+                        `/healthz`
+    FleetSnapshotter    periodic, crc-guarded, atomically committed JSON
+                        snapshot of fleet topology (members, versions,
+                        placements, resident set, SLO/breaker state) so a
+                        restarted fleet process rebuilds to its pre-crash
+                        shape through the warm pool + persistent AOT
+                        cache with zero cold compiles
+
+Same commit discipline as the training CheckpointManager: crc32 over the
+canonical payload, tmp-file + `os.replace` rename commit, corrupt
+snapshots detected on load (`SnapshotCorruptError`), never silently
+half-applied.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import zlib
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+from deeplearning4j_tpu.serving.batcher import (DeadlineExceededError,
+                                                RejectedError)
+
+# ---------------------------------------------------------------------------
+# Error classification
+# ---------------------------------------------------------------------------
+
+
+class FatalReplicaError(RuntimeError):
+    """A dispatch error class that poisons the replica: the device/server
+    behind it is gone (not transient), so the controller tears it down
+    and respawns it instead of waiting out a probe cycle."""
+
+
+class ReplicaKilledError(FatalReplicaError):
+    """The chaos harness's replica-kill fault (a dead device stays dead
+    until the replica is rebuilt)."""
+
+
+#: exception classes that are the CLIENT's fault — malformed input (bad
+#: shape, bad dtype, unknown key) — and must never count toward replica
+#: health or trip the breaker
+CLIENT_ERROR_TYPES = (ValueError, TypeError, KeyError)
+
+
+def classify_error(exc: BaseException) -> str:
+    """Map one request exception to its health-accounting class:
+
+    * ``"fatal"``    — `FatalReplicaError`: poison the replica, respawn;
+    * ``"deadline"`` — the request's own budget ran out in queue (queue
+      pressure, not a replica fault — the SLO tracker owns latency);
+    * ``"overload"`` — `RejectedError` from a replica's bounded queue
+      (shed, not broken; failover may retry elsewhere);
+    * ``"client"``   — malformed input; the replica did nothing wrong;
+    * ``"dispatch"`` — everything else: a runtime fault that counts
+      toward the breaker.
+    """
+    if isinstance(exc, FatalReplicaError):
+        return "fatal"
+    if isinstance(exc, DeadlineExceededError):
+        return "deadline"
+    if isinstance(exc, RejectedError):
+        return "overload"
+    if isinstance(exc, CLIENT_ERROR_TYPES):
+        return "client"
+    return "dispatch"
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class CircuitBreaker:
+    """Per-replica dispatch circuit breaker.
+
+    States: **closed** (routable; failures count), **open** (out of
+    routing; only probe traffic reaches it), **half-open** (a probe is in
+    flight — the router's every-`probe_every`-th pick moved it here).
+
+    Transition rules, all linearized under one lock so a probe success
+    racing a fresh failure can neither oscillate nor deadlock:
+
+    * closed --`threshold` consecutive failures--> open
+    * open --router probe pick (`try_probe`)--> half-open
+    * half-open --probe success--> closed;  --probe failure--> open
+    * any success resets the consecutive-failure count and closes the
+      breaker, so the pinned winner of a success/failure race is always
+      CLOSED: a failure arriving after the closing success counts 1
+      toward a *fresh* threshold instead of instantly re-opening.
+
+    `opened_at` keeps the FIRST open timestamp across half-open↔open
+    probe cycles — the controller's respawn deadline measures from the
+    original failure, not the latest failed probe.
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(self, threshold: int = 3):
+        self.threshold = max(int(threshold), 1)
+        self._lock = threading.Lock()
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+        self.failures = 0
+        self.opens_total = 0
+        self.opened_at: Optional[float] = None      # monotonic
+
+    def record_failure(self, threshold: Optional[int] = None) -> bool:
+        """Count one dispatch failure; returns True when this failure
+        flipped the breaker open (the replica left routing)."""
+        thr = self.threshold if threshold is None else max(int(threshold), 1)
+        with self._lock:
+            self.failures += 1
+            self.consecutive_failures += 1
+            if self.state == self.HALF_OPEN:         # the probe failed
+                self.state = self.OPEN
+                if self.opened_at is None:
+                    self.opened_at = time.monotonic()
+                return False
+            if self.state == self.CLOSED \
+                    and self.consecutive_failures >= thr:
+                self.state = self.OPEN
+                self.opens_total += 1
+                self.opened_at = time.monotonic()
+                return True
+            return False
+
+    def record_success(self) -> bool:
+        """One served request; returns True when it closed an open /
+        half-open breaker (the probe passed, the replica re-enters)."""
+        with self._lock:
+            self.consecutive_failures = 0
+            if self.state != self.CLOSED:
+                self.state = self.CLOSED
+                self.opened_at = None
+                return True
+            return False
+
+    def try_probe(self) -> bool:
+        """Router probe pick: move an open breaker to half-open (the
+        probe request is now in flight).  Returns True when the state
+        changed."""
+        with self._lock:
+            if self.state == self.OPEN:
+                self.state = self.HALF_OPEN
+                return True
+            return False
+
+    def force_open(self) -> bool:
+        """Trip the breaker immediately (fatal/poisoned error class —
+        no point counting to threshold on a dead device)."""
+        with self._lock:
+            if self.state == self.OPEN:
+                return False
+            was_closed = self.state == self.CLOSED
+            self.state = self.OPEN
+            if was_closed:
+                self.opens_total += 1
+            if self.opened_at is None:
+                self.opened_at = time.monotonic()
+            return was_closed
+
+    def level(self) -> int:
+        """Numeric export for `fleet_breaker_state`: 0=closed,
+        1=half-open, 2=open."""
+        return {self.CLOSED: 0, self.HALF_OPEN: 1, self.OPEN: 2}[self.state]
+
+    def describe(self) -> Dict[str, Any]:
+        return {"state": self.state,
+                "consecutive_failures": self.consecutive_failures,
+                "failures": self.failures,
+                "opens_total": self.opens_total,
+                "open_for_s": (round(time.monotonic() - self.opened_at, 3)
+                               if self.opened_at is not None else None)}
+
+
+# ---------------------------------------------------------------------------
+# Concurrent drain
+# ---------------------------------------------------------------------------
+
+
+def drain_replicas(replicas, timeout: float = 10.0,
+                   counter=None) -> List[str]:
+    """Drain many replica servers concurrently under ONE shared deadline
+    (the serial form let a single hung replica burn the whole budget
+    before the next was even tried).  Returns the names of replicas whose
+    drain did NOT finish inside the deadline; each expiry increments
+    `counter` (`serving_drain_timeouts_total`) when one is given.  An
+    expired drain keeps running on its daemon thread — its leftover
+    futures still fail over; we just stop waiting for it."""
+    replicas = list(replicas)
+    if not replicas:
+        return []
+    threads = []
+    for r in replicas:
+        t = threading.Thread(
+            target=r.server.shutdown,
+            kwargs={"drain": True, "timeout": timeout},
+            daemon=True, name=f"drain-{r.name}")
+        t.start()
+        threads.append(t)
+    deadline = time.monotonic() + timeout
+    expired = []
+    for r, t in zip(replicas, threads):
+        t.join(timeout=max(deadline - time.monotonic(), 0.0))
+        if t.is_alive():
+            expired.append(r.name)
+            if counter is not None:
+                counter.inc()
+    return expired
+
+
+# ---------------------------------------------------------------------------
+# Hedged / failover dispatch
+# ---------------------------------------------------------------------------
+
+
+class _HedgeScheduler:
+    """One daemon timer thread for the whole fleet: a heap of
+    (fire_at, callback) entries instead of a `threading.Timer` per
+    request (a flood would otherwise churn thousands of threads)."""
+
+    def __init__(self):
+        self._heap: List[list] = []
+        self._cond = threading.Condition()
+        self._seq = 0
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+
+    def schedule(self, fire_at: float, fn) -> list:
+        entry = [fire_at, self._seq, fn, False]      # [at, seq, fn, dead]
+        with self._cond:
+            if self._stopped:
+                entry[3] = True
+                return entry
+            self._seq += 1
+            entry[1] = self._seq
+            import heapq
+            heapq.heappush(self._heap, entry)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True, name="fleet-hedges")
+                self._thread.start()
+            self._cond.notify_all()
+        return entry
+
+    @staticmethod
+    def cancel(entry: list) -> None:
+        entry[3] = True
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._heap = []
+            self._cond.notify_all()
+
+    def _loop(self) -> None:
+        import heapq
+        while True:
+            with self._cond:
+                if self._stopped:
+                    return
+                if not self._heap:
+                    self._cond.wait(timeout=0.5)
+                    continue
+                now = time.monotonic()
+                if self._heap[0][0] > now:
+                    self._cond.wait(timeout=self._heap[0][0] - now)
+                    continue
+                entry = heapq.heappop(self._heap)
+            if not entry[3]:
+                try:
+                    entry[2]()
+                except Exception:       # a hedge is best-effort
+                    pass
+
+
+class FailoverRequest:
+    """One fleet request across bounded replica attempts.
+
+    The client sees ONE Future.  Per-attempt futures feed `_on_done`:
+    a success settles the client future (first completion wins — any
+    later duplicate counts `fleet_hedge_wasted_total` and is dropped);
+    a failover-eligible failure re-routes to the next healthy replica
+    with the REMAINING deadline budget; and while the original is still
+    in flight, the fleet's hedge scheduler may launch one speculative
+    duplicate after `hedge_fraction` of the budget has elapsed
+    (`fleet_hedges_total`, disabled at degraded level >= hedges_off).
+
+    Per-attempt health accounting runs through `classify_error`: client
+    errors never touch the breaker, fatal errors poison the replica,
+    deadline/overload outcomes are pressure (not replica faults), and
+    only genuine dispatch faults count toward opening it.
+    """
+
+    def __init__(self, fleet, member, x, priority: int,
+                 deadline_ms: Optional[float], t0: float):
+        self.fleet = fleet
+        self.member = member
+        self.x = x
+        self.priority = priority
+        self.t0 = t0
+        self.deadline_at = (None if deadline_ms is None
+                            else t0 + float(deadline_ms) / 1000.0)
+        self.future: Future = Future()
+        self._lock = threading.Lock()
+        self._settled = False
+        self._tried: List[Any] = []
+        self._inflight = 0
+        self._failovers = 0
+        self._hedges = 0
+        self._hedge_handle: Optional[list] = None
+        self._last_exc: Optional[BaseException] = None
+
+    # ---- lifecycle ----
+    def start(self, replica) -> Future:
+        """Launch the primary attempt (exceptions — RejectedError on a
+        full queue, ValueError on malformed input — propagate to the
+        caller: nothing was accepted yet) and arm the hedge timer."""
+        self._launch(replica)
+        pol = self.fleet.policy
+        if (self.deadline_at is not None and pol.max_hedges > 0
+                and self.fleet.ladder.hedges_enabled()):
+            budget = self.deadline_at - self.t0
+            self._hedge_handle = self.fleet._hedge_scheduler.schedule(
+                self.t0 + pol.hedge_fraction * budget, self._hedge)
+        return self.future
+
+    # ---- attempts ----
+    def _remaining_ms(self) -> Optional[float]:
+        if self.deadline_at is None:
+            return None
+        return (self.deadline_at - time.monotonic()) * 1000.0
+
+    def _launch(self, replica) -> None:
+        rem = self._remaining_ms()
+        if rem is not None and rem <= 0.0:
+            raise DeadlineExceededError(
+                "request budget exhausted before dispatch")
+        fut = replica.server.submit(
+            self.member.name, self.x,
+            version=self.fleet._route_version(self.member),
+            priority=self.priority, deadline_ms=rem)
+        with self._lock:
+            self._inflight += 1
+            self._tried.append(replica)
+        fut.add_done_callback(
+            lambda f, r=replica: self._on_done(r, f))
+
+    def _pick_next(self, allow_tried: bool):
+        group = self.member.group
+        snap = group.snapshot() if group is not None else []
+        fresh = [r for r in snap if r.healthy and r not in self._tried]
+        pool = fresh
+        if not pool and allow_tried:
+            pool = [r for r in snap if r.healthy]
+        if not pool:
+            return None
+        return min(pool, key=lambda r: r.queue_depth)
+
+    def _hedge(self) -> None:
+        pol = self.fleet.policy
+        if not self.fleet.ladder.hedges_enabled():
+            return                      # the ladder turned hedging off
+        with self._lock:
+            if self._settled or self._hedges >= pol.max_hedges:
+                return
+            self._hedges += 1
+        replica = self._pick_next(allow_tried=False)
+        if replica is None:
+            return                      # nowhere useful to duplicate to
+        self.fleet.instruments.hedges.inc()
+        try:
+            self._launch(replica)
+        except Exception:
+            pass                        # speculative: losing it is fine
+
+    # ---- completion ----
+    def _on_done(self, replica, fut: Future) -> None:
+        exc = fut.exception()
+        self._account(replica, exc)
+        with self._lock:
+            self._inflight -= 1
+            if self._settled:
+                if exc is None:
+                    # duplicate suppression: the client already has its
+                    # answer — a late original/hedge must not count twice
+                    self.fleet.instruments.hedge_wasted.inc()
+                return
+        if exc is None:
+            self._settle_ok(fut.result())
+            return
+        cls = classify_error(exc)
+        pol = self.fleet.policy
+        if (cls in ("dispatch", "fatal", "overload")
+                and self._failovers < pol.max_failovers):
+            rem = self._remaining_ms()
+            if rem is None or rem > 0.0:
+                nxt = self._pick_next(allow_tried=True)
+                if nxt is not None:
+                    self._failovers += 1
+                    self.fleet.instruments.failovers.inc()
+                    try:
+                        self._launch(nxt)
+                        return
+                    except Exception as launch_exc:
+                        exc = launch_exc
+        with self._lock:
+            self._last_exc = exc
+            if self._inflight > 0:
+                return                  # a hedge may still save this one
+        self._settle_exc(exc)
+
+    def _account(self, replica, exc: Optional[BaseException]) -> None:
+        fleet = self.fleet
+        if exc is None:
+            if replica.record_success():
+                fleet._note_breaker(self.member)
+            return
+        cls = classify_error(exc)
+        if cls == "client":
+            self.member.client_errors += 1
+            return
+        if cls in ("deadline", "overload"):
+            return                      # pressure, not a replica fault
+        if cls == "fatal":
+            if replica.poison(exc):
+                fleet.instruments.replica_unhealthy.inc()
+        elif replica.record_failure(fleet.policy.unhealthy_after):
+            fleet.instruments.replica_unhealthy.inc()
+        fleet._note_breaker(self.member)
+
+    def _settle_ok(self, result) -> None:
+        with self._lock:
+            if self._settled:
+                return
+            self._settled = True
+        if self._hedge_handle is not None:
+            _HedgeScheduler.cancel(self._hedge_handle)
+        member, fleet = self.member, self.fleet
+        member.latency.observe((time.monotonic() - self.t0) * 1000.0)
+        member._obs += 1
+        if member._obs % fleet.observe_every == 0:
+            fleet._observe_member(member)
+        self.future.set_result(result)
+
+    def _settle_exc(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._settled:
+                return
+            self._settled = True
+        if self._hedge_handle is not None:
+            _HedgeScheduler.cancel(self._hedge_handle)
+        self.future.set_exception(exc)
+
+
+# ---------------------------------------------------------------------------
+# Degraded-mode ladder
+# ---------------------------------------------------------------------------
+
+#: ladder levels, mildest first.  Each is a NAMED operating mode the
+#: fleet steps through explicitly (and exports via /healthz) instead of
+#: shedding opaquely.
+LADDER_LEVELS = ("full", "hedges_off", "quantized", "shed_floor")
+
+
+class DegradedLadder:
+    """Explicit degraded-mode state machine with hysteresis.
+
+    `observe(pressured)` is fed once per reconcile tick: after
+    `down_after` consecutive pressured ticks the fleet steps DOWN one
+    level (full → hedges_off → quantized → shed_floor); after `up_after`
+    consecutive healthy ticks it recovers one level in reverse.  One
+    level per flip in either direction — the ladder never jumps, so each
+    transition is an auditable event (`transitions`).
+    """
+
+    def __init__(self, down_after: int = 2, up_after: int = 3):
+        self.down_after = max(int(down_after), 1)
+        self.up_after = max(int(up_after), 1)
+        self.level = 0
+        self.transitions: List[Dict[str, Any]] = []
+        self._down = 0
+        self._up = 0
+        self._lock = threading.Lock()
+
+    @property
+    def name(self) -> str:
+        return LADDER_LEVELS[self.level]
+
+    def hedges_enabled(self) -> bool:
+        return self.level < LADDER_LEVELS.index("hedges_off")
+
+    def quantized_routing(self) -> bool:
+        return self.level >= LADDER_LEVELS.index("quantized")
+
+    def shed_floor(self) -> bool:
+        return self.level >= LADDER_LEVELS.index("shed_floor")
+
+    def observe(self, pressured: bool, why: str = "") -> int:
+        with self._lock:
+            if pressured:
+                self._down += 1
+                self._up = 0
+                if self._down >= self.down_after \
+                        and self.level < len(LADDER_LEVELS) - 1:
+                    self._step(+1, why or "sustained pressure")
+            else:
+                self._up += 1
+                self._down = 0
+                if self._up >= self.up_after and self.level > 0:
+                    self._step(-1, "recovered")
+            return self.level
+
+    def _step(self, delta: int, why: str) -> None:
+        """Caller holds the lock."""
+        frm = self.name
+        self.level += delta
+        self._down = self._up = 0
+        self.transitions.append({"at": time.time(), "from": frm,
+                                 "to": self.name, "why": why})
+        if len(self.transitions) > 64:
+            del self.transitions[:-64]
+
+    def to_state(self) -> Dict[str, Any]:
+        return {"level": self.level}
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        with self._lock:
+            self.level = min(max(int(state.get("level", 0)), 0),
+                             len(LADDER_LEVELS) - 1)
+            self._down = self._up = 0
+
+    def describe(self) -> Dict[str, Any]:
+        return {"level": self.level, "name": self.name,
+                "transitions": list(self.transitions[-8:])}
+
+
+# ---------------------------------------------------------------------------
+# Fleet snapshot / restore
+# ---------------------------------------------------------------------------
+
+
+class SnapshotCorruptError(RuntimeError):
+    """The snapshot file failed its crc32 / structure check."""
+
+
+SNAPSHOT_FORMAT = 1
+
+
+def _canonical(body: Dict[str, Any]) -> bytes:
+    return json.dumps(body, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+
+
+def load_snapshot(path: str) -> Dict[str, Any]:
+    """Read + verify one committed snapshot; returns the topology body.
+    Raises `SnapshotCorruptError` on a torn write, bad crc, or format
+    mismatch — a restore must never half-apply rotten state."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            payload = json.load(f)
+    except (OSError, ValueError) as e:
+        raise SnapshotCorruptError(f"{path}: unreadable snapshot: {e!r}")
+    if not isinstance(payload, dict) or "fleet" not in payload \
+            or "crc32" not in payload:
+        raise SnapshotCorruptError(f"{path}: not a fleet snapshot")
+    if payload.get("format") != SNAPSHOT_FORMAT:
+        raise SnapshotCorruptError(
+            f"{path}: snapshot format {payload.get('format')!r} != "
+            f"{SNAPSHOT_FORMAT}")
+    body = payload["fleet"]
+    crc = zlib.crc32(_canonical(body)) & 0xFFFFFFFF
+    if crc != payload["crc32"]:
+        raise SnapshotCorruptError(
+            f"{path}: crc mismatch (stored {payload['crc32']}, "
+            f"computed {crc})")
+    return body
+
+
+class FleetSnapshotter:
+    """Periodic crc-guarded snapshot of fleet topology.
+
+    `save()` collects the fleet's current shape under the admission lock
+    (members + SLO contracts, replica placements, resident order,
+    versions, tracker/breaker state, ladder level), stamps a crc32 over
+    the canonical JSON and commits with tmp-write + `os.replace` — the
+    same atomic discipline as the training CheckpointManager, so a crash
+    mid-save leaves the previous snapshot intact.  `maybe_save()` is the
+    reconcile-tick hook (no-op until `interval_s` has elapsed).
+    """
+
+    def __init__(self, fleet, path: str,
+                 interval_s: Optional[float] = None):
+        self.fleet = fleet
+        self.path = str(path)
+        self.interval_s = interval_s
+        self.last_saved: Optional[float] = None      # monotonic
+        self.saves = 0
+        self._lock = threading.Lock()
+
+    # ---- age ----
+    def age_s(self) -> float:
+        """Seconds since the last committed save; -1.0 before the
+        first (the `fleet_snapshot_age_s` gauge value)."""
+        if self.last_saved is None:
+            return -1.0
+        return time.monotonic() - self.last_saved
+
+    def maybe_save(self) -> bool:
+        if self.interval_s is None:
+            return False
+        if self.last_saved is not None \
+                and time.monotonic() - self.last_saved < self.interval_s:
+            return False
+        self.save()
+        return True
+
+    # ---- save ----
+    def save(self) -> str:
+        with self._lock:
+            body = self._collect()
+            payload = {"format": SNAPSHOT_FORMAT, "saved_at": time.time(),
+                       "fleet": body,
+                       "crc32": zlib.crc32(_canonical(body)) & 0xFFFFFFFF}
+            d = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(d, exist_ok=True)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            self.last_saved = time.monotonic()
+            self.saves += 1
+            self.fleet.instruments.snapshot_age.set(0.0)
+        return self.path
+
+    def _collect(self) -> Dict[str, Any]:
+        fleet = self.fleet
+        with fleet._admission_lock:
+            members: Dict[str, Any] = {}
+            for name, m in fleet._members.items():
+                group = m.group
+                replicas = group.snapshot() if group is not None else []
+                members[name] = {
+                    "slo": {"target_p99_ms": m.slo.target_p99_ms,
+                            "priority": m.slo.priority,
+                            "deadline_ms": m.slo.deadline_ms},
+                    "state": m.state,
+                    "replicas_target": m.replicas_target,
+                    "slices": [r.slice.index for r in replicas],
+                    "preferred_slices": list(m.preferred_slices),
+                    "serving_version": m.serving_version,
+                    "quantized_version": m.quantized_version,
+                    "versions": fleet.registry.versions(name),
+                    "tracker": m.tracker.to_state(),
+                    "breakers": [{"slice": r.slice.index,
+                                  **r.breaker.describe()}
+                                 for r in replicas],
+                    "requests": m.requests,
+                }
+            return {
+                "max_resident": fleet.pool.max_resident,
+                "n_slices": len(fleet._slices),
+                "resident": fleet.pool.resident_names(),
+                "degraded": fleet.ladder.to_state(),
+                "members": members,
+            }

@@ -70,9 +70,27 @@ class FleetPolicy:
     `shrink_idle_after_s` — reconcile reclaims a slice from a member
     whose group has been idle (zero queue, no breach) this long.
     `unhealthy_after` — consecutive dispatch FAILURES (exceptions, not
-    SLO breaches) before a replica is marked unhealthy and removed from
-    routing; it re-enters only after passing a probe request (the
+    SLO breaches) before a replica's circuit breaker opens and it leaves
+    routing; it re-enters only after a half-open probe passes (the
     serving mirror of the gang heartbeat deadline).
+
+    Fault-tolerance knobs (serving/resilience.py):
+    `hedge_fraction` — launch one speculative duplicate dispatch once
+    this fraction of a request's deadline budget has elapsed unanswered
+    (0 < f <= 1; requires a deadline — no budget, no hedge).
+    `max_hedges` / `max_failovers` — per-request bounds on speculative
+    duplicates and reactive re-routes after a failed attempt.
+    `respawn_after_s` — a breaker open this long (measured from its
+    FIRST open, across failed probes) gets its replica torn down and
+    respawned on the same slice by the controller.
+    `hang_after_s` — a dispatch stuck on the device this long marks the
+    replica hung and respawns it.
+    `drain_timeout_s` — shared deadline for concurrent replica drains
+    during teardown/respawn (expiries count
+    `serving_drain_timeouts_total`).
+    `ladder_down_after` / `ladder_up_after` — consecutive pressured /
+    healthy reconcile ticks before the degraded-mode ladder steps down /
+    recovers one level.
     """
 
     breach_after: int = 3
@@ -81,6 +99,14 @@ class FleetPolicy:
     grow_at_queue: int = 8
     shrink_idle_after_s: float = 30.0
     unhealthy_after: int = 3
+    hedge_fraction: float = 0.5
+    max_hedges: int = 1
+    max_failovers: int = 2
+    respawn_after_s: float = 2.0
+    hang_after_s: float = 30.0
+    drain_timeout_s: float = 5.0
+    ladder_down_after: int = 2
+    ladder_up_after: int = 3
 
     def __post_init__(self):
         if self.mode not in ("shed", "deprioritize"):
@@ -90,6 +116,19 @@ class FleetPolicy:
             raise ValueError("breach_after/clear_after must be >= 1")
         if self.unhealthy_after < 1:
             raise ValueError("unhealthy_after must be >= 1")
+        if not (0.0 < self.hedge_fraction <= 1.0):
+            raise ValueError(
+                f"hedge_fraction must be in (0, 1], got {self.hedge_fraction}")
+        if self.max_hedges < 0 or self.max_failovers < 0:
+            raise ValueError("max_hedges/max_failovers must be >= 0")
+        if self.respawn_after_s < 0 or self.hang_after_s <= 0:
+            raise ValueError(
+                "respawn_after_s must be >= 0 and hang_after_s > 0")
+        if self.drain_timeout_s <= 0:
+            raise ValueError("drain_timeout_s must be > 0")
+        if self.ladder_down_after < 1 or self.ladder_up_after < 1:
+            raise ValueError(
+                "ladder_down_after/ladder_up_after must be >= 1")
 
 
 class SLOTracker:
@@ -137,3 +176,20 @@ class SLOTracker:
             "breached": self.breached,
             "breaches_total": self.breaches_total,
         }
+
+    # ---- fleet snapshot/restore (serving/resilience.py) ----
+    def to_state(self) -> dict:
+        """JSON-able internal state for the fleet topology snapshot."""
+        return {"breached": self.breached,
+                "breaches_total": self.breaches_total,
+                "last_p99_ms": self.last_p99_ms,
+                "over": self._over, "under": self._under}
+
+    def restore_state(self, state: dict) -> None:
+        """Rehydrate from `to_state()` — a restarted fleet resumes
+        sustained-breach hysteresis where the crashed one left off."""
+        self.breached = bool(state.get("breached", False))
+        self.breaches_total = int(state.get("breaches_total", 0))
+        self.last_p99_ms = state.get("last_p99_ms")
+        self._over = int(state.get("over", 0))
+        self._under = int(state.get("under", 0))

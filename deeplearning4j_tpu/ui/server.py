@@ -108,11 +108,20 @@ class UIServer:
 
     def healthz(self) -> dict:
         """Liveness payload for `GET /healthz` — the server thread is up
-        and rendering."""
+        and rendering.  Attached fleets contribute their degraded-mode
+        ladder level (serving/resilience.py), so one liveness probe also
+        tells the operator which named operating mode each fleet is in."""
+        fleets = []
+        for f in list(self._fleets):
+            try:
+                fleets.append(f.healthz())
+            except Exception as e:      # a dead fleet must not 500 /healthz
+                fleets.append({"ok": False, "error": repr(e)})
         return {"ok": True,
                 "storages": len(self._storages) + len(self._paths),
                 "serving_sources": len(self._serving),
-                "fleets": len(self._fleets)}
+                "fleets": len(self._fleets),
+                "fleet_health": fleets}
 
     def readyz(self) -> dict:
         """Aggregate readiness for `GET /readyz`: every attached serving

@@ -13,6 +13,8 @@ radius is observable next to the recovery counters it should trigger.
     FlakyIterator        data producer that raises at batch K (N times)
     SlowIterator         data producer with a fixed per-batch stall
     FlakyDispatch        serving dispatch_fn that raises N times
+    ReplicaChaos         kill/hang/slow/flaky ONE live fleet replica
+                         (serving self-healing / failover scenarios)
 
 None of this is imported by production code paths — tests (and operators
 running game days) compose it in explicitly.
@@ -277,3 +279,108 @@ class FlakyDispatch:
             _count("dispatch")
             raise self.exc_type("injected dispatch failure")
         return self.fn(*args, **kwargs)
+
+
+class ReplicaChaos:
+    """Injects a REPLICA-LEVEL fault into one live fleet replica, the
+    serving mirror of :class:`PeerKiller`.  `arm(replica)` wraps the
+    replica server's compiled-run entry point (`server.cache.run`) so
+    the fault fires inside the dispatch path, exactly where a real
+    device failure surfaces.  `mode`:
+
+      * ``"kill"``  — from dispatch `at_dispatch` onward EVERY run
+        raises :class:`serving.resilience.ReplicaKilledError` (a dead
+        device stays dead): the request fails over, the replica is
+        poisoned, and the controller respawns it — the respawned
+        replica gets a fresh server + cache, so the wrap does not
+        survive the heal;
+      * ``"hang"``  — dispatch `at_dispatch` sleeps `duration_s`
+        INSIDE the run (the batcher worker is stuck; `inflight_age_s`
+        grows): hedges cover the stuck requests, the controller
+        declares the replica hung and respawns it;
+      * ``"slow"``  — every dispatch sleeps `delay_s` (bounded, below
+        any failure deadline): the hedge-latency negative control — no
+        respawn may occur;
+      * ``"flaky"`` — raise :class:`ChaosError` for `times` dispatches
+        starting at `at_dispatch`, then behave: the breaker opens and
+        a half-open probe re-admits the replica, no respawn.
+
+    `marker` (file path) makes the injector one-shot across re-arms,
+    exactly like :class:`PeerKiller`.  `restore()` unwraps."""
+
+    def __init__(self, mode: str = "kill", at_dispatch: int = 0,
+                 duration_s: float = 2.0, delay_s: float = 0.05,
+                 times: int = 3, marker: Optional[str] = None):
+        if mode not in ("kill", "hang", "slow", "flaky"):
+            raise ValueError(f"unknown ReplicaChaos mode {mode!r}")
+        self.mode = mode
+        self.at_dispatch = int(at_dispatch)
+        self.duration_s = float(duration_s)
+        self.delay_s = float(delay_s)
+        self.times = int(times)
+        self.marker = marker
+        self.fired = False
+        self.calls = 0
+        self._cache = None
+        self._orig = None
+        self._hung = False
+        self._flaked = 0
+
+    def armed(self) -> bool:
+        if self.fired and self.mode in ("kill", "hang"):
+            return False
+        return self.marker is None or not os.path.exists(self.marker)
+
+    def arm(self, replica):
+        """Wrap one live replica's compiled-run entry point.  Accepts a
+        fleet `Replica` (or anything with `.server.cache.run`)."""
+        if self._cache is not None:
+            raise RuntimeError("ReplicaChaos is already armed")
+        self._cache = replica.server.cache
+        self._orig = self._cache.run
+        self._cache.run = self._run
+        return replica
+
+    def restore(self) -> None:
+        if self._cache is not None and self._orig is not None:
+            self._cache.run = self._orig
+        self._cache = self._orig = None
+
+    def _fire(self) -> None:
+        self.fired = True
+        if self.marker is not None:
+            with open(self.marker, "w") as f:
+                f.write(f"{self.mode}@{self.calls}")
+        _count(f"replica-{self.mode}")
+
+    def _run(self, *args, **kwargs):
+        self.calls += 1
+        armed = self.armed()
+        if self.mode == "kill":
+            if self.fired or (armed and self.calls > self.at_dispatch):
+                if not self.fired:
+                    self._fire()
+                # lazy import: chaos must not drag serving into every
+                # training-side test that imports utils.chaos
+                from deeplearning4j_tpu.serving.resilience import \
+                    ReplicaKilledError
+                raise ReplicaKilledError(
+                    f"injected replica kill at dispatch {self.calls}")
+        elif self.mode == "hang":
+            if armed and self.calls > self.at_dispatch:
+                self._fire()
+                time.sleep(self.duration_s)
+        elif self.mode == "slow":
+            if armed and self.calls > self.at_dispatch:
+                if not self.fired:
+                    self._fire()
+                time.sleep(self.delay_s)
+        else:                       # "flaky"
+            if armed and self.calls > self.at_dispatch \
+                    and self._flaked < self.times:
+                if not self.fired:
+                    self._fire()
+                self._flaked += 1
+                raise ChaosError(
+                    f"injected flaky dispatch {self._flaked}/{self.times}")
+        return self._orig(*args, **kwargs)

@@ -1,0 +1,522 @@
+"""Serving fault tolerance contract (ISSUE 12 acceptance): error
+classification (client errors never trip the breaker), the per-replica
+circuit breaker incl. the probe-readmission race, concurrent replica
+drain under a shared deadline, failover + hedged dispatch with duplicate
+suppression, controller self-healing (kill -> poison -> respawn on the
+same slice with zero fresh compiles; hang -> detect -> respawn), the
+degraded-mode ladder (hedges off -> quantized routing -> shed floor,
+hysteresis recovery), and the crc-guarded fleet topology
+snapshot/restore.  The full chaos-flood gate lives in
+`bench.py --fleetchaos` (slow-marked subprocess test at the bottom)."""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import (DenseLayer, InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.serving import (CircuitBreaker, DeadlineExceededError,
+                                        DegradedLadder, FailoverRequest,
+                                        FatalReplicaError, FleetPolicy,
+                                        LatencySLO, ModelFleet,
+                                        RejectedError, ReplicaKilledError,
+                                        SnapshotCorruptError, classify_error,
+                                        drain_replicas, load_snapshot)
+from deeplearning4j_tpu.serving.resilience import LADDER_LEVELS
+from deeplearning4j_tpu.train.updaters import Sgd
+from deeplearning4j_tpu.utils.chaos import ChaosError, ReplicaChaos
+
+
+def _net(seed=0, n_in=8, n_out=3, hidden=16):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(1e-1))
+            .list([DenseLayer(n_out=hidden, activation="relu"),
+                   OutputLayer(n_out=n_out, loss="mcxent",
+                               activation="softmax")])
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _x(n=2, n_in=8, seed=0):
+    return np.random.RandomState(seed).randn(n, n_in).astype(np.float32)
+
+
+def _fleet(tmp_path, **kw):
+    kw.setdefault("max_resident", 2)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("batch_timeout_ms", 1.0)
+    kw.setdefault("cache_dir", str(tmp_path / "exec-cache"))
+    return ModelFleet(**kw)
+
+
+# ---------------------------------------------------------------------------
+# Error classification
+# ---------------------------------------------------------------------------
+
+def test_classify_error_matrix():
+    assert classify_error(FatalReplicaError("dead")) == "fatal"
+    assert classify_error(ReplicaKilledError("chaos")) == "fatal"
+    assert classify_error(DeadlineExceededError("late")) == "deadline"
+    assert classify_error(RejectedError("full")) == "overload"
+    # malformed input is the CLIENT's fault — never a replica fault
+    assert classify_error(ValueError("bad shape")) == "client"
+    assert classify_error(TypeError("bad dtype")) == "client"
+    assert classify_error(KeyError("model")) == "client"
+    # everything else is a genuine dispatch/runtime fault
+    assert classify_error(RuntimeError("xla")) == "dispatch"
+    assert classify_error(ChaosError("injected")) == "dispatch"
+
+
+def test_client_errors_never_count_toward_replica_health(tmp_path):
+    with _fleet(tmp_path) as fleet:
+        m = fleet.deploy("m", _net(), replicas=1, warm=True)
+        replica = m.group.replicas[0]
+        req = FailoverRequest(fleet, m, _x(), 0, None, time.monotonic())
+        for _ in range(10):
+            req._account(replica, ValueError("bad input"))
+        assert replica.healthy
+        assert replica.breaker.consecutive_failures == 0
+        assert m.client_errors == 10
+        # deadline/overload outcomes are pressure, not replica faults
+        req._account(replica, DeadlineExceededError("late"))
+        req._account(replica, RejectedError("full"))
+        assert replica.healthy and replica.breaker.failures == 0
+        # a genuine dispatch fault DOES count
+        req._account(replica, RuntimeError("xla fault"))
+        assert replica.breaker.consecutive_failures == 1
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+
+def test_circuit_breaker_state_machine():
+    b = CircuitBreaker(threshold=3)
+    assert b.state == CircuitBreaker.CLOSED and b.level() == 0
+    assert not b.record_failure() and not b.record_failure()
+    assert b.record_failure()               # third consecutive: opens
+    assert b.state == CircuitBreaker.OPEN and b.level() == 2
+    assert b.opens_total == 1
+    first_open = b.opened_at
+    assert first_open is not None
+    # a probe pick moves it to half-open; a failed probe re-opens it
+    # WITHOUT resetting opened_at — the respawn deadline measures from
+    # the FIRST failure, not the latest failed probe
+    assert b.try_probe() and b.state == CircuitBreaker.HALF_OPEN
+    assert b.level() == 1
+    assert not b.record_failure()           # probe failed -> open again
+    assert b.state == CircuitBreaker.OPEN
+    assert b.opened_at == first_open
+    # a passed probe closes it and clears the open timestamp
+    assert b.try_probe()
+    assert b.record_success()
+    assert b.state == CircuitBreaker.CLOSED and b.opened_at is None
+    assert b.consecutive_failures == 0
+    # force_open (poison) trips immediately from closed
+    assert b.force_open() and b.state == CircuitBreaker.OPEN
+    assert b.opens_total == 2
+    assert not b.force_open()               # already open: no event
+
+
+def test_breaker_probe_race_pins_closed_winner():
+    """A probe success racing a fresh failure must neither oscillate nor
+    deadlock: the pinned winner is CLOSED — a failure that lands after
+    the closing success counts 1 toward a FRESH threshold instead of
+    instantly re-opening the breaker."""
+    for trial in range(200):
+        b = CircuitBreaker(threshold=3)
+        b.force_open()
+        b.try_probe()                        # probe in flight
+        barrier = threading.Barrier(2)
+
+        def probe_success():
+            barrier.wait()
+            b.record_success()
+
+        def fresh_failure():
+            barrier.wait()
+            b.record_failure()
+
+        # alternate start order so both interleavings get exercised
+        fns = [probe_success, fresh_failure]
+        if trial % 2:
+            fns.reverse()
+        threads = [threading.Thread(target=f) for f in fns]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5)
+        assert not any(t.is_alive() for t in threads), "deadlocked"
+        # failure-then-success -> success closes; success-then-failure
+        # -> failure counts 1 fresh.  Either way: CLOSED, cf <= 1.
+        assert b.state == CircuitBreaker.CLOSED
+        assert b.consecutive_failures <= 1
+
+
+# ---------------------------------------------------------------------------
+# Concurrent drain
+# ---------------------------------------------------------------------------
+
+class _Ctr:
+    def __init__(self):
+        self.n = 0
+
+    def inc(self):
+        self.n += 1
+
+
+class _FakeServer:
+    def __init__(self, delay):
+        self.delay = delay
+
+    def shutdown(self, drain=True, timeout=10.0):
+        time.sleep(self.delay)
+
+
+class _FakeReplica:
+    def __init__(self, name, delay):
+        self.name = name
+        self.server = _FakeServer(delay)
+
+
+def test_concurrent_drain_shared_deadline_reports_expiries():
+    """Two drains run CONCURRENTLY: a hung replica must not burn the
+    whole budget before the fast one is even tried, and the expiry is
+    named + counted."""
+    fast = _FakeReplica("fast", 0.2)
+    hung = _FakeReplica("hung", 5.0)
+    ctr = _Ctr()
+    t0 = time.monotonic()
+    expired = drain_replicas([fast, hung], timeout=0.6, counter=ctr)
+    wall = time.monotonic() - t0
+    assert expired == ["hung"]
+    assert ctr.n == 1
+    # serial would be 0.2 + 0.6; concurrent is bounded by ONE deadline
+    assert wall < 2.0
+    assert drain_replicas([], timeout=0.1) == []
+
+
+# ---------------------------------------------------------------------------
+# Failover + hedged dispatch
+# ---------------------------------------------------------------------------
+
+def test_killed_replica_fails_over_and_respawns_compile_free(tmp_path):
+    with _fleet(tmp_path, n_slices=2,
+                policy=FleetPolicy(drain_timeout_s=1.0)) as fleet:
+        m = fleet.deploy("m", _net(seed=1), replicas=2, warm=True)
+        fleet.output("m", _x(), timeout=30)          # buckets warm
+        victim = m.group.replicas[0]
+        victim_slice = victim.slice.index
+        failovers_before = fleet.instruments.failovers.value
+        respawns_before = fleet.instruments.respawns("poisoned").value
+        chaos = ReplicaChaos(mode="kill", at_dispatch=0)
+        chaos.arm(victim)
+        # every accepted request resolves: a kill on its replica fails
+        # over to the healthy one, never surfaces to the client
+        futs = [fleet.submit("m", _x(seed=i), deadline_ms=4000.0)
+                for i in range(16)]
+        assert all(f.exception(timeout=30) is None for f in futs)
+        assert victim.poisoned
+        assert victim.breaker.state == CircuitBreaker.OPEN
+        assert fleet.instruments.failovers.value > failovers_before
+        # the controller tears it down and respawns ON THE SAME SLICE
+        # through the persistent AOT cache: deserialize, not recompile
+        rec = fleet.controller.reconcile()
+        respawns = [a for a in rec["actions"] if a["action"] == "respawn"]
+        assert len(respawns) == 1
+        assert respawns[0]["cause"] == "poisoned"
+        assert respawns[0]["slice"] == victim_slice
+        assert respawns[0]["fresh_compiles"] == 0
+        assert m.respawns == 1
+        assert m.last_respawn["fresh_compiles"] == 0
+        assert fleet.instruments.respawns("poisoned").value \
+            == respawns_before + 1
+        assert victim not in m.group.replicas
+        assert all(r.healthy for r in m.group.snapshot())
+        # the healed member serves on both replicas again
+        fleet.output("m", _x(), timeout=30)
+
+
+def test_hung_replica_detected_drained_and_respawned(tmp_path):
+    policy = FleetPolicy(hang_after_s=0.3, drain_timeout_s=0.3,
+                         respawn_after_s=60.0)      # isolate the hang path
+    with _fleet(tmp_path, n_slices=2, policy=policy) as fleet:
+        m = fleet.deploy("m", _net(seed=2), replicas=2, warm=True)
+        fleet.output("m", _x(), timeout=30)
+        victim = m.group.replicas[0]
+        chaos = ReplicaChaos(mode="hang", at_dispatch=0, duration_s=1.5)
+        chaos.arm(victim)
+        futs = [fleet.submit("m", _x(seed=i), deadline_ms=8000.0)
+                for i in range(8)]
+        # wait until the stuck dispatch is visible on the batcher
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            age = victim.server.batcher.inflight_age_s
+            if age is not None and age >= policy.hang_after_s:
+                break
+            time.sleep(0.02)
+        drains_before = fleet.instruments.drain_timeouts.value
+        hung_before = fleet.instruments.respawns("hung").value
+        rec = fleet.controller.reconcile()
+        respawns = [a for a in rec["actions"] if a["action"] == "respawn"]
+        assert len(respawns) == 1 and respawns[0]["cause"] == "hung"
+        assert respawns[0]["fresh_compiles"] == 0
+        # the hung server blew the bounded drain deadline — counted
+        assert fleet.instruments.drain_timeouts.value > drains_before
+        assert fleet.instruments.respawns("hung").value == hung_before + 1
+        # NO accepted request is lost: stuck ones resolve when the hang
+        # ends; drained leftovers fail over to the healthy replica
+        assert all(f.exception(timeout=30) is None for f in futs)
+        fleet.output("m", _x(), timeout=30)
+
+
+def test_hedged_dispatch_first_wins_late_duplicate_suppressed(tmp_path):
+    policy = FleetPolicy(hedge_fraction=0.5, max_hedges=1)
+    with _fleet(tmp_path, n_slices=2, policy=policy) as fleet:
+        m = fleet.deploy("m", _net(seed=3), replicas=2, warm=True)
+        fleet.output("m", _x(), timeout=30)
+        slow, fast = m.group.replicas
+        chaos = ReplicaChaos(mode="slow", at_dispatch=0, delay_s=0.6)
+        chaos.arm(slow)
+        lat_before = m.latency.count
+        hedges_before = fleet.instruments.hedges.value
+        wasted_before = fleet.instruments.hedge_wasted.value
+        req = FailoverRequest(fleet, m, _x(), 0, 1000.0, time.monotonic())
+        fut = req.start(slow)               # primary lands on the slow one
+        # the hedge fires at 50% of the budget and wins on the fast
+        # replica; the late original completes too but is SUPPRESSED —
+        # one answer, one latency sample, one wasted-duplicate count
+        assert fut.exception(timeout=30) is None
+        assert fleet.instruments.hedges.value == hedges_before + 1
+        deadline = time.monotonic() + 5.0
+        while fleet.instruments.hedge_wasted.value == wasted_before \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert fleet.instruments.hedge_wasted.value == wasted_before + 1
+        assert m.latency.count == lat_before + 1
+        chaos.restore()
+
+
+def test_ladder_hedges_off_disarms_the_hedge_timer(tmp_path):
+    with _fleet(tmp_path, n_slices=2) as fleet:
+        m = fleet.deploy("m", _net(seed=4), replicas=2, warm=True)
+        fleet.output("m", _x(), timeout=30)
+        fleet.ladder.restore_state({"level": 1})     # hedges_off
+        hedges_before = fleet.instruments.hedges.value
+        req = FailoverRequest(fleet, m, _x(), 0, 1000.0, time.monotonic())
+        fut = req.start(m.group.replicas[0])
+        assert req._hedge_handle is None             # never armed
+        assert fut.exception(timeout=30) is None
+        assert fleet.instruments.hedges.value == hedges_before
+
+
+# ---------------------------------------------------------------------------
+# Degraded-mode ladder
+# ---------------------------------------------------------------------------
+
+def test_degraded_ladder_hysteresis_and_predicates():
+    lad = DegradedLadder(down_after=2, up_after=3)
+    assert lad.name == "full" and lad.hedges_enabled()
+    assert not lad.quantized_routing() and not lad.shed_floor()
+    assert lad.observe(True) == 0           # one pressured tick: holds
+    assert lad.observe(True) == 1           # second: steps down ONE level
+    assert lad.name == "hedges_off" and not lad.hedges_enabled()
+    # pressure keeps walking it down one level at a time
+    lad.observe(True), lad.observe(True)
+    assert lad.name == "quantized" and lad.quantized_routing()
+    lad.observe(True), lad.observe(True)
+    assert lad.name == "shed_floor" and lad.shed_floor()
+    lad.observe(True), lad.observe(True)    # already at the floor: holds
+    assert lad.level == len(LADDER_LEVELS) - 1
+    # recovery needs up_after consecutive healthy ticks, one level each
+    lad.observe(False), lad.observe(False)
+    assert lad.level == 3                   # not yet
+    lad.observe(False)
+    assert lad.name == "quantized"
+    # a pressured tick resets the recovery streak (hysteresis)
+    lad.observe(False), lad.observe(False), lad.observe(True)
+    lad.observe(False), lad.observe(False)
+    assert lad.name == "quantized"
+    for _ in range(6):
+        lad.observe(False)
+    assert lad.name == "full"
+    assert len(lad.transitions) >= 6
+    # snapshot state restores clamped
+    lad.restore_state({"level": 99})
+    assert lad.level == len(LADDER_LEVELS) - 1
+    lad.restore_state(lad.to_state())
+    assert lad.level == len(LADDER_LEVELS) - 1
+
+
+def test_ladder_quantized_routing_and_shed_floor(tmp_path):
+    with _fleet(tmp_path, n_slices=4) as fleet:
+        hi = fleet.deploy("hi", _net(seed=5),
+                          slo=LatencySLO(target_p99_ms=500.0, priority=10),
+                          warm=True)
+        lo = fleet.deploy("lo", _net(seed=6),
+                          slo=LatencySLO(target_p99_ms=500.0, priority=0),
+                          warm=True)
+        entry = fleet.prepare_quantized("lo")
+        # the standby changes NOTHING at full level: f32 stays pinned
+        assert lo.quantized_version == entry.version
+        assert fleet._route_version(lo) == lo.serving_version
+        fleet.output("lo", _x(), timeout=30)
+        # at the quantized level, routing flips to the int8 standby —
+        # zero compiles, the buckets were warmed at prepare time; a
+        # member with no standby keeps its f32 version
+        fleet.ladder.restore_state({"level": 2})
+        assert fleet._route_version(lo) == entry.version
+        assert fleet._route_version(hi) == hi.serving_version
+        compiles = fleet.cache.stats["compiles"]
+        fleet.output("lo", _x(), timeout=30)
+        fleet.output("hi", _x(), timeout=30)
+        assert fleet.cache.stats["compiles"] == compiles
+        # at the shed floor only the top priority class is admitted
+        fleet.ladder.restore_state({"level": 3})
+        sheds = lo.sheds
+        with pytest.raises(RejectedError, match="shed"):
+            fleet.submit("lo", _x())
+        assert lo.sheds == sheds + 1
+        fleet.output("hi", _x(), timeout=30)
+        # recovery restores normal routing
+        fleet.ladder.restore_state({"level": 0})
+        fleet.output("lo", _x(), timeout=30)
+        assert fleet._route_version(lo) == lo.serving_version
+
+
+def test_ladder_level_exported_via_healthz_and_fleet_stats(tmp_path):
+    with _fleet(tmp_path, n_slices=2) as fleet:
+        fleet.deploy("m", _net(seed=7), warm=True)
+        fleet.ladder.observe(True)
+        fleet.ladder.observe(True)              # down_after=2 default
+        assert fleet.ladder.level == 1
+        assert fleet.healthz()["degraded_mode"] == "hedges_off"
+        assert fleet.healthz()["degraded_level"] == 1
+        assert fleet.fleet_stats()["degraded"]["level"] == 1
+        assert fleet.fleet_stats()["degraded"]["name"] == "hedges_off"
+
+
+# ---------------------------------------------------------------------------
+# Snapshot / restore
+# ---------------------------------------------------------------------------
+
+def test_snapshot_roundtrip_restore_zero_compiles(tmp_path):
+    snap = str(tmp_path / "fleet-snapshot.json")
+    cache = str(tmp_path / "exec-cache")
+    fleet = _fleet(tmp_path, n_slices=4, cache_dir=cache,
+                   snapshot_path=snap)
+    a = fleet.deploy("a", _net(seed=8),
+                     slo=LatencySLO(target_p99_ms=250.0, priority=7),
+                     replicas=2, warm=True)
+    fleet.deploy("b", _net(seed=9))                  # cold member
+    fleet.output("a", _x(), timeout=30)
+    a.tracker.restore_state({"breached": True, "breaches_total": 2,
+                             "over": 1, "under": 0})
+    a_slices = sorted(r.slice.index for r in a.group.snapshot())
+    assert fleet.save_snapshot() == snap
+    assert fleet.instruments.snapshot_age.value == 0.0
+    body = load_snapshot(snap)
+    assert body["resident"] == ["a"]
+    assert body["members"]["a"]["replicas_target"] == 2
+    assert sorted(body["members"]["a"]["slices"]) == a_slices
+    assert body["members"]["a"]["slo"]["priority"] == 7
+    fleet.shutdown()
+
+    # a NEW fleet process: same cache dir, rebuilt to pre-crash shape
+    fleet2 = ModelFleet(max_resident=2, max_batch=4, batch_timeout_ms=1.0,
+                        n_slices=4, cache_dir=cache, snapshot_path=snap)
+    fleet2.deploy("a", _net(seed=8),
+                  slo=LatencySLO(target_p99_ms=250.0, priority=7))
+    fleet2.deploy("b", _net(seed=9))
+    report = fleet2.restore_snapshot()
+    assert sorted(report["restored"]) == ["a", "b"]
+    assert report["missing"] == []
+    assert report["fresh_compiles"] == 0             # warm AOT path
+    a2 = fleet2.member("a")
+    assert a2.replicas_target == 2
+    assert sorted(r.slice.index
+                  for r in a2.group.snapshot()) == a_slices
+    assert a2.tracker.breached and a2.tracker.breaches_total == 2
+    assert fleet2.pool.resident_names() == ["a"]
+    # breached members shed all but probes — retry until one admits
+    for _ in range(64):
+        try:
+            fleet2.output("a", _x(), timeout=30)
+            break
+        except RejectedError:
+            continue
+    else:
+        pytest.fail("restored member never admitted a probe")
+    fleet2.shutdown()
+
+
+def test_snapshot_detects_corruption_and_missing_members(tmp_path):
+    snap = str(tmp_path / "snap.json")
+    with _fleet(tmp_path, snapshot_path=snap) as fleet:
+        fleet.deploy("m", _net(seed=10), warm=True)
+        fleet.save_snapshot()
+        # crc catches a flipped byte in the body
+        with open(snap) as f:
+            payload = json.load(f)
+        payload["fleet"]["max_resident"] = 99
+        with open(snap, "w") as f:
+            json.dump(payload, f)
+        with pytest.raises(SnapshotCorruptError, match="crc"):
+            load_snapshot(snap)
+        # torn/truncated writes and wrong formats are refused too
+        with open(snap, "w") as f:
+            f.write("{\"fleet\": {")
+        with pytest.raises(SnapshotCorruptError):
+            load_snapshot(snap)
+        with open(snap, "w") as f:
+            json.dump({"format": 999, "fleet": {}, "crc32": 0}, f)
+        with pytest.raises(SnapshotCorruptError, match="format"):
+            load_snapshot(snap)
+    # a member in the snapshot but not deployed is reported, not fatal
+    snap2 = str(tmp_path / "snap2.json")
+    with _fleet(tmp_path, snapshot_path=snap2) as fleet:
+        fleet.deploy("m", _net(seed=10), warm=True)
+        fleet.deploy("gone", _net(seed=11))
+        fleet.save_snapshot()
+    with _fleet(tmp_path, snapshot_path=snap2) as fleet2:
+        fleet2.deploy("m", _net(seed=10))
+        report = fleet2.restore_snapshot()
+        assert report["missing"] == ["gone"]
+        assert "m" in report["restored"]
+
+
+def test_periodic_snapshot_from_reconcile_tick(tmp_path):
+    snap = str(tmp_path / "snap.json")
+    with _fleet(tmp_path, snapshot_path=snap,
+                snapshot_interval_s=0.0) as fleet:
+        fleet.deploy("m", _net(seed=12), warm=True)
+        assert fleet.snapshotter.saves == 0
+        fleet.controller.reconcile()
+        assert fleet.snapshotter.saves == 1          # tick committed one
+        assert load_snapshot(snap)["resident"] == ["m"]
+        assert fleet.healthz()["snapshot_age_s"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# The tier-1 chaos gate: bench.py --fleetchaos --quick (slow lane)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_bench_fleetchaos_quick_gate():
+    import os
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, os.path.join(root, "bench.py"),
+         "--fleetchaos", "--quick"],
+        capture_output=True, text=True, timeout=600, cwd=root, env=env)
+    assert p.returncode == 0, p.stderr[-2000:]
+    line = json.loads(p.stdout.strip().splitlines()[-1])
+    assert line["pass"] is True
+    assert line["value"] == 0                        # lost accepted
+    assert set(line["respawn_causes"]) == {"hung", "poisoned"}
+    assert all(c == 0 for c in line["respawn_fresh_compiles"])
+    assert line["restore_fresh_compiles"] == 0
