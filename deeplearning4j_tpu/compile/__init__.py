@@ -18,11 +18,12 @@ Opt-in: nothing persists unless a cache directory is configured — pass
 `$DL4J_TPU_EXEC_CACHE`.
 """
 from deeplearning4j_tpu.compile.autotune import (  # noqa: F401
-    DEFAULT_SPACE, Schedule, ScheduleAutotuner, load_schedule,
-    save_schedule, schedule_path)
+    DEFAULT_SPACE, Schedule, ScheduleAutotuner, TileAutotuner,
+    autotune_tiles, load_schedule, load_tile_table, save_schedule,
+    save_tile_entry, schedule_path, tile_table_path)
 from deeplearning4j_tpu.compile.fingerprint import (  # noqa: F401
-    environment_fingerprint, mesh_fingerprint, model_fingerprint,
-    transform_fingerprint)
+    environment_fingerprint, kernel_tier_fingerprint, mesh_fingerprint,
+    model_fingerprint, transform_fingerprint)
 from deeplearning4j_tpu.compile.persistent import (  # noqa: F401
     PersistentExecutableCache, as_cache, default_cache, default_cache_dir,
     enable_jax_compilation_cache, set_default_cache)

@@ -261,3 +261,215 @@ def load_schedule(directory: str, name: Optional[str] = None,
     sch.source = "loaded"
     sch.meta = dict(sch.meta, loaded_from=path)
     return sch
+
+
+# ---------------------------------------------------------------------------
+# Tile-size search for the fused-kernel tier (ops/pallas)
+# ---------------------------------------------------------------------------
+#
+# Same shape as the schedule search one level down: a coarse grid over the
+# dominant tile dimensions, greedy per-dimension refinement, memoized
+# measurements — but the search space is a kernel's TileConfig and the
+# persisted artifact is a per-device-kind tile table
+# (`tiles-<device_kind>.json`) keyed by `<kernel>/<shape_class>`, living
+# next to the schedule store.  Winners are installed into
+# `ops.pallas.dispatch`, which folds them into `kernel_tier_fingerprint`
+# so a tile change can never collide with a stale AOT executable.
+
+from deeplearning4j_tpu.ops.pallas.tiles import (  # noqa: E402
+    DEFAULT_TILES, TILE_FORMAT, TILE_GRID_DIMS, TILE_SPACES, TileConfig,
+    iter_space)
+
+
+class TileAutotuner:
+    """Grid + greedy-refinement search over one kernel's TileConfig space.
+
+    `measure(tile) -> rate` (higher is better — steps/sec, GFLOP/s,
+    1/latency; any consistent unit).  Measurements are memoized per
+    config; every evaluation lands in `history`; `search()` returns the
+    winning TileConfig and records `best_rate` / `evaluated` on self."""
+
+    def __init__(self, measure: Callable[[TileConfig], float],
+                 kernel: str,
+                 space: Optional[Dict[str, List[int]]] = None,
+                 base: Optional[TileConfig] = None,
+                 refine_rounds: int = 2,
+                 on_candidate: Optional[Callable[[TileConfig, float], None]]
+                 = None):
+        self.measure = measure
+        self.kernel = kernel
+        self.space = dict(space if space is not None
+                          else TILE_SPACES.get(kernel, {}))
+        self.base = base if base is not None else DEFAULT_TILES.get(
+            kernel, TileConfig())
+        self.refine_rounds = int(refine_rounds)
+        self.on_candidate = on_candidate
+        self.history: List[Dict[str, Any]] = []
+        self._memo: Dict[str, float] = {}
+        self.best_rate: Optional[float] = None
+        self.evaluated: int = 0
+
+    def _eval(self, cand: TileConfig) -> float:
+        key = cand.config_key()
+        if key in self._memo:
+            return self._memo[key]
+        rate = float(self.measure(cand))
+        self._memo[key] = rate
+        self.history.append(dict(cand.to_json(), rate=rate))
+        if self.on_candidate is not None:
+            self.on_candidate(cand, rate)
+        return rate
+
+    def search(self) -> TileConfig:
+        best = self.base
+        best_rate = self._eval(best)
+
+        grid_dims = [d for d in TILE_GRID_DIMS.get(self.kernel, ())
+                     if d in self.space] or sorted(self.space)[:2]
+        for combo in iter_space({d: self.space[d] for d in grid_dims}):
+            cand = best.replace(**combo)
+            rate = self._eval(cand)
+            if rate > best_rate:
+                best, best_rate = cand, rate
+
+        for _ in range(self.refine_rounds):
+            improved = False
+            for dim in sorted(self.space):
+                for v in self.space[dim]:
+                    cand = best.replace(**{dim: v})
+                    rate = self._eval(cand)
+                    if rate > best_rate:
+                        best, best_rate = cand, rate
+                        improved = True
+            if not improved:
+                break
+
+        self.best_rate = best_rate
+        self.evaluated = len(self._memo)
+        return best
+
+
+def _device_kind_slug(device_kind: Optional[str] = None) -> str:
+    if device_kind is None:
+        import jax
+        device_kind = jax.devices()[0].device_kind
+    return "".join(c if c.isalnum() else "-" for c in str(device_kind).lower())
+
+
+def tile_table_path(directory: str,
+                    device_kind: Optional[str] = None) -> str:
+    return os.path.join(os.path.expanduser(directory),
+                        f"tiles-{_device_kind_slug(device_kind)}.json")
+
+
+def _load_tile_doc(directory: str,
+                   device_kind: Optional[str] = None) -> Dict[str, Any]:
+    try:
+        with open(tile_table_path(directory, device_kind)) as f:
+            doc = json.load(f)
+        if doc.get("format") != TILE_FORMAT:
+            return {}
+        entries = doc.get("entries")
+        return entries if isinstance(entries, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def load_tile_table(directory: str, device_kind: Optional[str] = None
+                    ) -> Dict[str, TileConfig]:
+    """The persisted tile table as `{<kernel>/<shape_class>: TileConfig}`,
+    or `{}` when absent/unreadable/wrong format — ready for
+    `ops.pallas.dispatch.install_tile_table`."""
+    out: Dict[str, TileConfig] = {}
+    for key, entry in _load_tile_doc(directory, device_kind).items():
+        try:
+            out[key] = TileConfig.from_json(entry["tile"])
+        except (KeyError, TypeError, ValueError):
+            continue
+    return out
+
+
+def save_tile_entry(directory: str, kernel: str, shape_class: str,
+                    tile: TileConfig, rate: Optional[float] = None,
+                    meta: Optional[Dict[str, Any]] = None,
+                    device_kind: Optional[str] = None) -> str:
+    """Read-modify-write one `<kernel>/<shape_class>` entry into the
+    per-device tile table, with the same tmp+rename commit discipline as
+    the schedule artifact.  Returns the table path."""
+    directory = os.path.expanduser(directory)
+    os.makedirs(directory, exist_ok=True)
+    path = tile_table_path(directory, device_kind)
+    entries = _load_tile_doc(directory, device_kind)
+    entries[f"{kernel}/{shape_class}"] = {
+        "tile": tile.to_json(),
+        "rate": rate,
+        "meta": dict(meta or {}),
+        "written_at": time.time(),
+    }
+    doc = {"format": TILE_FORMAT,
+           "device_kind": _device_kind_slug(device_kind),
+           "entries": entries,
+           "env": environment_fingerprint()}
+    fd, tmp = tempfile.mkstemp(dir=directory, prefix=".tmp-tiles-")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=2)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def autotune_tiles(kernel: str, shape_class: str,
+                   measure: Callable[[TileConfig], float],
+                   directory: str,
+                   space: Optional[Dict[str, List[int]]] = None,
+                   base: Optional[TileConfig] = None,
+                   refine_rounds: int = 2,
+                   install: bool = True,
+                   device_kind: Optional[str] = None
+                   ) -> "tuple[TileConfig, Dict[str, Any]]":
+    """Memoized tile search: serve `<kernel>/<shape_class>` from the
+    persisted per-device tile table when present (zero re-search, counted
+    as `autotune_tile_cache_hits_total`), otherwise run the grid+greedy
+    `TileAutotuner`, persist the winner, and (by default) install it into
+    `ops.pallas.dispatch` so subsequent dispatches — and AOT fingerprints
+    — pick it up.  Returns `(tile, info)`."""
+    from deeplearning4j_tpu.monitor.instrument import ops_instruments
+    from deeplearning4j_tpu.ops.pallas import dispatch as _kd
+
+    key = f"{kernel}/{shape_class}"
+    entry = _load_tile_doc(directory, device_kind).get(key)
+    if entry is not None:
+        try:
+            tile = TileConfig.from_json(entry["tile"])
+        except (KeyError, TypeError, ValueError):
+            tile = None
+        if tile is not None:
+            ops_instruments().record_tile_cache_hit()
+            if install:
+                _kd.set_tile(kernel, tile, shape_class)
+            return tile, {"source": "cache", "evaluated": 0,
+                          "rate": entry.get("rate"),
+                          "path": tile_table_path(directory, device_kind)}
+
+    t0 = time.perf_counter()
+    tuner = TileAutotuner(measure, kernel, space=space, base=base,
+                          refine_rounds=refine_rounds)
+    tile = tuner.search()
+    search_ms = (time.perf_counter() - t0) * 1000.0
+    ops_instruments().record_tile_search_ms(search_ms)
+    path = save_tile_entry(directory, kernel, shape_class, tile,
+                           rate=tuner.best_rate,
+                           meta={"evaluated": tuner.evaluated,
+                                 "search_ms": round(search_ms, 3)},
+                           device_kind=device_kind)
+    if install:
+        _kd.set_tile(kernel, tile, shape_class)
+    return tile, {"source": "searched", "evaluated": tuner.evaluated,
+                  "rate": tuner.best_rate,
+                  "search_ms": search_ms, "path": path}

@@ -190,7 +190,23 @@ def model_fingerprint(model) -> str:
     qfp = getattr(model, "quant_fingerprint", None)
     if callable(qfp):
         parts["quant"] = qfp()
+    # the fused-kernel tier changes the traced program: reference vs
+    # Pallas lowering, and any installed TileConfig, must never share a
+    # persisted executable with each other or with a stale tile choice
+    parts["kernel_tier"] = kernel_tier_fingerprint()
     return digest(parts)
+
+
+def kernel_tier_fingerprint() -> Dict[str, Any]:
+    """The fused-kernel tier's contribution to program identity: dispatch
+    mode, Pallas availability, and every installed TileConfig (see
+    `ops.pallas.dispatch`).  Falls back to a reference-only stanza when
+    the tier cannot import, so fingerprinting never depends on Pallas."""
+    try:
+        from deeplearning4j_tpu.ops.pallas import dispatch as _kd
+        return _kd.kernel_tier_fingerprint()
+    except Exception:
+        return {"mode": "reference", "pallas": False, "tiles": {}}
 
 
 def args_signature(args: Any) -> Tuple:

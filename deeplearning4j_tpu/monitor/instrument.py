@@ -118,6 +118,15 @@ docs/observability.md):
                                      the most recent parity check
                                      (fraction of disagreeing top-1
                                      predictions / relative error)
+  ops_kernel_dispatch_total{kernel=,impl=}
+                                     fused-kernel tier dispatch decisions
+                                     (ops.pallas.dispatch), impl=pallas|
+                                     reference — counted at trace time
+  autotune_tile_search_ms            wall time of one TileConfig search
+                                     (compile.autotune.autotune_tiles,
+                                     cache-miss path)
+  autotune_tile_cache_hits_total     tile lookups served by the persisted
+                                     tile table with zero re-search
 """
 from __future__ import annotations
 
@@ -652,7 +661,56 @@ class QuantInstruments:
 _pipeline: Optional[PipelineInstruments] = None
 _resilience: Optional[ResilienceInstruments] = None
 _aot: Optional[AotCacheInstruments] = None
+class OpsInstruments:
+    """Fused-kernel tier handles (ops.pallas.dispatch + the tile stage of
+    compile.autotune).  Per-(kernel, impl) dispatch counters are created
+    lazily and memoized, matching the fleet bundle's labeled-child
+    pattern."""
+
+    def __init__(self, registry_: Optional[MetricsRegistry] = None):
+        reg = registry_ if registry_ is not None else registry()
+        self._reg = reg
+        self.tile_search_ms = reg.histogram(
+            "autotune_tile_search_ms",
+            help="wall time of one TileConfig grid+greedy search "
+            "(cache-miss path of compile.autotune.autotune_tiles)")
+        self.tile_cache_hits = reg.counter(
+            "autotune_tile_cache_hits_total",
+            help="tile lookups served by the persisted tile table with "
+            "zero re-search")
+        self._dispatch: dict = {}
+
+    def dispatch(self, kernel: str, impl: str):
+        key = (kernel, impl)
+        c = self._dispatch.get(key)
+        if c is None:
+            c = self._reg.counter(
+                "ops_kernel_dispatch_total",
+                help="fused-kernel tier dispatch decisions, labeled by "
+                "kernel name and chosen implementation (pallas vs jnp "
+                "reference); counted at trace time",
+                labels={"kernel": kernel, "impl": impl})
+            self._dispatch[key] = c
+        return c
+
+    def record_dispatch(self, kernel: str, impl: str) -> None:
+        if not enabled():
+            return
+        self.dispatch(kernel, impl).inc()
+
+    def record_tile_search_ms(self, ms: float) -> None:
+        if not enabled():
+            return
+        self.tile_search_ms.observe(float(ms))
+
+    def record_tile_cache_hit(self) -> None:
+        if not enabled():
+            return
+        self.tile_cache_hits.inc()
+
+
 _quant: Optional[QuantInstruments] = None
+_ops: Optional[OpsInstruments] = None
 
 
 def quant_instruments() -> QuantInstruments:
@@ -661,6 +719,14 @@ def quant_instruments() -> QuantInstruments:
     if _quant is None:
         _quant = QuantInstruments()
     return _quant
+
+
+def ops_instruments() -> OpsInstruments:
+    """Process-wide fused-kernel-tier handle bundle (lazy singleton)."""
+    global _ops
+    if _ops is None:
+        _ops = OpsInstruments()
+    return _ops
 
 
 def aot_instruments() -> AotCacheInstruments:

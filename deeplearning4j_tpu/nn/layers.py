@@ -61,10 +61,39 @@ class DenseLayer(Layer):
         x = self.maybe_input_dropout(x, train, rng)
         if x.ndim > 2 and not self._is_recurrent_input(x):
             x = x.reshape(x.shape[0], -1)
+        y = self._fused_dense(x, params)
+        if y is not None:
+            return y, state
         y = x @ params["W"]
         if self.has_bias:
             y = y + params["b"]
         return self.act_fn()(y), state
+
+    def _fused_dense(self, x, params):
+        """Route through the Pallas fused bias+activation tile when the
+        kernel tier takes the call (TPU/GPU, or forced mode); None keeps
+        the plain XLA lowering — the CPU/tier-1 path, bit-identical to
+        before the tier existed."""
+        act = self.activation if self.activation is not None else "identity"
+        if not isinstance(act, str):
+            return None
+        try:
+            from deeplearning4j_tpu.ops import pallas as tier
+            b = params.get("b") if self.has_bias else None
+            if tier.dispatch.resolve("fused_dense", x, params["W"], bias=b,
+                                     activation=act) != "pallas":
+                return None
+            rows = 1
+            for d in x.shape[:-1]:
+                rows *= int(d)
+            sc = tier.shape_class(m=rows, k=int(x.shape[-1]),
+                                  n=int(params["W"].shape[-1]))
+            return tier.matmul.fused_dense(
+                x, params["W"], bias=b, activation=act,
+                tile=tier.dispatch.get_tile("fused_dense", sc),
+                interpret=tier.dispatch.interpret_mode())
+        except Exception:
+            return None
 
     def _is_recurrent_input(self, x):
         # [batch, time, features] passes through time-distributed.

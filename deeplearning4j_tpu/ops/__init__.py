@@ -4,6 +4,8 @@ from deeplearning4j_tpu.ops.losses import LOSSES, get_loss  # noqa: F401
 from deeplearning4j_tpu.ops.norm_kernels import (  # noqa: F401
     fused_layer_norm, layer_norm_reference)
 from deeplearning4j_tpu.ops.quant_kernels import (  # noqa: F401
-    QTensor, dequantize, quantization_error, quantize_tensor,
-    quantized_dense, quantized_matmul, quantized_matmul_static,
-    range_hostility)
+    QTensor, dequant_epilogue, dequantize, quantization_error,
+    quantize_tensor, quantized_dense, quantized_matmul,
+    quantized_matmul_static, range_hostility)
+from deeplearning4j_tpu.ops import pallas  # noqa: F401  (registers the
+# fused-kernel tier; `pallas.dispatch` is the tier's selection layer)

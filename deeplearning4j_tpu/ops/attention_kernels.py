@@ -28,8 +28,13 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+try:  # collection-time guard: a missing pallas degrades the Pallas paths
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover - reference-only environments
+    pl = None
+    pltpu = None
 
 NEG_INF = -1e30
 
@@ -453,23 +458,26 @@ def flash_attention_bwd_tpu(q, k, v, out, lse, g, causal=False, scale=None,
             dv.reshape(B, H, S, D))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def _flash_attention_diff(q, k, v, mask, causal, scale, block_q=256,
-                          block_k=256):
+                          block_k=256, interpret=False):
     return flash_attention_tpu(q, k, v, causal, scale, block_q, block_k,
-                               mask=mask)
+                               mask=mask, interpret=interpret)
 
 
-def _fa_fwd(q, k, v, mask, causal, scale, block_q, block_k):
+def _fa_fwd(q, k, v, mask, causal, scale, block_q, block_k,
+            interpret=False):
     out, lse = flash_attention_tpu(q, k, v, causal, scale, block_q, block_k,
-                                   return_lse=True, mask=mask)
+                                   return_lse=True, mask=mask,
+                                   interpret=interpret)
     return out, (q, k, v, mask, out, lse)
 
 
-def _fa_bwd(causal, scale, block_q, block_k, res, g):
+def _fa_bwd(causal, scale, block_q, block_k, interpret, res, g):
     q, k, v, mask, out, lse = res
     dq, dk, dv = flash_attention_bwd_tpu(q, k, v, out, lse, g, causal, scale,
-                                         block_q, block_k, mask=mask)
+                                         block_q, block_k, mask=mask,
+                                         interpret=interpret)
     return dq, dk, dv, None
 
 
@@ -495,27 +503,32 @@ _XLA_SCORE_BYTES_MAX = 2 << 30   # beyond ~2GB of scores, never take XLA path
 def fused_attention(q, k, v, mask=None, causal=False, scale=None):
     """Dispatcher (the platform-helper pattern — cuDNN-attention role):
 
-    - TPU, tiling shapes, long seq → Pallas flash kernels (fwd + true
-      FlashAttention-2-style bwd, O(T) memory), with [B, S] padding/
-      segment masks supported in-kernel (additive bias per KV tile).
+    - kernel tier (`ops/pallas/dispatch`): Pallas flash kernels (fwd +
+      true FlashAttention-2-style bwd, O(T) memory) with TileConfig-driven
+      blocks and masked-tail padding for ragged shapes, on TPU/GPU when
+      the measured heuristics say flash wins (long seq, lane-multiple D),
+      or whenever the tier is forced to `pallas`.
     - short seq / small scores → XLA-fused naive path (measured fastest
       on v5e below ~2k).
-    - non-tiling → blockwise scan (O(T) memory), or XLA path when
-      scores are small.
+    - the rest → blockwise scan (O(T) memory).
 
     Differentiable everywhere."""
-    on_tpu = jax.default_backend() == "tpu"
     B, H, T, D = q.shape
     S = k.shape[2]
+    try:
+        from deeplearning4j_tpu.ops import pallas as _tier
+        impl = _tier.dispatch.resolve("attention", q, k, v, mask=mask,
+                                      causal=causal)
+    except Exception:
+        _tier, impl = None, "reference"
+    if impl == "pallas":
+        from deeplearning4j_tpu.ops.pallas import attention as _pa
+        sc = _tier.shape_class(t=T, s=S, d=D)
+        return _pa.flash_attention(
+            q, k, v, mask=mask, causal=causal, scale=scale,
+            tile=_tier.dispatch.get_tile("attention", sc),
+            interpret=_tier.dispatch.interpret_mode())
     score_bytes = B * H * T * S * q.dtype.itemsize
-    mask_ok = mask is None or (mask.ndim == 2 and mask.shape == (B, S))
-    if (on_tpu and mask_ok and D % 64 == 0
-            and max(T, S) >= _FLASH_MIN_SEQ):
-        bq = _pick_block(T, 512)
-        bk = _pick_block(S, 1024)
-        if bq and bk:
-            return _flash_attention_diff(q, k, v, mask, causal, scale,
-                                         bq, bk)
     if score_bytes <= _XLA_SCORE_BYTES_MAX:
         return mha_reference(q, k, v, mask, causal, scale)
     return blockwise_attention(q, k, v, mask, causal, scale)

@@ -1,0 +1,100 @@
+"""Flash attention entry point for the fused-kernel tier.
+
+Thin, tile-aware wrapper over the blockwise online-softmax kernels in
+``ops/attention_kernels.py`` (forward + FlashAttention-2-style backward
+via ``_flash_attention_diff``).  What the tier adds on top:
+
+- tiling comes from a :class:`TileConfig` (``block_q``/``block_kv``)
+  instead of the fixed ``_pick_block`` ladder, so the autotuner's
+  persisted winners take effect here;
+- ragged / non-multiple-of-tile shapes are handled by zero-padding T and
+  S up to block multiples with the padded KV positions knocked out via
+  the additive [B, S] mask (a masked tail), then slicing the padded query
+  rows back off — exact, because masked positions contribute
+  ``exp(-1e30)``-scale weights and padded query rows are discarded;
+- a ``reference`` lowering (plain ``mha_reference``) that is the
+  definition of correctness for the conformance suite.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.pallas.tiles import DEFAULT_TILES, TileConfig
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _q_sublane(dtype) -> int:
+    return 16 if jnp.dtype(dtype) == jnp.dtype(jnp.bfloat16) else 8
+
+
+def flash_attention(q, k, v, mask=None, causal: bool = False, scale=None,
+                    tile: Optional[TileConfig] = None,
+                    interpret: bool = False):
+    """[B, H, T, D] flash attention with TileConfig-driven blocks and
+    masked-tail padding for ragged T/S.  Differentiable."""
+    import deeplearning4j_tpu.ops.attention_kernels as ak
+
+    tile = tile or DEFAULT_TILES["attention"]
+    B, H, T, D = q.shape
+    S = k.shape[2]
+    bq = min(tile.block_q, _round_up(T, _q_sublane(q.dtype)))
+    bk = min(tile.block_kv, _round_up(S, 128))
+    Tp, Sp = _round_up(T, bq), _round_up(S, bk)
+
+    if (Tp, Sp) == (T, S):
+        args = (q, k, v, mask, causal, scale, bq, bk)
+    else:
+        qp = jnp.pad(q, ((0, 0), (0, 0), (0, Tp - T), (0, 0)))
+        kp = jnp.pad(k, ((0, 0), (0, 0), (0, Sp - S), (0, 0)))
+        vp = jnp.pad(v, ((0, 0), (0, 0), (0, Sp - S), (0, 0)))
+        keep = jnp.ones((B, S), q.dtype) if mask is None else mask
+        maskp = jnp.pad(keep.astype(q.dtype), ((0, 0), (0, Sp - S)))
+        args = (qp, kp, vp, maskp, causal, scale, bq, bk)
+    if interpret:
+        args = args + (True,)
+    out = ak._flash_attention_diff(*args)
+    if Tp != T:
+        out = out[:, :, :T, :]
+    return out
+
+
+def attention_reference(q, k, v, mask=None, causal: bool = False,
+                        scale=None):
+    import deeplearning4j_tpu.ops.attention_kernels as ak
+
+    return ak.mha_reference(q, k, v, mask=mask, causal=causal, scale=scale)
+
+
+def attention_supports(q, k, v, mask=None, causal: bool = False,
+                       **kw) -> bool:
+    """Hard constraints only — forced-pallas mode must work on the small
+    shapes the conformance suite uses."""
+    if getattr(q, "ndim", 0) != 4:
+        return False
+    if jnp.dtype(q.dtype) not in (jnp.dtype(jnp.float32),
+                                  jnp.dtype(jnp.bfloat16)):
+        return False
+    if k.dtype != q.dtype or v.dtype != q.dtype:
+        return False
+    if mask is not None:
+        B, _, _, _ = q.shape
+        S = k.shape[2]
+        if getattr(mask, "ndim", 0) != 2 or mask.shape != (B, S):
+            return False
+    return True
+
+
+def attention_profitable(q, k, v, mask=None, causal: bool = False,
+                         **kw) -> bool:
+    """Auto-mode perf heuristics: mirror the measured v5e policy the old
+    dispatcher encoded (flash wins from ~2k sequence, D a lane multiple)."""
+    import deeplearning4j_tpu.ops.attention_kernels as ak
+
+    T, D = q.shape[2], q.shape[3]
+    S = k.shape[2]
+    return D % 64 == 0 and max(T, S) >= ak._FLASH_MIN_SEQ

@@ -1,0 +1,198 @@
+"""Single dispatch layer for the fused-kernel tier.
+
+Every kernel in ``ops/pallas`` ships two implementations: a Pallas kernel
+parameterized by a :class:`~deeplearning4j_tpu.ops.pallas.tiles.TileConfig`
+and a pure-jnp reference that is the definition of correctness.  Call sites
+ask this module which implementation to run; the answer depends on three
+things:
+
+* availability — ``jax.experimental.pallas`` importable at all (a missing
+  import degrades the whole tier to reference-only instead of raising),
+* the dispatch mode — ``auto`` (Pallas on TPU/GPU when the kernel's
+  support *and* profitability predicates pass, reference everywhere else),
+  ``pallas`` (force Pallas wherever the hard support predicate allows;
+  on CPU the kernel runs in interpret mode, which is how the conformance
+  suite pins ``pallas == reference``), or ``reference`` (force the jnp
+  lowering),
+* the kernel's own predicates, registered alongside its implementations.
+
+The mode comes from ``DL4J_TPU_KERNEL_TIER`` or :func:`set_dispatch_mode`.
+The module also owns the in-process tile table (installed by the autotuner
+or loaded from the persisted store) and exposes
+:func:`kernel_tier_fingerprint` so ``compile/fingerprint.py`` can fold the
+tier configuration into AOT cache keys — a tile change can never collide
+with a stale executable.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from deeplearning4j_tpu.ops.pallas.tiles import DEFAULT_TILES, TileConfig
+
+_MODES = ("auto", "pallas", "reference")
+
+_lock = threading.Lock()
+_mode: str = os.environ.get("DL4J_TPU_KERNEL_TIER", "auto")
+if _mode not in _MODES:  # bad env value: fail safe, not loud
+    _mode = "auto"
+
+_pallas_ok: Optional[bool] = None
+
+
+def pallas_available() -> bool:
+    """True when ``jax.experimental.pallas`` imports cleanly (memoized)."""
+    global _pallas_ok
+    if _pallas_ok is None:
+        try:
+            from jax.experimental import pallas  # noqa: F401
+
+            _pallas_ok = True
+        except Exception:
+            _pallas_ok = False
+    return _pallas_ok
+
+
+def on_accelerator() -> bool:
+    return jax.default_backend() in ("tpu", "gpu")
+
+
+def interpret_mode() -> bool:
+    """Whether a forced-Pallas kernel must run under ``interpret=True``."""
+    return not on_accelerator()
+
+
+def dispatch_mode() -> str:
+    return _mode
+
+
+def set_dispatch_mode(mode: str) -> str:
+    """Set the tier mode; returns the previous mode (for try/finally)."""
+    global _mode
+    if mode not in _MODES:
+        raise ValueError(f"unknown kernel-tier mode {mode!r}; want one of {_MODES}")
+    with _lock:
+        prev, _mode = _mode, mode
+    return prev
+
+
+@dataclass
+class KernelSpec:
+    name: str
+    pallas_fn: Optional[Callable[..., Any]]
+    reference_fn: Callable[..., Any]
+    #: hard correctness constraints — gate both auto and forced-pallas modes
+    supports: Optional[Callable[..., bool]] = None
+    #: perf heuristics — gate auto mode only, so forced mode stays testable
+    #: on shapes too small to be profitable
+    profitable: Optional[Callable[..., bool]] = None
+
+
+_registry: Dict[str, KernelSpec] = {}
+_tiles: Dict[str, TileConfig] = {}
+
+
+def register(
+    name: str,
+    pallas_fn: Optional[Callable[..., Any]],
+    reference_fn: Callable[..., Any],
+    supports: Optional[Callable[..., bool]] = None,
+    profitable: Optional[Callable[..., bool]] = None,
+) -> None:
+    _registry[name] = KernelSpec(name, pallas_fn, reference_fn, supports, profitable)
+
+
+def kernels() -> Dict[str, KernelSpec]:
+    return dict(_registry)
+
+
+def resolve(name: str, *args: Any, **kwargs: Any) -> str:
+    """Pick ``"pallas"`` or ``"reference"`` for one call and record it."""
+    spec = _registry.get(name)
+    impl = "reference"
+    if spec is not None and spec.pallas_fn is not None and pallas_available():
+        mode = _mode
+        if mode != "reference":
+            ok = spec.supports is None or bool(spec.supports(*args, **kwargs))
+            if ok and mode == "auto":
+                ok = on_accelerator() and (
+                    spec.profitable is None or bool(spec.profitable(*args, **kwargs))
+                )
+            if ok:
+                impl = "pallas"
+    _record(name, impl)
+    return impl
+
+
+def _record(name: str, impl: str) -> None:
+    try:
+        from deeplearning4j_tpu.monitor.instrument import ops_instruments
+
+        ops_instruments().record_dispatch(name, impl)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Tile table
+# ---------------------------------------------------------------------------
+
+
+def set_tile(kernel: str, cfg: TileConfig, shape_class: Optional[str] = None) -> None:
+    key = f"{kernel}/{shape_class}" if shape_class else kernel
+    with _lock:
+        _tiles[key] = cfg
+
+
+def get_tile(kernel: str, shape_class: Optional[str] = None) -> TileConfig:
+    """Most specific installed tile: shape-class entry > kernel-wide > default."""
+    if shape_class is not None:
+        cfg = _tiles.get(f"{kernel}/{shape_class}")
+        if cfg is not None:
+            return cfg
+    cfg = _tiles.get(kernel)
+    if cfg is not None:
+        return cfg
+    return DEFAULT_TILES.get(kernel, TileConfig())
+
+
+def install_tile_table(table: Dict[str, TileConfig]) -> None:
+    with _lock:
+        _tiles.update(table)
+
+
+def tile_table() -> Dict[str, TileConfig]:
+    return dict(_tiles)
+
+
+def clear_tiles() -> None:
+    with _lock:
+        _tiles.clear()
+
+
+def reset() -> None:
+    """Test hook: restore env-derived mode and drop installed tiles."""
+    global _mode
+    with _lock:
+        _mode = os.environ.get("DL4J_TPU_KERNEL_TIER", "auto")
+        if _mode not in _MODES:
+            _mode = "auto"
+        _tiles.clear()
+
+
+def kernel_tier_fingerprint() -> Dict[str, Any]:
+    """Stable description of the tier config, folded into AOT cache keys.
+
+    Distinguishes reference programs from Pallas-default programs from
+    autotuned-tile programs: any change in mode, availability, or any
+    installed tile changes the fingerprint.
+    """
+    return {
+        "mode": _mode,
+        "pallas": pallas_available(),
+        "tiles": {k: cfg.to_json() for k, cfg in sorted(_tiles.items())},
+    }

@@ -140,16 +140,41 @@ def range_hostility(w, axis: int = -1) -> float:
     return float(ratio.max()) if ratio.size else 0.0
 
 
-def quantized_matmul(x, qt: QTensor, acc_dtype=None):
-    """x @ dequant(W) computed as (x @ W_q) * scale — the matmul consumes
-    the int8 weights cast to the accumulating dtype and the per-output-
-    channel scales apply to the product, so no f32 copy of W ever exists
-    in the program.  `acc_dtype` defaults to x's dtype (bf16 under mixed
-    precision).  Exact (up to rounding of W) only for axis == last dim."""
-    if qt.axis != qt.ndim - 1:
-        raise ValueError(
-            f"quantized_matmul needs per-output-channel scales "
-            f"(axis={qt.ndim - 1}), got axis={qt.axis}")
+def dequant_epilogue(y, scale, bias=None, out_dtype=None):
+    """Shared int8→float epilogue: widen the int32 accumulator to f32,
+    multiply by the (already combined) per-channel scale row, add the
+    optional bias — all in f32 — then cast.  Both the jnp reference
+    contraction and the Pallas int8 tile call this same function, so the
+    two paths agree bit-for-bit on scales for any tiling."""
+    y = y.astype(jnp.float32) * scale.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    if out_dtype is not None:
+        y = y.astype(out_dtype)
+    return y
+
+
+def _tier_resolve(kernel, *args, **kwargs):
+    """Ask the fused-kernel tier which implementation this call gets.
+
+    Returns ("reference", None) when the tier is unavailable so the pure
+    jnp path below never depends on `ops.pallas` importing."""
+    try:
+        from deeplearning4j_tpu.ops import pallas as tier
+        return tier.dispatch.resolve(kernel, *args, **kwargs), tier
+    except Exception:
+        return "reference", None
+
+
+def _matmul_shape_class(x, n_out: int):
+    from deeplearning4j_tpu.ops.pallas.tiles import shape_class
+    rows = 1
+    for d in x.shape[:-1]:
+        rows *= int(d)
+    return shape_class(m=rows, k=int(x.shape[-1]), n=int(n_out))
+
+
+def _quantized_matmul_ref(x, qt: QTensor, acc_dtype=None):
     acc = jnp.dtype(acc_dtype) if acc_dtype is not None else x.dtype
     x = x.astype(acc)
     y = jax.lax.dot_general(
@@ -157,6 +182,30 @@ def quantized_matmul(x, qt: QTensor, acc_dtype=None):
         dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=acc)
     return y * qt.scale.astype(acc).reshape((1,) * (y.ndim - 1) + (-1,))
+
+
+def quantized_matmul(x, qt: QTensor, acc_dtype=None):
+    """x @ dequant(W) computed as (x @ W_q) * scale — the matmul consumes
+    the int8 weights cast to the accumulating dtype and the per-output-
+    channel scales apply to the product, so no f32 copy of W ever exists
+    in the program.  `acc_dtype` defaults to x's dtype (bf16 under mixed
+    precision).  Exact (up to rounding of W) only for axis == last dim.
+
+    On TPU/GPU (or under a forced `pallas` dispatch mode) this routes to
+    the weight-only Pallas tile, which widens W one K-block at a time in
+    VMEM instead of streaming a dequantized copy from HBM."""
+    if qt.axis != qt.ndim - 1:
+        raise ValueError(
+            f"quantized_matmul needs per-output-channel scales "
+            f"(axis={qt.ndim - 1}), got axis={qt.axis}")
+    impl, tier = _tier_resolve("q_matmul", x, qt.q, qt.scale)
+    if impl == "pallas":
+        sc = _matmul_shape_class(x, qt.shape[-1])
+        return tier.matmul.q_matmul(
+            x, qt.q, qt.scale, acc_dtype=acc_dtype,
+            tile=tier.dispatch.get_tile("q_matmul", sc),
+            interpret=tier.dispatch.interpret_mode())
+    return _quantized_matmul_ref(x, qt, acc_dtype=acc_dtype)
 
 
 def quantize_activation(x, scale):
@@ -176,21 +225,42 @@ def quantized_matmul_static(x, qt: QTensor, x_scale,
     if qt.axis != qt.ndim - 1:
         raise ValueError("static quantized matmul needs axis == last dim")
     xq = quantize_activation(x, x_scale)
+    acc = jnp.dtype(acc_dtype)
+    impl, tier = _tier_resolve("int8_matmul", xq, qt.q, qt.scale, x_scale)
+    if impl == "pallas":
+        sc = _matmul_shape_class(xq, qt.shape[-1])
+        return tier.matmul.int8_matmul(
+            xq, qt.q, qt.scale, x_scale=x_scale, out_dtype=acc,
+            tile=tier.dispatch.get_tile("int8_matmul", sc),
+            interpret=tier.dispatch.interpret_mode())
     y = jax.lax.dot_general(
         xq, qt.q,
         dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)
-    acc = jnp.dtype(acc_dtype)
-    scale = (jnp.asarray(x_scale, acc)
-             * qt.scale.astype(acc).reshape((1,) * (y.ndim - 1) + (-1,)))
-    return y.astype(acc) * scale
+    scale = (jnp.asarray(x_scale, jnp.float32)
+             * qt.scale.astype(jnp.float32).reshape(
+                 (1,) * (y.ndim - 1) + (-1,)))
+    return dequant_epilogue(y, scale, out_dtype=acc)
 
 
 def quantized_dense(x, qt: QTensor, b: Optional[jax.Array] = None,
                     acc_dtype=None):
     """Dense-layer hot path: quantized matmul + bias in the accumulating
-    dtype (activation application stays with the calling layer)."""
-    y = quantized_matmul(x, qt, acc_dtype=acc_dtype)
+    dtype (activation application stays with the calling layer).  When
+    the Pallas tier takes the call, the bias add is fused into the tile's
+    epilogue."""
+    if qt.axis != qt.ndim - 1:
+        raise ValueError(
+            f"quantized_dense needs per-output-channel scales "
+            f"(axis={qt.ndim - 1}), got axis={qt.axis}")
+    impl, tier = _tier_resolve("q_matmul", x, qt.q, qt.scale, bias=b)
+    if impl == "pallas":
+        sc = _matmul_shape_class(x, qt.shape[-1])
+        return tier.matmul.q_matmul(
+            x, qt.q, qt.scale, bias=b, acc_dtype=acc_dtype,
+            tile=tier.dispatch.get_tile("q_matmul", sc),
+            interpret=tier.dispatch.interpret_mode())
+    y = _quantized_matmul_ref(x, qt, acc_dtype=acc_dtype)
     if b is not None:
         y = y + b.astype(y.dtype)
     return y

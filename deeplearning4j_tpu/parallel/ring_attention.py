@@ -105,8 +105,9 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
 
 
 def ring_attention_flash(q, k, v, axis_name: str, causal: bool = False,
-                         scale=None, block_q: int = 256,
-                         block_k: int = 256, interpret: bool = False):
+                         scale=None, block_q: Optional[int] = None,
+                         block_k: Optional[int] = None,
+                         interpret: bool = False):
     """Ring attention whose INNER chunk-vs-chunk attention runs the
     Pallas flash kernel (`ops.attention_kernels.flash_attention_tpu`
     with ``return_lse``), merging per-chunk results by logsumexp:
@@ -129,7 +130,20 @@ def ring_attention_flash(q, k, v, axis_name: str, causal: bool = False,
     step.  Single-chip A/B is vacuous (axis size 1 = plain flash), so
     adoption into dispatch waits for multi-chip hardware; correctness is
     CPU-tested via interpret mode.
+
+    ``block_q``/``block_k`` default to the kernel tier's installed
+    attention :class:`TileConfig` (autotuned winners apply here too),
+    clamped to divisors of the local chunk length via ``_pick_block``.
     """
+    if block_q is None or block_k is None:
+        from deeplearning4j_tpu.ops import pallas as _tier
+        import deeplearning4j_tpu.ops.attention_kernels as _ak
+        T = q.shape[2]
+        tile = _tier.dispatch.get_tile("attention")
+        if block_q is None:
+            block_q = _ak._pick_block(T, min(tile.block_q, T)) or T
+        if block_k is None:
+            block_k = _ak._pick_block(T, min(tile.block_kv, T)) or T
     return _ring_flash(q, k, v, axis_name, causal, scale, block_q,
                        block_k, interpret)
 
