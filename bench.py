@@ -1694,6 +1694,348 @@ def main_fleetchaos(quick: bool):
         sys.exit(1)
 
 
+def bench_federation(quick=False):
+    """`--federation` gate: cross-host fleet federation under injected
+    host failure (serving/federation.py).
+
+    Three in-process hosts, each a full `ModelFleet` (hi + lo members,
+    all sharing one persistent AOT cache dir) behind a `HostAgent`,
+    fronted by one `FederationRouter`.  Hi/lo client threads flood the
+    router; mid-flood `HostChaos` KILLS the hi-affinity host (EOF ->
+    cause ``crash``) and PARTITIONS a second host for a window (silence
+    -> cause ``partition``; the replies it flushes on heal are
+    generation-fenced and counted).  The router must evict both, fail
+    over every orphaned in-flight request inside its deadline budget,
+    and warm-re-place each dead host's models on a survivor from the
+    replicated snapshot (`fresh_compiles == 0`).  The partitioned host
+    auto-rejoins on heal; the killed host is relaunched as a NEW agent
+    with the same host id and must be re-admitted at a bumped
+    generation with its snapshot offered back.  Gates: zero lost
+    accepted requests, zero malformed replies delivered, hi-priority
+    p99 within SLO through both events, eviction causes >= {crash,
+    partition}, every re-placement warm, stale dispatches fenced AND
+    counted, detection->replacement bounded, both failed hosts back in
+    the membership at the end."""
+    import os
+    import shutil
+    import tempfile
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.serving import (FederationPolicy,
+                                            FederationRouter, HostAgent,
+                                            LatencySLO, ModelFleet,
+                                            RejectedError)
+    from deeplearning4j_tpu.serving.federation import _rendezvous
+    from deeplearning4j_tpu.train.updaters import Sgd
+    from deeplearning4j_tpu.utils.chaos import HostChaos
+
+    n_in = 16
+    n_out = 4
+    hi_slo_ms = 2500.0
+    deadline_ms = 8000.0
+    flood = 40 if quick else 120            # requests per client thread
+    clients = 2                             # threads per priority class
+    host_ids = ["h1", "h2", "h3"]
+
+    def make_net(seed, hidden=32):
+        conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(1e-1))
+                .list([DenseLayer(n_out=hidden, activation="relu"),
+                       OutputLayer(n_out=n_out, loss="mcxent",
+                                   activation="softmax")])
+                .set_input_type(InputType.feed_forward(n_in)).build())
+        return MultiLayerNetwork(conf).init()
+
+    work_dir = tempfile.mkdtemp(prefix="bench-federation-")
+    cache_dir = os.path.join(work_dir, "exec-cache")   # SHARED across hosts
+    policy = FederationPolicy(heartbeat_interval_s=0.1,
+                              failure_deadline_s=0.8,
+                              straggler_deadline_s=6.0,
+                              max_failovers=3, affinity_slack=4,
+                              ghost_linger_s=8.0)
+
+    def build_fleet(host_id):
+        d = os.path.join(work_dir, host_id)
+        os.makedirs(d, exist_ok=True)
+        fleet = ModelFleet(max_resident=2, n_slices=4, max_batch=8,
+                           batch_timeout_ms=1.0, cache_dir=cache_dir,
+                           snapshot_path=os.path.join(d, "snapshot.json"),
+                           snapshot_interval_s=0.2, host_id=host_id,
+                           observe_every=4)
+        fleet.deploy("hi", make_net(1001),
+                     slo=LatencySLO(target_p99_ms=hi_slo_ms, priority=10),
+                     warm=True)
+        fleet.deploy("lo", make_net(1002),
+                     slo=LatencySLO(target_p99_ms=1000.0, priority=0),
+                     warm=True)
+        return fleet
+
+    router = FederationRouter(
+        policy, replicas_dir=os.path.join(work_dir, "router-replicas"))
+    os.makedirs(router.replicas_dir, exist_ok=True)
+    fleets, agents = {}, {}
+    try:
+        port = router.start(0)
+        for h in host_ids:
+            fleets[h] = build_fleet(h)
+            agents[h] = HostAgent(
+                h, fleets[h], ("127.0.0.1", port), policy=policy,
+                replicas_dir=os.path.join(work_dir, h, "replicas")).start()
+        x0 = np.random.RandomState(0).rand(2, n_in).astype(np.float32)
+        for name in ("hi", "lo"):           # warm the cross-host path
+            router.output(name, x0, deadline_ms=60_000.0, timeout=120)
+        for h in host_ids:                  # replicate a snapshot of each
+            fleets[h].save_snapshot()       # host's topology to the router
+        rep_deadline = time.monotonic() + 10.0
+        while time.monotonic() < rep_deadline:
+            if set(router.federation_stats()["replicas"]) >= set(host_ids):
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("snapshot replication never completed")
+
+        # the hi-affinity host takes the kill (it is guaranteed traffic);
+        # the lo-affinity host among the SURVIVORS takes the partition,
+        # so its post-kill lo dispatches trip the chaos wrapper
+        kill_host = _rendezvous(host_ids, "hi")
+        part_host = _rendezvous([h for h in host_ids if h != kill_host],
+                                "lo")
+        kill = HostChaos(mode="kill", at_dispatch=0)
+        part = HostChaos(mode="partition", at_dispatch=0, duration_s=1.5)
+        armed = {"kill": threading.Event(), "part": threading.Event()}
+        progress = threading.Lock()
+        submitted = [0]
+        total = flood * clients * 2
+
+        def client(spec):
+            name, prio, seed = spec
+            rs = np.random.RandomState(seed)
+            served = failed = shed = bad = 0
+            lat = []
+            for _ in range(flood):
+                with progress:
+                    submitted[0] += 1
+                    n = submitted[0]
+                if n == total // 4 and not kill.fired:
+                    kill.arm(agents[kill_host])
+                    armed["kill"].set()
+                if n == total // 2 and not part.fired:
+                    part.arm(agents[part_host])
+                    armed["part"].set()
+                x = rs.rand(2, n_in).astype(np.float32)
+                t0 = time.perf_counter()
+                try:
+                    f = router.submit(name, x, priority=prio,
+                                      deadline_ms=deadline_ms)
+                except RejectedError:
+                    shed += 1
+                    continue
+                # accepted: this future MUST resolve — a killed or
+                # partitioned host has to fail over, not lose it
+                exc = f.exception(timeout=60)
+                if exc is None:
+                    y = f.result()
+                    if y.shape != (2, n_out):   # a stale reply delivered
+                        bad += 1                # to a client would land here
+                    else:
+                        served += 1
+                        lat.append((time.perf_counter() - t0) * 1000.0)
+                else:
+                    failed += 1
+            return name, served, failed, shed, bad, lat
+
+        specs = [("hi", 10, 100 + i) for i in range(clients)] \
+            + [("lo", 0, 200 + i) for i in range(clients)]
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(len(specs)) as ex:
+            results = list(ex.map(client, specs))
+        flood_dt = time.perf_counter() - t0
+        assert armed["kill"].wait(10) and armed["part"].wait(10), \
+            "chaos never armed"
+
+        # ---- sustain + recovery: the flood can outrun the failure
+        # detector, so keep traffic flowing (still SLO-gated: sustain
+        # hi latencies count toward p99) until BOTH faults have fired,
+        # both evictions are replaced, and the partitioned host is back
+        sustain = {"served": 0, "failed": 0, "shed": 0}
+        sustain_hi_lat = []
+        rs = np.random.RandomState(999)
+        recover_deadline = time.monotonic() + 45.0
+        while time.monotonic() < recover_deadline:
+            ev = list(router.events)
+            replaced = {e["host"] for e in ev if e["event"] == "replaced"}
+            if kill.fired and part.fired \
+                    and {kill_host, part_host} <= replaced \
+                    and part_host in router.hosts() \
+                    and agents[part_host].generation == router.generation:
+                break
+            for name, prio in (("hi", 10), ("lo", 0)):
+                x = rs.rand(2, n_in).astype(np.float32)
+                ts = time.perf_counter()
+                try:
+                    f = router.submit(name, x, priority=prio,
+                                      deadline_ms=deadline_ms)
+                except RejectedError:
+                    sustain["shed"] += 1
+                    continue
+                if f.exception(timeout=60) is None:
+                    sustain["served"] += 1
+                    if name == "hi":
+                        sustain_hi_lat.append(
+                            (time.perf_counter() - ts) * 1000.0)
+                else:
+                    sustain["failed"] += 1
+            time.sleep(0.02)
+        else:
+            raise RuntimeError(
+                "federation never recovered: "
+                f"kill.fired={kill.fired} part.fired={part.fired} "
+                f"events={list(router.events)[-12:]}")
+        events = list(router.events)
+        evictions = [e for e in events if e["event"] == "evict"]
+        replacements = [e for e in events if e["event"] == "replaced"]
+        stale_fenced = int(router.instruments.stale_dispatch.value)
+
+        # ---- relaunch the killed host: same id, NEW agent, bumped gen ----
+        gen_before = router.generation
+        relaunched = HostAgent(
+            kill_host, fleets[kill_host], ("127.0.0.1", port),
+            policy=policy,
+            replicas_dir=os.path.join(work_dir, kill_host, "replicas"))
+        relaunched.start(timeout=15.0)
+        old_agent, agents[kill_host] = agents[kill_host], relaunched
+        old_agent.close()
+        for name in ("hi", "lo"):           # full membership serves again
+            router.output(name, x0, deadline_ms=60_000.0, timeout=120)
+
+        served = {n: 0 for n, *_ in results}
+        failed, shed, bad = dict(served), dict(served), dict(served)
+        hi_lat = list(sustain_hi_lat)
+        for name, s, f_, sh, b, lat in results:
+            served[name] += s
+            failed[name] += f_
+            shed[name] += sh
+            bad[name] += b
+            if name == "hi":
+                hi_lat.extend(lat)
+        hi_lat.sort()
+        hi_p99 = hi_lat[min(len(hi_lat) - 1,
+                            int(len(hi_lat) * 0.99))] if hi_lat else -1.0
+
+        return {
+            "flood_requests": total,
+            "flood_duration_s": flood_dt,
+            "hi_slo_ms": hi_slo_ms,
+            "hi_p99_ms": hi_p99,
+            "served": served,
+            "failed": failed,
+            "shed": shed,
+            "bad_replies": bad,
+            "sustain": sustain,
+            "lost_accepted": sum(failed.values()) + sustain["failed"],
+            "kill_host": kill_host,
+            "part_host": part_host,
+            "evictions": [{k: e[k] for k in
+                           ("host", "cause", "detection_ms", "generation")}
+                          for e in evictions],
+            "replacements": [{k: e[k] for k in
+                              ("host", "on", "models", "fresh_compiles",
+                               "warm", "replace_ms")}
+                             for e in replacements],
+            "stale_fenced": stale_fenced,
+            "part_host_rejoins": agents[part_host].rejoins,
+            "relaunch_generation_before": gen_before,
+            "relaunch_generation_after": router.generation,
+            "relaunch_agent_generation": relaunched.generation,
+            "relaunch_snapshot_restored": relaunched.restored is not None,
+            "final_hosts": router.hosts(),
+            "final_healthz": router.healthz(),
+        }
+    finally:
+        for a in agents.values():
+            try:
+                a.close()
+            except Exception:
+                pass
+        router.shutdown()
+        for f in fleets.values():
+            try:
+                f.shutdown()
+            except Exception:
+                pass
+        shutil.rmtree(work_dir, ignore_errors=True)
+
+
+def main_federation(quick: bool):
+    """`--federation` mode: federation detail to stderr +
+    BENCH_federation.json, ONE stdout JSON line.  Gates: zero lost
+    accepted requests through a host kill + a host partition, zero
+    stale replies delivered to clients (fenced AND counted instead),
+    hi-priority p99 within SLO, both evictions warm-re-placed within
+    bound, partitioned host auto-rejoined, killed host re-admitted at a
+    bumped generation."""
+    import os
+    if not os.environ.get("JAX_PLATFORMS"):
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from __graft_entry__ import _probe_backend_device_count
+        if _probe_backend_device_count() < 1:
+            print("[bench] TPU backend unreachable; federation bench on "
+                  "CPU", file=sys.stderr, flush=True)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = bench_federation(quick=quick)
+    except Exception as e:
+        print(json.dumps({"metric": "federation_lost_accepted",
+                          "value": None, "unit": "requests",
+                          "error": repr(e)[:300]}))
+        sys.exit(1)
+    for k, v in r.items():      # detail to stderr: stdout stays one line
+        print(f"[federation] {k} = {v}", file=sys.stderr, flush=True)
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_federation.json"), "w") as f:
+        json.dump(r, f, indent=2)
+    causes = {e["cause"] for e in r["evictions"]}
+    replaced_hosts = {p["host"] for p in r["replacements"]}
+    ok = (r["lost_accepted"] == 0
+          and sum(r["bad_replies"].values()) == 0
+          and r["hi_p99_ms"] <= r["hi_slo_ms"]
+          and {"crash", "partition"} <= causes
+          and {r["kill_host"], r["part_host"]} <= replaced_hosts
+          and all(p["warm"] and p["fresh_compiles"] == 0
+                  for p in r["replacements"])
+          and all(e["detection_ms"] <= 5_000.0 for e in r["evictions"])
+          and all(p["replace_ms"] <= 10_000.0 for p in r["replacements"])
+          and r["stale_fenced"] >= 1
+          and r["part_host_rejoins"] >= 1
+          and r["relaunch_generation_after"]
+          > r["relaunch_generation_before"]
+          and r["relaunch_agent_generation"]
+          == r["relaunch_generation_after"]
+          and sorted(r["final_hosts"]) == ["h1", "h2", "h3"]
+          and r["final_healthz"]["ok"])
+    print(json.dumps({
+        "metric": "federation_lost_accepted",
+        "value": r["lost_accepted"],
+        "unit": "requests",
+        "threshold": 0,
+        "pass": ok,
+        "hi_p99_ms": round(r["hi_p99_ms"], 2),
+        "hi_slo_ms": r["hi_slo_ms"],
+        "eviction_causes": sorted(causes),
+        "replacements_warm": [p["warm"] for p in r["replacements"]],
+        "detection_ms": [e["detection_ms"] for e in r["evictions"]],
+        "replace_ms": [p["replace_ms"] for p in r["replacements"]],
+        "stale_fenced": r["stale_fenced"],
+        "part_host_rejoins": r["part_host_rejoins"],
+        "relaunch_generation": r["relaunch_generation_after"],
+        "final_hosts": r["final_hosts"],
+    }))
+    if not ok:
+        sys.exit(1)
+
+
 def aot_child(cache_dir: str, steps: int, batch: int, n_in: int):
     """`--aot-child` worker: ONE process's cold-or-warm measurement.
 
@@ -2482,6 +2824,9 @@ def main():
         return
     if "--fleetchaos" in sys.argv:
         main_fleetchaos(quick)
+        return
+    if "--federation" in sys.argv:
+        main_federation(quick)
         return
     if "--fleet" in sys.argv:
         main_fleet(quick)

@@ -18,6 +18,8 @@ Scrape surface: `GET /metrics` on `ui.server.UIServer` (Prometheus text
 format) and a snapshot block on the HTML dashboard; `serving.ServingMetrics`
 is a view over the same registry.
 """
+from deeplearning4j_tpu.monitor.forecast import (  # noqa: F401
+    ArrivalRateForecaster, HoltForecaster)
 from deeplearning4j_tpu.monitor.registry import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, enabled, registry,
     set_enabled)

@@ -1,0 +1,147 @@
+"""Per-model arrival-rate forecasting over the registry's own series.
+
+ROADMAP item 2's predictive autoscaler needs to know what traffic is
+*about to* arrive, not what arrived; this module is its groundwork.  A
+`HoltForecaster` is a tiny level+trend exponential smoother (with
+`beta=0` it degrades to plain EWMA); an `ArrivalRateForecaster` feeds
+one per model from the deltas of the `fleet_requests_total{model=}`
+counters the fleet router already maintains — no second bookkeeping
+store, the forecast reads the exact series `/metrics` exports — and
+publishes each model's next-horizon rate as
+`fleet_arrival_forecast{model=}` (req/s).
+
+Usage (a reconcile-tick hook, or any periodic caller):
+
+    fc = ArrivalRateForecaster()        # process-wide registry
+    ...
+    fc.tick()                           # call once per interval
+
+Stdlib-only, like everything else in `monitor`.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from deeplearning4j_tpu.monitor.registry import MetricsRegistry, registry
+
+__all__ = ["HoltForecaster", "ArrivalRateForecaster"]
+
+
+class HoltForecaster:
+    """Holt's linear (double-exponential) smoothing over a scalar series.
+
+    `alpha` smooths the level, `beta` the trend; `beta=0` collapses to a
+    plain EWMA (trend pinned at 0).  `observe(x)` feeds one sample;
+    `forecast(steps)` extrapolates level + steps*trend, floored at 0 —
+    a negative arrival rate is never a useful prediction.
+    """
+
+    def __init__(self, alpha: float = 0.5, beta: float = 0.2):
+        if not (0.0 < alpha <= 1.0):
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        if not (0.0 <= beta <= 1.0):
+            raise ValueError(f"beta must be in [0, 1], got {beta}")
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.level: Optional[float] = None
+        self.trend = 0.0
+        self.n = 0
+
+    def observe(self, x: float) -> None:
+        x = float(x)
+        if self.level is None:
+            self.level = x
+            self.trend = 0.0
+        else:
+            prev = self.level
+            self.level = self.alpha * x \
+                + (1.0 - self.alpha) * (self.level + self.trend)
+            if self.beta > 0.0:
+                self.trend = self.beta * (self.level - prev) \
+                    + (1.0 - self.beta) * self.trend
+        self.n += 1
+
+    def forecast(self, steps: float = 1.0) -> float:
+        if self.level is None:
+            return 0.0
+        return max(0.0, self.level + float(steps) * self.trend)
+
+
+class ArrivalRateForecaster:
+    """Feeds one `HoltForecaster` per model from the registry's
+    `fleet_requests_total{model=}` counters and publishes the forecast
+    as `fleet_arrival_forecast{model=}` (req/s for the next horizon).
+
+    `tick()` is the whole API: it walks the counter family's live
+    children (`registry.children`), turns each counter's delta since the
+    previous tick into a rate, smooths it, and sets the gauge.  New
+    models appear automatically on their first tick (delta measured from
+    the counter's current value, so historical traffic before the
+    forecaster started is not misread as one giant burst).
+    """
+
+    def __init__(self, registry_: Optional[MetricsRegistry] = None,
+                 alpha: float = 0.5, beta: float = 0.2,
+                 horizon_s: float = 10.0,
+                 source: str = "fleet_requests_total",
+                 label: str = "model"):
+        self._reg = registry_ if registry_ is not None else registry()
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.horizon_s = float(horizon_s)
+        self.source = source
+        self.label = label
+        self._lock = threading.Lock()
+        self._models: Dict[str, HoltForecaster] = {}
+        self._last_value: Dict[str, int] = {}
+        self._last_tick: Optional[float] = None
+        self._gauges: Dict[str, object] = {}
+
+    def _gauge(self, model: str):
+        g = self._gauges.get(model)
+        if g is None:
+            g = self._reg.gauge(
+                "fleet_arrival_forecast",
+                help="forecast per-model arrival rate for the next "
+                "horizon (req/s; EWMA/Holt over fleet_requests_total "
+                "deltas)",
+                labels={self.label: model})
+            self._gauges[model] = g
+        return g
+
+    def tick(self, now: Optional[float] = None) -> Dict[str, float]:
+        """One sampling step; returns {model: forecast_rate}."""
+        t = time.monotonic() if now is None else float(now)
+        out: Dict[str, float] = {}
+        with self._lock:
+            dt = (t - self._last_tick) if self._last_tick is not None \
+                else None
+            self._last_tick = t
+            for labels, counter in self._reg.children(self.source):
+                model = labels.get(self.label)
+                if model is None:
+                    continue
+                value = int(counter.value)
+                prev = self._last_value.get(model)
+                self._last_value[model] = value
+                if prev is None or dt is None or dt <= 0:
+                    continue        # first sighting: baseline only
+                rate = max(0, value - prev) / dt
+                fc = self._models.get(model)
+                if fc is None:
+                    fc = self._models[model] = HoltForecaster(
+                        self.alpha, self.beta)
+                fc.observe(rate)
+                # forecast one horizon ahead, in units of tick steps
+                steps = self.horizon_s / dt if dt > 0 else 1.0
+                out[model] = fc.forecast(steps)
+                self._gauge(model).set(round(out[model], 6))
+        return out
+
+    def forecasts(self) -> Dict[str, float]:
+        """Last published forecast per model (no new sampling)."""
+        with self._lock:
+            return {m: self._gauges[m].value
+                    for m in self._gauges}

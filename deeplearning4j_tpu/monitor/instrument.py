@@ -107,6 +107,26 @@ docs/observability.md):
   gang_stale_frames_total            stale-generation data frames fenced
                                      and dropped (never summed into
                                      gradients)
+  fed_hosts                          live hosts in the serving federation
+  fed_generation                     current federation membership
+                                     generation
+  fed_host_evictions_total{cause=}   hosts evicted from the federation
+                                     (cause=crash|partition|straggler)
+  fed_replacements_total{warm=}      dead-host model re-placements onto
+                                     survivors (warm=true paid zero fresh
+                                     compiles through the AOT cache)
+  fed_cross_host_failovers_total     requests re-dispatched to another
+                                     host with the remaining deadline
+                                     budget
+  fed_stale_dispatch_total           stale-generation dispatch replies
+                                     fenced (never returned to a client)
+  fed_detection_ms                   silence observed on a host when it
+                                     was declared lost
+  fed_replace_ms                     eviction-to-replaced wall time of one
+                                     dead-host model re-placement
+  fleet_arrival_forecast{model=}     forecast per-model arrival rate for
+                                     the next horizon (req/s; EWMA/Holt
+                                     over fleet_requests_total deltas)
   quant_calibration_batches_total    batches consumed by PTQ calibration
                                      passes (quant.calibrate)
   quant_models_total{dtype=}         models quantized, by produced dtype
@@ -607,6 +627,80 @@ class FleetInstruments:
         return g
 
 
+class FederationInstruments:
+    """Cross-host federation handles (serving.federation).  Mirrors the
+    gang bundle's membership surface — generation, live-member gauge,
+    cause-labeled evictions, detection latency, stale-frame fencing —
+    plus the serving-side recovery counters (warm re-placements and
+    cross-host deadline-carrying failovers)."""
+
+    def __init__(self, registry_: Optional[MetricsRegistry] = None):
+        reg = registry_ if registry_ is not None else registry()
+        self._reg = reg
+        self.hosts = reg.gauge(
+            "fed_hosts", help="live hosts in the serving federation")
+        self.generation = reg.gauge(
+            "fed_generation",
+            help="current federation membership generation (bumps on "
+            "every eviction and admission)")
+        self.cross_host_failovers = reg.counter(
+            "fed_cross_host_failovers_total",
+            help="requests re-dispatched to another host with the "
+            "remaining deadline budget after their host failed")
+        self.stale_dispatch = reg.counter(
+            "fed_stale_dispatch_total",
+            help="stale-generation dispatch replies fenced at the router "
+            "or a host agent — counted, never returned to a client")
+        self.detection_ms = reg.histogram(
+            "fed_detection_ms",
+            help="silence observed on a host when it was declared lost "
+            "(federation failure-detection latency)")
+        self.replace_ms = reg.histogram(
+            "fed_replace_ms",
+            help="eviction-to-replaced wall time of one dead-host model "
+            "re-placement on a survivor")
+        self._evictions: dict = {}
+        self._replacements = {
+            flag: reg.counter(
+                "fed_replacements_total",
+                help="dead-host model re-placements onto survivor hosts "
+                "(warm=true paid zero fresh compiles through the shared "
+                "persistent AOT cache)",
+                labels={"warm": "true" if flag else "false"})
+            for flag in (True, False)}
+
+    def evictions(self, cause: str):
+        c = self._evictions.get(cause)
+        if c is None:
+            c = self._reg.counter(
+                "fed_host_evictions_total",
+                help="hosts evicted from the federation, by cause "
+                "(crash | partition | straggler)",
+                labels={"cause": cause})
+            self._evictions[cause] = c
+        return c
+
+    def record_membership(self, generation: int, hosts: int) -> None:
+        if not enabled():
+            return
+        self.generation.set(int(generation))
+        self.hosts.set(int(hosts))
+
+    def record_eviction(self, cause: str, detection_ms: float,
+                        generation: int, hosts: int) -> None:
+        if not enabled():
+            return
+        self.evictions(cause).inc()
+        self.detection_ms.observe(float(detection_ms))
+        self.record_membership(generation, hosts)
+
+    def record_replacement(self, warm: bool, replace_ms: float) -> None:
+        if not enabled():
+            return
+        self._replacements[bool(warm)].inc()
+        self.replace_ms.observe(float(replace_ms))
+
+
 class QuantInstruments:
     """Quantized-inference handles (quant.calibrate / quant.ptq).
     Per-dtype model counters are created lazily and memoized, matching
@@ -739,6 +833,7 @@ def aot_instruments() -> AotCacheInstruments:
 
 _comms: Optional[CommsInstruments] = None
 _gang: Optional[GangInstruments] = None
+_federation: Optional[FederationInstruments] = None
 
 
 def gang_instruments() -> GangInstruments:
@@ -747,6 +842,14 @@ def gang_instruments() -> GangInstruments:
     if _gang is None:
         _gang = GangInstruments()
     return _gang
+
+
+def federation_instruments() -> FederationInstruments:
+    """Process-wide federation handle bundle (lazy singleton)."""
+    global _federation
+    if _federation is None:
+        _federation = FederationInstruments()
+    return _federation
 
 
 def comms_instruments() -> CommsInstruments:

@@ -317,6 +317,19 @@ class MetricsRegistry:
         with self._lock:
             return sorted(self._families)
 
+    def children(self, name: str) -> List[tuple]:
+        """All live children of one family as `(labels_dict, child)`
+        pairs; empty when the family does not exist (never creates).
+        The arrival-rate forecaster walks `fleet_requests_total`
+        children through this without knowing the model names up
+        front."""
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                return []
+            return [(dict(frozen), child)
+                    for frozen, child in fam.children.items()]
+
     def unregister(self, name: str) -> None:
         with self._lock:
             self._families.pop(name, None)

@@ -357,12 +357,20 @@ class TcpGradientMesh:
 # fenced (dropped + counted), never summed into gradients.  Heartbeats
 # update liveness regardless of generation — a survivor that has not yet
 # consumed the REFORM frame still proves it is alive.
+#
+# The same framing is the wire protocol of the serving-side fleet
+# federation (`serving/federation.py`): a HostAgent JOINs the
+# FederationRouter, heartbeats, carries dispatch traffic in DATA frames
+# and replicated fleet-topology snapshots in SNAPSHOT frames — with the
+# identical stale-generation fence, so a partitioned host's late replies
+# are never returned to clients.
 _ELASTIC_HDR = struct.Struct("<QIB")
 KIND_DATA = 0        # gradient payload (gather leg or broadcast leg)
 KIND_HB = 1          # heartbeat (empty payload)
 KIND_REFORM = 2      # coordinator -> members: new (gen, world, rank map)
 KIND_JOIN = 3        # member -> coordinator: formation / rejoin request
 KIND_WELCOME = 4     # coordinator -> joiner: admission + resume point
+KIND_SNAPSHOT = 5    # federation: replicated fleet-topology snapshot copy
 
 
 class _FrameReader:

@@ -19,11 +19,17 @@ AOT compiled-executable serving model (PAPERS.md).
     resilience      — serving fault tolerance: per-replica circuit
                       breaker, failover + hedged dispatch, degraded-mode
                       ladder, crc-guarded fleet topology snapshot/restore
+    federation      — cross-host fleet federation: HostAgent per host,
+                      FederationRouter front door, generation-fenced
+                      membership, replicated snapshots + warm host-loss
+                      re-placement (UI: /federation endpoint)
 """
 from deeplearning4j_tpu.serving.batcher import (  # noqa: F401
     ContinuousBatcher, DeadlineExceededError, RejectedError)
 from deeplearning4j_tpu.serving.compile_cache import (  # noqa: F401
     BucketedCompileCache, bucket_for, bucket_sizes)
+from deeplearning4j_tpu.serving.federation import (  # noqa: F401
+    FederationRouter, HostAgent, HostLostError)
 from deeplearning4j_tpu.serving.fleet import (  # noqa: F401
     DeviceSlice, FleetController, FleetMember, FleetRouter, ModelFleet,
     Replica, ReplicaGroup, WarmPool)
@@ -33,7 +39,8 @@ from deeplearning4j_tpu.serving.registry import (  # noqa: F401
 from deeplearning4j_tpu.serving.resilience import (  # noqa: F401
     LADDER_LEVELS, CircuitBreaker, DegradedLadder, FailoverRequest,
     FatalReplicaError, FleetSnapshotter, ReplicaKilledError,
-    SnapshotCorruptError, classify_error, drain_replicas, load_snapshot)
+    SnapshotCorruptError, classify_error, drain_replicas, load_snapshot,
+    load_snapshot_payload, select_snapshot)
 from deeplearning4j_tpu.serving.server import ModelServer  # noqa: F401
 from deeplearning4j_tpu.serving.slo import (  # noqa: F401
-    FleetPolicy, LatencySLO, SLOTracker)
+    FederationPolicy, FleetPolicy, LatencySLO, SLOTracker)

@@ -1,0 +1,1242 @@
+"""Cross-host fleet federation (docs/robustness.md "Cross-host
+federation").
+
+Every robustness mechanism below this layer — circuit breakers, hedged
+failover, the degraded ladder, snapshot/restore, compile-free respawns —
+operates inside one host's `ModelFleet`.  This module is the failure
+domain above it: a **federation** of per-host fleets that keeps serving,
+within SLO and without cold compiles, through the loss of an entire
+host.
+
+Two roles, one wire protocol:
+
+* `HostAgent` — runs next to each host's `ModelFleet`.  It JOINs the
+  router over TCP, heartbeats, answers dispatch requests by submitting
+  into the local fleet, forwards every committed `FleetSnapshotter` save
+  for replication, and re-places a dead peer's models on request.
+* `FederationRouter` — the coordinator AND the front door.  It owns
+  membership (generation-fenced, heartbeat failure detection, the same
+  crash / partition / straggler taxonomy as the elastic training gang),
+  routes requests per model across hosts (consistent-hash affinity for
+  AOT mesh-fingerprint locality, least-loaded fallback), carries each
+  request's remaining deadline budget across cross-host failovers
+  exactly like `FailoverRequest` does across replicas, and holds a
+  federation-level `DegradedLadder`.
+
+The wire format is `parallel/transport.py`'s elastic framing verbatim
+(`<Q payload-len><I generation><B kind>`): HB / JOIN / WELCOME / REFORM
+frames play their gang roles for *hosts*, DATA frames carry dispatch
+traffic (a JSON header + raw ndarray bytes), and SNAPSHOT frames carry
+replicated fleet-topology snapshots.  Every reply is stamped with the
+generation its request was dispatched under; the router only settles a
+client future when the reply matches the live attempt — a partitioned
+host's late replies are fenced and counted (`fed_stale_dispatch_total`),
+never returned to a client.
+
+Host-loss recovery: on eviction the router picks the newest intact
+replicated snapshot of the dead host (highest generation wins —
+`select_snapshot`), asks the least-loaded survivor to re-place the dead
+host's resident models, and the survivor admits them through its warm
+pool + the shared persistent AOT cache: `fresh_compiles == 0` where the
+mesh fingerprint matches.  A relaunched host parks via JOIN and is
+re-admitted at a bumped generation WITH its preferred placements (its
+own replicated snapshot rides back on the WELCOME).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import select
+import socket
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.monitor.instrument import FederationInstruments
+from deeplearning4j_tpu.monitor.registry import MetricsRegistry, registry
+from deeplearning4j_tpu.parallel.transport import (KIND_DATA, KIND_HB,
+                                                   KIND_JOIN, KIND_REFORM,
+                                                   KIND_SNAPSHOT,
+                                                   KIND_WELCOME,
+                                                   _FrameReader, _frame_bytes)
+from deeplearning4j_tpu.serving.batcher import (DeadlineExceededError,
+                                                RejectedError)
+from deeplearning4j_tpu.serving.resilience import (SnapshotCorruptError,
+                                                   classify_error,
+                                                   select_snapshot,
+                                                   DegradedLadder)
+from deeplearning4j_tpu.serving.slo import FederationPolicy
+
+__all__ = ["FederationRouter", "HostAgent", "HostLostError"]
+
+_SEND_TIMEOUT_S = 2.0
+
+
+class HostLostError(RuntimeError):
+    """The request's host failed and the cross-host failover budget (or
+    the deadline budget) could not place it anywhere else."""
+
+
+# ---------------------------------------------------------------------------
+# DATA payload codec: 4-byte big-endian JSON length + JSON header + raw
+# ndarray bytes (the header's dtype/shape rebuild the array zero-copy).
+# ---------------------------------------------------------------------------
+
+
+def _encode(msg: Dict[str, Any], raw: bytes = b"") -> bytes:
+    j = json.dumps(msg, separators=(",", ":")).encode("utf-8")
+    return len(j).to_bytes(4, "big") + j + raw
+
+
+def _decode(payload: bytes) -> Tuple[Dict[str, Any], bytes]:
+    n = int.from_bytes(payload[:4], "big")
+    msg = json.loads(payload[4:4 + n].decode("utf-8"))
+    return msg, payload[4 + n:]
+
+
+def _array_parts(x) -> Tuple[Dict[str, Any], bytes]:
+    a = np.ascontiguousarray(x)
+    return {"dtype": a.dtype.str, "shape": list(a.shape)}, a.tobytes()
+
+
+def _array_from(msg: Dict[str, Any], raw: bytes) -> np.ndarray:
+    return np.frombuffer(raw, dtype=np.dtype(msg["dtype"])) \
+        .reshape(msg["shape"]).copy()
+
+
+# ---------------------------------------------------------------------------
+# Router-side host record
+# ---------------------------------------------------------------------------
+
+
+class _HostRecord:
+    __slots__ = ("host_id", "sock", "reader", "last_heard", "pending",
+                 "models", "joined_gen", "evicted", "evicted_at",
+                 "send_lock")
+
+    def __init__(self, host_id: str, sock: socket.socket, joined_gen: int):
+        self.host_id = host_id
+        self.sock = sock
+        self.reader = _FrameReader()
+        self.last_heard = time.monotonic()
+        self.pending: Dict[int, float] = {}      # request id -> dispatch t
+        self.models: Dict[str, int] = {}         # model -> priority
+        self.joined_gen = joined_gen
+        self.evicted = False
+        self.evicted_at: Optional[float] = None
+        self.send_lock = threading.Lock()
+
+    def send(self, frame: bytes) -> None:
+        with self.send_lock:
+            self.sock.sendall(frame)
+
+
+class _Pending:
+    __slots__ = ("id", "model", "header", "raw", "priority", "deadline_ms",
+                 "t0", "deadline_at", "future", "tried", "failovers",
+                 "host", "dispatch_gen", "dispatched_t")
+
+    def __init__(self, rid: int, model: str, header, raw, priority,
+                 deadline_ms):
+        self.id = rid
+        self.model = model
+        self.header = header
+        self.raw = raw
+        self.priority = priority
+        self.deadline_ms = deadline_ms
+        self.t0 = time.monotonic()
+        self.deadline_at = (self.t0 + deadline_ms / 1000.0
+                            if deadline_ms is not None else None)
+        self.future: Future = Future()
+        self.tried: List[str] = []
+        self.failovers = 0
+        self.host: Optional[str] = None
+        self.dispatch_gen = -1
+        self.dispatched_t = self.t0
+
+    def remaining_ms(self) -> Optional[float]:
+        if self.deadline_at is None:
+            return None
+        return (self.deadline_at - time.monotonic()) * 1000.0
+
+
+def _rendezvous(host_ids: List[str], model: str) -> str:
+    """Highest-random-weight (rendezvous) hash: the affinity host for a
+    model moves only when its own host leaves — evictions never reshuffle
+    the placement of models on surviving hosts, which is exactly the
+    AOT-locality property we want."""
+    return max(host_ids, key=lambda h: hashlib.md5(
+        f"{h}:{model}".encode("utf-8")).digest())
+
+
+class FederationRouter:
+    """Membership coordinator + global front door for a host federation.
+
+    `start(port=0)` binds the listener and the reactor thread; hosts
+    connect via `HostAgent`.  `submit(model, x)` routes one request and
+    returns a Future; `output(...)` is the blocking form.  See the
+    module docstring for the protocol.
+    """
+
+    def __init__(self, policy: Optional[FederationPolicy] = None,
+                 replicas_dir: Optional[str] = None,
+                 registry_: Optional[MetricsRegistry] = None):
+        self.policy = policy if policy is not None else FederationPolicy()
+        self.replicas_dir = replicas_dir
+        self._reg = registry_ if registry_ is not None else registry()
+        self.instruments = FederationInstruments(self._reg)
+        self.generation = 0
+        self.ladder = DegradedLadder(
+            down_after=self.policy.ladder_down_after,
+            up_after=self.policy.ladder_up_after)
+        self.events: List[Dict[str, Any]] = []
+        self._hosts: Dict[str, _HostRecord] = {}
+        self._ghosts: Dict[str, _HostRecord] = {}
+        self._handshakes: List[Tuple[socket.socket, _FrameReader]] = []
+        self._joiners: List[tuple] = []
+        self._known: set = set()                 # host ids ever admitted
+        self._replicas: Dict[str, Dict[str, Any]] = {}   # latest payloads
+        self._replacing: Dict[str, Tuple[str, float]] = {}
+        self._pending: Dict[int, _Pending] = {}
+        self._expected_hosts = 0
+        self._next_id = 0
+        self._lock = threading.RLock()
+        self._listener: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._closed = False
+        self.port: Optional[int] = None
+
+    # ---- lifecycle ----
+    def start(self, port: int = 0, host: str = "127.0.0.1") -> int:
+        ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind((host, port))
+        ls.listen(64)
+        ls.settimeout(0.0)
+        self._listener = ls
+        self.port = ls.getsockname()[1]
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._reactor, name="fed-router", daemon=True)
+        self._thread.start()
+        return self.port
+
+    def shutdown(self) -> None:
+        self._closed = True
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        with self._lock:
+            for rec in list(self._hosts.values()) \
+                    + list(self._ghosts.values()):
+                try:
+                    rec.sock.close()
+                except OSError:
+                    pass
+            for sock, _ in self._handshakes:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            if self._listener is not None:
+                self._listener.close()
+            for entry in list(self._pending.values()):
+                if not entry.future.done():
+                    entry.future.set_exception(
+                        RejectedError("federation router shut down"))
+            self._pending.clear()
+
+    def __enter__(self) -> "FederationRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # ---- reactor ----
+    def _reactor(self) -> None:
+        hb_interval = self.policy.heartbeat_interval_s
+        last_hb = last_tick = 0.0
+        while self._running:
+            socks = [self._listener]
+            with self._lock:
+                socks += [r.sock for r in self._hosts.values()]
+                socks += [r.sock for r in self._ghosts.values()]
+                socks += [s for s, _ in self._handshakes]
+            try:
+                readable, _, _ = select.select(socks, [], [], hb_interval)
+            except (OSError, ValueError):
+                readable = []
+            now = time.monotonic()
+            for sock in readable:
+                if sock is self._listener:
+                    self._accept()
+                else:
+                    self._pump(sock)
+            if now - last_hb >= hb_interval:
+                last_hb = now
+                self._broadcast_hb()
+            if now - last_tick >= hb_interval:
+                last_tick = now
+                self._check_deadlines(now)
+                self._sweep_pending(now)
+                self._sweep_ghosts(now)
+                self._tick_ladder()
+
+    def _accept(self) -> None:
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except (BlockingIOError, OSError):
+                return
+            conn.settimeout(_SEND_TIMEOUT_S)
+            with self._lock:
+                self._handshakes.append((conn, _FrameReader()))
+
+    def _pump(self, sock: socket.socket) -> None:
+        with self._lock:
+            rec = next((r for r in list(self._hosts.values())
+                        + list(self._ghosts.values())
+                        if r.sock is sock), None)
+            hs = next(((s, rd) for s, rd in self._handshakes
+                       if s is sock), None)
+        try:
+            data = sock.recv(1 << 16)
+        except socket.timeout:
+            return
+        except OSError:
+            data = b""
+        if not data:
+            if rec is not None and not rec.evicted:
+                self._evict(rec.host_id, "crash",
+                            (time.monotonic() - rec.last_heard) * 1000.0)
+            elif rec is not None:
+                with self._lock:
+                    self._ghosts.pop(rec.host_id, None)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            elif hs is not None:
+                with self._lock:
+                    self._handshakes.remove(hs)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            return
+        if rec is not None:
+            rec.last_heard = time.monotonic()
+            for gen, kind, payload in rec.reader.feed(data):
+                self._on_frame(rec, gen, kind, payload)
+        elif hs is not None:
+            for gen, kind, payload in hs[1].feed(data):
+                if kind == KIND_JOIN:
+                    self._on_join(sock, hs, payload)
+                    break
+
+    # ---- membership ----
+    def _on_join(self, sock: socket.socket, hs, payload: bytes) -> None:
+        try:
+            msg = json.loads(payload.decode("utf-8"))
+        except ValueError:
+            return
+        with self._lock:
+            if hs in self._handshakes:
+                self._handshakes.remove(hs)
+            if self.policy.auto_admit:
+                self._admit(sock, msg, reader=hs[1])
+            else:
+                self._joiners.append((sock, msg, hs[1]))
+
+    def admit_joiners(self) -> int:
+        """Admit every parked joiner (no-op under `auto_admit`)."""
+        with self._lock:
+            joiners, self._joiners = self._joiners, []
+            for sock, msg, reader in joiners:
+                self._admit(sock, msg, reader=reader)
+            return len(joiners)
+
+    def _admit(self, sock: socket.socket, msg: Dict[str, Any],
+               reader: Optional[_FrameReader] = None) -> None:
+        """Caller holds the lock."""
+        host_id = str(msg.get("host_id"))
+        stale = self._hosts.pop(host_id, None)
+        if stale is not None:          # superseded connection, not a death
+            try:
+                stale.sock.close()
+            except OSError:
+                pass
+        self._ghosts.pop(host_id, None)
+        self.generation += 1
+        rec = _HostRecord(host_id, sock, self.generation)
+        if reader is not None:         # frames already buffered mid-JOIN
+            rec.reader = reader
+        rec.models = {str(k): int(v)
+                      for k, v in (msg.get("models") or {}).items()}
+        self._hosts[host_id] = rec
+        rejoin = host_id in self._known
+        self._known.add(host_id)
+        self._expected_hosts = max(self._expected_hosts, len(self._hosts))
+        snap = self._replicas.get(host_id)
+        welcome = {"generation": self.generation,
+                   "hosts": sorted(self._hosts),
+                   "rejoin": rejoin,
+                   "snapshot": snap["fleet"] if rejoin and snap else None}
+        try:
+            rec.send(_frame_bytes(self.generation, KIND_WELCOME,
+                                  _encode(welcome)))
+        except OSError:
+            pass
+        self._broadcast_reform("join", evicted=None, exclude=host_id)
+        self.instruments.record_membership(self.generation,
+                                           len(self._hosts))
+        self._event("join", host=host_id, rejoin=rejoin,
+                    generation=self.generation)
+
+    def _broadcast_reform(self, cause: str, evicted: Optional[str],
+                          exclude: Optional[str] = None,
+                          include_ghost: Optional[_HostRecord] = None
+                          ) -> None:
+        """Caller holds the lock."""
+        msg = {"generation": self.generation,
+               "hosts": sorted(self._hosts),
+               "cause": cause, "evicted": evicted}
+        frame = _frame_bytes(self.generation, KIND_REFORM, _encode(msg))
+        targets = [r for h, r in self._hosts.items() if h != exclude]
+        if include_ghost is not None:
+            targets.append(include_ghost)     # best-effort eviction notice
+        for rec in targets:
+            try:
+                rec.send(frame)
+            except OSError:
+                pass
+
+    def _broadcast_hb(self) -> None:
+        with self._lock:
+            recs = list(self._hosts.values())
+            gen = self.generation
+        frame = _frame_bytes(gen, KIND_HB, b"")
+        for rec in recs:
+            try:
+                rec.send(frame)
+            except OSError:
+                pass
+
+    def _check_deadlines(self, now: float) -> None:
+        with self._lock:
+            recs = list(self._hosts.values())
+        for rec in recs:
+            silence = now - rec.last_heard
+            if silence > self.policy.failure_deadline_s:
+                self._evict(rec.host_id, "partition", silence * 1000.0)
+                continue
+            if rec.pending:
+                oldest = min(rec.pending.values())
+                if now - oldest > self.policy.straggler_deadline_s:
+                    self._evict(rec.host_id, "straggler",
+                                (now - oldest) * 1000.0)
+
+    def _evict(self, host_id: str, cause: str,
+               detection_ms: float) -> None:
+        with self._lock:
+            rec = self._hosts.pop(host_id, None)
+            if rec is None:
+                return
+            self.generation += 1
+            rec.evicted = True
+            rec.evicted_at = time.monotonic()
+            # keep the socket readable: the whole point of the fence is
+            # that a partitioned host's late replies are COUNTED, not
+            # silently lost with the connection
+            self._ghosts[host_id] = rec
+            self.instruments.record_eviction(
+                cause, detection_ms, self.generation, len(self._hosts))
+            self._event("evict", host=host_id, cause=cause,
+                        detection_ms=round(detection_ms, 3),
+                        generation=self.generation)
+            self._broadcast_reform(cause, evicted=host_id,
+                                   include_ghost=rec)
+            orphans = [self._pending.get(rid)
+                       for rid in list(rec.pending)]
+            rec.pending.clear()
+        for entry in orphans:
+            if entry is not None and not entry.future.done():
+                self._failover(entry, f"host {host_id} evicted ({cause})")
+        self._replace(host_id, rec)
+
+    # ---- host-loss re-placement ----
+    def _snapshot_body_for(self, host_id: str) -> Optional[Dict[str, Any]]:
+        if self.replicas_dir is not None:
+            try:
+                prefix = f"{host_id}-gen"
+                paths = sorted(
+                    os.path.join(self.replicas_dir, f)
+                    for f in os.listdir(self.replicas_dir)
+                    if f.startswith(prefix) and f.endswith(".json"))
+            except OSError:
+                paths = []
+            if paths:
+                try:
+                    _, payload = select_snapshot(paths)
+                    return payload["fleet"]
+                except SnapshotCorruptError:
+                    pass
+        payload = self._replicas.get(host_id)
+        return payload["fleet"] if payload else None
+
+    def _replace(self, host_id: str, rec: _HostRecord) -> None:
+        body = self._snapshot_body_for(host_id)
+        with self._lock:
+            live = [r for r in self._hosts.values() if not r.evicted]
+            if body is None or not live:
+                self._event("replace-skipped", host=host_id,
+                            reason="no snapshot" if body is None
+                            else "no survivor")
+                return
+            target = min(live, key=lambda r: len(r.pending))
+            self._replacing[host_id] = (target.host_id, time.monotonic())
+            msg = {"type": "replace", "host_id": host_id,
+                   "body": body}
+        try:
+            target.send(_frame_bytes(self.generation, KIND_DATA,
+                                     _encode(msg)))
+        except OSError:
+            with self._lock:
+                self._replacing.pop(host_id, None)
+
+    def _on_replaced(self, rec: _HostRecord, msg: Dict[str, Any]) -> None:
+        host_id = str(msg.get("host_id"))
+        with self._lock:
+            pending = self._replacing.pop(host_id, None)
+            t0 = pending[1] if pending else time.monotonic()
+            fresh = int(msg.get("fresh_compiles") or 0)
+            warm = fresh == 0
+            ms = (time.monotonic() - t0) * 1000.0
+            rec.models.update(
+                {str(m): rec.models.get(str(m), 0)
+                 for m in msg.get("models", [])})
+            self.instruments.record_replacement(warm, ms)
+            self._event("replaced", host=host_id, on=rec.host_id,
+                        models=msg.get("models", []),
+                        fresh_compiles=fresh, warm=warm,
+                        replace_ms=round(ms, 3))
+            # capacity accounted for: the ladder recovers from here
+            self._expected_hosts = max(len(self._hosts), 1)
+
+    # ---- frames from hosts ----
+    def _on_frame(self, rec: _HostRecord, gen: int, kind: int,
+                  payload: bytes) -> None:
+        if kind == KIND_HB:
+            return
+        if kind == KIND_SNAPSHOT:
+            self._on_snapshot(rec, payload)
+            return
+        if kind != KIND_DATA:
+            return
+        try:
+            msg, raw = _decode(payload)
+        except (ValueError, KeyError):
+            return
+        mtype = msg.get("type")
+        if mtype == "rep":
+            self._on_reply(rec, gen, msg, raw)
+        elif mtype == "replaced":
+            self._on_replaced(rec, msg)
+        elif mtype == "leave":
+            self._on_leave(rec)
+
+    def _on_leave(self, rec: _HostRecord) -> None:
+        with self._lock:
+            if self._hosts.pop(rec.host_id, None) is None:
+                return
+            self.generation += 1
+            rec.evicted = True
+            rec.evicted_at = time.monotonic()
+            self._ghosts[rec.host_id] = rec
+            self._expected_hosts = max(len(self._hosts), 1)
+            self._broadcast_reform("leave", evicted=rec.host_id)
+            self.instruments.record_membership(self.generation,
+                                               len(self._hosts))
+            self._event("leave", host=rec.host_id,
+                        generation=self.generation)
+            orphans = [self._pending.get(rid)
+                       for rid in list(rec.pending)]
+            rec.pending.clear()
+        for entry in orphans:
+            if entry is not None and not entry.future.done():
+                self._failover(entry, f"host {rec.host_id} left")
+
+    def _on_snapshot(self, rec: _HostRecord, payload: bytes) -> None:
+        try:
+            msg, _ = _decode(payload)
+            host_id = str(msg["host_id"])
+            snap = msg["payload"]
+        except (ValueError, KeyError):
+            return
+        with self._lock:
+            prev = self._replicas.get(host_id)
+            if prev is None or int(snap.get("generation", -1)) >= \
+                    int(prev.get("generation", -1)):
+                self._replicas[host_id] = snap
+            recs = [r for h, r in self._hosts.items() if h != host_id]
+        if self.replicas_dir is not None:
+            self._persist_replica(host_id, snap)
+        frame = _frame_bytes(self.generation, KIND_SNAPSHOT, payload)
+        for peer in recs:          # replicate to every peer host
+            try:
+                peer.send(frame)
+            except OSError:
+                pass
+
+    def _persist_replica(self, host_id: str, snap: Dict[str, Any]) -> None:
+        try:
+            os.makedirs(self.replicas_dir, exist_ok=True)
+            gen = int(snap.get("generation", 0))
+            path = os.path.join(self.replicas_dir,
+                                f"{host_id}-gen{gen:06d}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(snap, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+    # ---- dispatch ----
+    def submit(self, model: str, x, priority: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> Future:
+        """Route one request across the federation; returns its Future.
+        Raises `RejectedError` when the router is shut down, no host is
+        live, or the federation ladder is at its shed floor and the
+        request is below the highest known priority class."""
+        if self._closed:
+            raise RejectedError("federation router is shut down")
+        with self._lock:
+            if not self._hosts:
+                raise RejectedError("no live hosts in the federation")
+            if self.ladder.shed_floor():
+                floor = max((max(r.models.values(), default=0)
+                             for r in self._hosts.values()), default=0)
+                if (priority or 0) < floor:
+                    raise RejectedError(
+                        "federation degraded to shed_floor: only "
+                        f"priority >= {floor} admitted")
+            header, raw = _array_parts(x)
+            self._next_id += 1
+            entry = _Pending(self._next_id, model, header, raw,
+                             priority, deadline_ms)
+            self._pending[entry.id] = entry
+        self._dispatch(entry)
+        return entry.future
+
+    def output(self, model: str, x, priority: Optional[int] = None,
+               deadline_ms: Optional[float] = None,
+               timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking convenience form of `submit`."""
+        return self.submit(model, x, priority=priority,
+                           deadline_ms=deadline_ms).result(timeout=timeout)
+
+    def _pick_host(self, entry: _Pending) -> Optional[_HostRecord]:
+        """Caller holds the lock.  Consistent-hash (rendezvous) affinity
+        bounded by `affinity_slack`, least-loaded fallback; hosts the
+        request already tried are excluded while alternatives exist."""
+        live = [r for r in self._hosts.values() if not r.evicted]
+        if not live:
+            return None
+        serving = [r for r in live if entry.model in r.models] or live
+        fresh = [r for r in serving if r.host_id not in entry.tried] \
+            or serving
+        affinity = next(
+            (r for r in fresh if r.host_id == _rendezvous(
+                sorted(r2.host_id for r2 in fresh), entry.model)), None)
+        least = min(fresh, key=lambda r: len(r.pending))
+        if affinity is not None and len(affinity.pending) \
+                <= len(least.pending) + self.policy.affinity_slack:
+            return affinity
+        return least
+
+    def _dispatch(self, entry: _Pending) -> None:
+        remaining = entry.remaining_ms()
+        if remaining is not None and remaining <= 0:
+            self._settle_exc(entry, DeadlineExceededError(
+                f"request {entry.id}: deadline exhausted before dispatch"))
+            return
+        with self._lock:
+            rec = self._pick_host(entry)
+            if rec is None:
+                self._settle_exc(entry, HostLostError(
+                    f"request {entry.id}: no live host for "
+                    f"'{entry.model}'"))
+                return
+            entry.host = rec.host_id
+            entry.dispatch_gen = self.generation
+            entry.dispatched_t = time.monotonic()
+            entry.tried.append(rec.host_id)
+            rec.pending[entry.id] = entry.dispatched_t
+            msg = {"type": "req", "id": entry.id, "model": entry.model,
+                   "priority": entry.priority, "deadline_ms": remaining,
+                   **entry.header}
+            frame = _frame_bytes(self.generation, KIND_DATA,
+                                 _encode(msg, entry.raw))
+        try:
+            rec.send(frame)
+        except OSError:
+            with self._lock:
+                rec.pending.pop(entry.id, None)
+            self._failover(entry, f"send to {rec.host_id} failed")
+
+    def _failover(self, entry: _Pending, why: str) -> None:
+        if entry.future.done():
+            return
+        entry.failovers += 1
+        if entry.failovers > self.policy.max_failovers:
+            self._settle_exc(entry, HostLostError(
+                f"request {entry.id} ({entry.model}): {why}; "
+                f"failover budget ({self.policy.max_failovers}) "
+                "exhausted"))
+            return
+        remaining = entry.remaining_ms()
+        if remaining is not None and remaining <= 0:
+            self._settle_exc(entry, DeadlineExceededError(
+                f"request {entry.id} ({entry.model}): deadline budget "
+                f"exhausted after {entry.failovers - 1} failover(s): "
+                f"{why}"))
+            return
+        self.instruments.cross_host_failovers.inc()
+        self._dispatch(entry)
+
+    def _on_reply(self, rec: _HostRecord, gen: int, msg: Dict[str, Any],
+                  raw: bytes) -> None:
+        rid = int(msg.get("id", -1))
+        with self._lock:
+            entry = self._pending.get(rid)
+            rec.pending.pop(rid, None)
+            # THE fence: only the live attempt settles the client future.
+            # A ghost's reply, a reply from a superseded attempt, or a
+            # reply stamped with a stale dispatch generation is counted
+            # and dropped.
+            if entry is None or rec.evicted \
+                    or entry.host != rec.host_id \
+                    or entry.dispatch_gen != gen:
+                self.instruments.stale_dispatch.inc()
+                self._event("stale-fenced", host=rec.host_id, id=rid,
+                            reply_gen=gen, generation=self.generation)
+                return
+        if msg.get("ok"):
+            try:
+                self._settle_ok(entry, _array_from(msg, raw))
+            except (ValueError, KeyError) as e:
+                self._settle_exc(entry, RuntimeError(
+                    f"malformed reply from {rec.host_id}: {e!r}"))
+            return
+        cls = msg.get("class", "dispatch")
+        err = str(msg.get("error", "dispatch failed"))
+        if cls == "deadline":
+            self._settle_exc(entry, DeadlineExceededError(err))
+        elif cls == "client":
+            self._settle_exc(entry, ValueError(err))
+        else:                        # fatal | overload | dispatch
+            self._failover(
+                entry, f"host {rec.host_id} replied {cls}: {err}")
+
+    def _settle_ok(self, entry: _Pending, value: np.ndarray) -> None:
+        with self._lock:
+            self._pending.pop(entry.id, None)
+        if not entry.future.done():
+            entry.future.set_result(value)
+
+    def _settle_exc(self, entry: _Pending, exc: BaseException) -> None:
+        with self._lock:
+            self._pending.pop(entry.id, None)
+        if not entry.future.done():
+            entry.future.set_exception(exc)
+
+    def _sweep_pending(self, now: float) -> None:
+        """Settlement guarantee: no accepted future outlives its
+        deadline unsettled, whatever the hosts did."""
+        with self._lock:
+            expired = [e for e in self._pending.values()
+                       if e.deadline_at is not None
+                       and now > e.deadline_at
+                       + self.policy.heartbeat_interval_s]
+        for entry in expired:
+            with self._lock:
+                rec = self._hosts.get(entry.host) \
+                    or self._ghosts.get(entry.host)
+                if rec is not None:
+                    rec.pending.pop(entry.id, None)
+            self._settle_exc(entry, DeadlineExceededError(
+                f"request {entry.id} ({entry.model}): no reply within "
+                "deadline"))
+
+    def _sweep_ghosts(self, now: float) -> None:
+        with self._lock:
+            for host_id, rec in list(self._ghosts.items()):
+                if rec.evicted_at is not None and now - rec.evicted_at \
+                        > self.policy.ghost_linger_s:
+                    self._ghosts.pop(host_id)
+                    try:
+                        rec.sock.close()
+                    except OSError:
+                        pass
+
+    def _tick_ladder(self) -> None:
+        with self._lock:
+            pressured = len(self._hosts) < self._expected_hosts \
+                or bool(self._replacing)
+        level = self.ladder.observe(
+            pressured, why="host down" if pressured else "")
+        self.instruments.record_membership(self.generation,
+                                           len(self._hosts))
+        return level
+
+    # ---- introspection ----
+    def _event(self, kind: str, **kw) -> None:
+        """Caller may or may not hold the lock (append is atomic)."""
+        self.events.append({"at": time.time(), "event": kind, **kw})
+        if len(self.events) > 256:
+            del self.events[:-256]
+
+    def hosts(self) -> List[str]:
+        with self._lock:
+            return sorted(self._hosts)
+
+    def federation_stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "generation": self.generation,
+                "hosts": {h: {"models": sorted(r.models),
+                              "pending": len(r.pending),
+                              "joined_gen": r.joined_gen}
+                          for h, r in self._hosts.items()},
+                "ghosts": sorted(self._ghosts),
+                "pending": len(self._pending),
+                "replicas": {h: int(p.get("generation", 0))
+                             for h, p in self._replicas.items()},
+                "degraded": self.ladder.describe(),
+                "events": list(self.events[-64:]),
+            }
+
+    def healthz(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"ok": bool(self._hosts) and not self._closed,
+                    "hosts": len(self._hosts),
+                    "generation": self.generation,
+                    "degraded_level": self.ladder.level,
+                    "degraded_mode": self.ladder.name}
+
+
+# ---------------------------------------------------------------------------
+# Host agent
+# ---------------------------------------------------------------------------
+
+
+class HostAgent:
+    """One host's seat in the federation: wraps the local `ModelFleet`,
+    answers the router's dispatch/control protocol, heartbeats, forwards
+    snapshot saves for replication, and re-places dead peers' models.
+
+    Chaos hooks (driven by `utils.chaos.HostChaos`): `crash()` drops the
+    connection without a goodbye, `partition(on)` silences BOTH
+    directions (outgoing frames are deferred and flushed on heal — which
+    is exactly what makes the router's stale fence observable),
+    `hang(duration_s)` withholds dispatch replies while heartbeats keep
+    flowing, `slow(delay_s)` adds a bounded per-dispatch delay."""
+
+    def __init__(self, host_id: str, fleet,
+                 address: Tuple[str, int],
+                 policy: Optional[FederationPolicy] = None,
+                 replicas_dir: Optional[str] = None,
+                 auto_rejoin: bool = True,
+                 registry_: Optional[MetricsRegistry] = None):
+        self.host_id = str(host_id)
+        self.fleet = fleet
+        self.address = address
+        self.policy = policy if policy is not None else FederationPolicy()
+        self.replicas_dir = replicas_dir
+        self.auto_rejoin = bool(auto_rejoin)
+        reg = registry_ if registry_ is not None else fleet._reg
+        self.instruments = FederationInstruments(reg)
+        self.generation = 0
+        self.hosts: List[str] = []
+        self.evicted = False
+        self.rejoins = 0
+        self.stale_dropped = 0
+        self.restored: Optional[Dict[str, Any]] = None
+        self._sock: Optional[socket.socket] = None
+        self._reader = _FrameReader()
+        self._send_lock = threading.Lock()
+        self._deferred: List[bytes] = []
+        self._partitioned = False
+        self._hb_paused = False
+        self._hang_until = 0.0
+        self._slow_s = 0.0
+        self._welcomed = threading.Event()
+        self._running = False
+        self._threads: List[threading.Thread] = []
+        self._old_socks: List[socket.socket] = []
+        self._sent_saves = 0
+        if fleet.host_id is None:
+            fleet.host_id = self.host_id
+        if fleet.snapshotter is not None \
+                and fleet.snapshotter.host_id is None:
+            fleet.snapshotter.host_id = self.host_id
+
+    # ---- lifecycle ----
+    def start(self, timeout: float = 10.0) -> "HostAgent":
+        self._running = True
+        self._connect()
+        t1 = threading.Thread(target=self._recv_loop,
+                              name=f"fed-agent-{self.host_id}",
+                              daemon=True)
+        t2 = threading.Thread(target=self._hb_loop,
+                              name=f"fed-hb-{self.host_id}", daemon=True)
+        self._threads = [t1, t2]
+        t1.start()
+        t2.start()
+        if not self._welcomed.wait(timeout):
+            raise TimeoutError(
+                f"host {self.host_id}: no WELCOME within {timeout}s")
+        return self
+
+    def _connect(self) -> None:
+        sock = socket.create_connection(self.address, timeout=5.0)
+        sock.settimeout(_SEND_TIMEOUT_S)
+        self._sock = sock
+        self._reader = _FrameReader()
+        join = {"host_id": self.host_id,
+                "models": {m.name: m.slo.priority
+                           for m in self.fleet.members()},
+                "capacity": self.fleet.pool.max_resident}
+        self._send(_frame_bytes(self.generation, KIND_JOIN,
+                                json.dumps(join).encode("utf-8")),
+                   force=True)
+
+    def close(self) -> None:
+        """Graceful leave: tell the router (no eviction counted), stop
+        the threads, close the socket.  Idempotent."""
+        if self._running:
+            try:
+                self._send(_frame_bytes(self.generation, KIND_DATA,
+                                        _encode({"type": "leave"})),
+                           force=True)
+            except OSError:
+                pass
+        self._running = False
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        for old in self._old_socks:
+            try:
+                old.close()
+            except OSError:
+                pass
+        self._old_socks.clear()
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=2.0)
+
+    # ---- chaos hooks ----
+    def crash(self) -> None:
+        """Die without a goodbye — the router sees EOF (cause crash)."""
+        self._running = False
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def partition(self, on: bool) -> None:
+        """Silence both directions.  While on, nothing is sent (replies
+        are deferred) and nothing is read (the kernel buffers the
+        router's frames); on heal the deferred replies flush — stamped
+        with their original dispatch generation, so the router fences
+        every one of them."""
+        if on:
+            self._partitioned = True
+            return
+        self._partitioned = False
+        with self._send_lock:
+            deferred, self._deferred = self._deferred, []
+        for frame in deferred:
+            try:
+                self._send(frame)
+            except OSError:
+                break
+
+    def pause_heartbeats(self, paused: bool) -> None:
+        self._hb_paused = bool(paused)
+
+    def hang(self, duration_s: float) -> None:
+        """Withhold dispatch replies while heartbeats keep flowing — the
+        router's straggler detector is the only thing that can see
+        this."""
+        self._hang_until = time.monotonic() + float(duration_s)
+
+    def slow(self, delay_s: float) -> None:
+        self._slow_s = max(float(delay_s), 0.0)
+
+    # ---- sending ----
+    def _send(self, frame: bytes, force: bool = False) -> None:
+        with self._send_lock:
+            if self._partitioned and not force:
+                self._deferred.append(frame)
+                return
+            if self._sock is not None:
+                self._sock.sendall(frame)
+
+    # ---- heartbeats + snapshot replication ----
+    def _hb_loop(self) -> None:
+        interval = self.policy.heartbeat_interval_s
+        while self._running:
+            time.sleep(interval)
+            if not self._running or self._hb_paused or self._partitioned:
+                continue
+            try:
+                self._send(_frame_bytes(self.generation, KIND_HB, b""))
+            except OSError:
+                continue
+            self._maybe_replicate()
+
+    def _maybe_replicate(self) -> None:
+        snap = self.fleet.snapshotter
+        if snap is None or not self.policy.replicate_snapshots:
+            return
+        if snap.saves == self._sent_saves:
+            return
+        try:
+            with open(snap.path, "r", encoding="utf-8") as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            return
+        self._sent_saves = snap.saves
+        msg = {"host_id": self.host_id, "payload": payload}
+        try:
+            self._send(_frame_bytes(self.generation, KIND_SNAPSHOT,
+                                    _encode(msg)))
+        except OSError:
+            pass
+
+    # ---- receiving ----
+    def _recv_loop(self) -> None:
+        while self._running:
+            if self._partitioned:
+                time.sleep(0.02)
+                continue
+            sock = self._sock
+            if sock is None:
+                break
+            try:
+                readable, _, _ = select.select([sock], [], [], 0.25)
+            except (OSError, ValueError):
+                readable = []
+            if not readable:
+                continue
+            try:
+                data = sock.recv(1 << 16)
+            except socket.timeout:
+                continue
+            except OSError:
+                data = b""
+            if not data:
+                if self._running and self.auto_rejoin:
+                    self._rejoin()
+                    continue
+                break
+            for gen, kind, payload in self._reader.feed(data):
+                try:
+                    self._on_frame(gen, kind, payload)
+                except Exception:    # a bad frame must not kill the host
+                    pass
+
+    def _rejoin(self) -> None:
+        """Reconnect + JOIN until admitted (eviction recovery path)."""
+        self._welcomed.clear()
+        while self._running:
+            try:
+                self._connect()
+                self.rejoins += 1
+                return
+            except OSError:
+                time.sleep(self.policy.heartbeat_interval_s)
+
+    def _on_frame(self, gen: int, kind: int, payload: bytes) -> None:
+        if kind == KIND_HB:
+            return
+        if kind == KIND_WELCOME:
+            msg, _ = _decode(payload)
+            self.generation = int(msg["generation"])
+            self.hosts = list(msg.get("hosts", []))
+            self.evicted = False
+            if self.fleet.snapshotter is not None:
+                self.fleet.snapshotter.generation = self.generation
+            snap = msg.get("snapshot")
+            if snap and self.fleet.members():
+                # relaunch path: recover this host's own preferred
+                # placements from its replicated snapshot
+                try:
+                    self.restored = self.fleet.restore_snapshot(body=snap)
+                except Exception:
+                    self.restored = None
+            self._welcomed.set()
+            return
+        if kind == KIND_REFORM:
+            msg, _ = _decode(payload)
+            self.generation = int(msg["generation"])
+            self.hosts = list(msg.get("hosts", []))
+            if self.fleet.snapshotter is not None:
+                self.fleet.snapshotter.generation = self.generation
+            if self.host_id not in self.hosts:
+                self.evicted = True
+                if self.auto_rejoin and self._running:
+                    # half-close (FIN, not RST): frames this agent
+                    # already flushed — the router fences them — must
+                    # not be torn out of the router's receive buffer
+                    old = self._sock
+                    try:
+                        old.shutdown(socket.SHUT_WR)
+                    except OSError:
+                        pass
+                    self._old_socks.append(old)
+                    self._rejoin()
+            return
+        if kind == KIND_SNAPSHOT:
+            self._store_peer_snapshot(payload)
+            return
+        if kind != KIND_DATA:
+            return
+        msg, raw = _decode(payload)
+        mtype = msg.get("type")
+        if mtype == "req":
+            self._on_request(gen, msg, raw)
+        elif mtype == "replace":
+            self._on_replace(msg)
+
+    def _store_peer_snapshot(self, payload: bytes) -> None:
+        if self.replicas_dir is None:
+            return
+        try:
+            msg, _ = _decode(payload)
+            host_id = str(msg["host_id"])
+            snap = msg["payload"]
+            os.makedirs(self.replicas_dir, exist_ok=True)
+            gen = int(snap.get("generation", 0))
+            path = os.path.join(self.replicas_dir,
+                                f"{host_id}-gen{gen:06d}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(snap, f)
+            os.replace(tmp, path)
+        except (OSError, ValueError, KeyError):
+            pass
+
+    # ---- dispatch handling ----
+    def _on_request(self, gen: int, msg: Dict[str, Any],
+                    raw: bytes) -> None:
+        if gen < self.generation:
+            # the agent-side half of the fence: a request dispatched
+            # under a generation this host has already moved past is
+            # never served — the error reply (matching the request's
+            # own generation) sends the router to its failover path
+            self.stale_dropped += 1
+            self.instruments.stale_dispatch.inc()
+            self._reply_exc(int(msg.get("id", -1)), gen, RuntimeError(
+                f"host {self.host_id}: stale dispatch generation "
+                f"{gen} < {self.generation}"))
+            return
+        now = time.monotonic()
+        if now < self._hang_until:          # chaos: straggle
+            time.sleep(self._hang_until - now)
+        if self._slow_s > 0.0:              # chaos: bounded slowdown
+            time.sleep(self._slow_s)
+        rid = int(msg["id"])
+        try:
+            x = _array_from(msg, raw)
+            fut = self.fleet.submit(msg["model"], x,
+                                    priority=msg.get("priority"),
+                                    deadline_ms=msg.get("deadline_ms"))
+        except BaseException as e:
+            self._reply_exc(rid, gen, e)
+            return
+        fut.add_done_callback(
+            lambda f, rid=rid, gen=gen: self._on_done(rid, gen, f))
+
+    def _on_done(self, rid: int, gen: int, fut: Future) -> None:
+        exc = fut.exception()
+        if exc is not None:
+            self._reply_exc(rid, gen, exc)
+            return
+        header, raw = _array_parts(fut.result())
+        msg = {"type": "rep", "id": rid, "ok": True, **header}
+        try:
+            self._send(_frame_bytes(gen, KIND_DATA, _encode(msg, raw)))
+        except OSError:
+            pass
+
+    def _reply_exc(self, rid: int, gen: int, exc: BaseException) -> None:
+        msg = {"type": "rep", "id": rid, "ok": False,
+               "class": classify_error(exc), "error": str(exc)}
+        try:
+            self._send(_frame_bytes(gen, KIND_DATA, _encode(msg)))
+        except OSError:
+            pass
+
+    # ---- peer re-placement ----
+    def _on_replace(self, msg: Dict[str, Any]) -> None:
+        """Re-place a dead peer's resident models on THIS host, through
+        the shared registry (the models must be deploy()-ed here too)
+        and the shared persistent AOT cache (warm re-admission where the
+        mesh fingerprint matches)."""
+        body = msg.get("body") or {}
+        dead = str(msg.get("host_id"))
+        fleet = self.fleet
+        before = fleet.cache.stats["compiles"] if fleet.cache else 0
+        placed, missing = [], []
+        members = body.get("members", {})
+        for name in body.get("resident", []):
+            try:
+                m = fleet.member(name)
+            except KeyError:
+                missing.append(name)
+                continue
+            rec = members.get(name, {})
+            prefer = [i for i in rec.get("slices", [])
+                      if 0 <= i < len(fleet._slices)]
+            if prefer:
+                m.preferred_slices = prefer + [
+                    i for i in m.preferred_slices if i not in prefer]
+            try:
+                fleet.pool.ensure_resident(m)
+                placed.append(name)
+            except Exception:
+                missing.append(name)
+        fresh = (fleet.cache.stats["compiles"] - before
+                 if fleet.cache else 0)
+        reply = {"type": "replaced", "host_id": dead, "models": placed,
+                 "missing": missing, "fresh_compiles": fresh}
+        try:
+            self._send(_frame_bytes(self.generation, KIND_DATA,
+                                    _encode(reply)))
+        except OSError:
+            pass
+
+    # ---- introspection ----
+    def describe(self) -> Dict[str, Any]:
+        return {"host_id": self.host_id, "generation": self.generation,
+                "hosts": list(self.hosts), "evicted": self.evicted,
+                "rejoins": self.rejoins,
+                "stale_dropped": self.stale_dropped,
+                "models": sorted(m.name for m in self.fleet.members()),
+                "resident": self.fleet.pool.resident_names()}
+
+    def __enter__(self) -> "HostAgent":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

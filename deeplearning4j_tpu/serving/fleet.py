@@ -67,7 +67,8 @@ from deeplearning4j_tpu.serving.resilience import (CircuitBreaker,
                                                    FleetSnapshotter,
                                                    _HedgeScheduler,
                                                    drain_replicas,
-                                                   load_snapshot)
+                                                   load_snapshot,
+                                                   select_snapshot)
 from deeplearning4j_tpu.serving.server import ModelServer
 from deeplearning4j_tpu.serving.slo import FleetPolicy, LatencySLO, SLOTracker
 
@@ -736,6 +737,7 @@ class ModelFleet:
                  reconcile_interval_s: Optional[float] = None,
                  snapshot_path: Optional[str] = None,
                  snapshot_interval_s: Optional[float] = None,
+                 host_id: Optional[str] = None,
                  registry_: Optional[MetricsRegistry] = None):
         from deeplearning4j_tpu.compile import as_cache
         self.registry = ModelRegistry()
@@ -763,8 +765,10 @@ class ModelFleet:
             down_after=self.policy.ladder_down_after,
             up_after=self.policy.ladder_up_after)
         self._hedge_scheduler = _HedgeScheduler()
+        self.host_id = host_id
         self.snapshotter = (FleetSnapshotter(
-            self, snapshot_path, interval_s=snapshot_interval_s)
+            self, snapshot_path, interval_s=snapshot_interval_s,
+            host_id=host_id)
             if snapshot_path is not None else None)
         self.instruments.snapshot_age.set(-1.0)
         self.pool = WarmPool(self, max_resident)
@@ -1161,7 +1165,9 @@ class ModelFleet:
             return None
         return self.snapshotter.save()
 
-    def restore_snapshot(self, path: Optional[str] = None
+    def restore_snapshot(self, path: Optional[str] = None, *,
+                         paths: Optional[List[str]] = None,
+                         body: Optional[Dict[str, Any]] = None
                          ) -> Dict[str, Any]:
         """Rebuild this fleet to a snapshotted topology.  The models
         themselves must already be `deploy()`-ed (weights are
@@ -1173,13 +1179,27 @@ class ModelFleet:
         cache, so a restart on the same `cache_dir` reconverges with
         ZERO cold compiles.  Returns a report: members restored /
         missing (snapshotted but not deployed), and the fresh-compile
-        count the restore paid (0 on the warm path)."""
-        p = path if path is not None else (
-            self.snapshotter.path if self.snapshotter is not None else None)
-        if p is None:
-            raise ValueError("restore_snapshot: no path (fleet built "
-                             "without snapshot_path)")
-        body = load_snapshot(p)
+        count the restore paid (0 on the warm path).
+
+        Sources, in precedence order: `body` (an already-verified
+        topology body — the federation re-placement path), `paths`
+        (replicated copies; the intact one with the highest generation
+        wins via `select_snapshot`, so a corrupt newest copy falls back
+        to an older generation), `path`, else the fleet's own
+        `snapshot_path`."""
+        if body is None:
+            if paths is not None:
+                _, payload = select_snapshot(paths)
+                body = payload["fleet"]
+            else:
+                p = path if path is not None else (
+                    self.snapshotter.path
+                    if self.snapshotter is not None else None)
+                if p is None:
+                    raise ValueError(
+                        "restore_snapshot: no path (fleet built "
+                        "without snapshot_path)")
+                body = load_snapshot(p)
         restored, missing = [], []
         before = self.cache.stats["compiles"] if self.cache else None
         with self._admission_lock:

@@ -43,7 +43,7 @@ import threading
 import time
 import zlib
 from concurrent.futures import Future
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 from deeplearning4j_tpu.serving.batcher import (DeadlineExceededError,
                                                 RejectedError)
@@ -582,10 +582,11 @@ def _canonical(body: Dict[str, Any]) -> bytes:
                       separators=(",", ":")).encode("utf-8")
 
 
-def load_snapshot(path: str) -> Dict[str, Any]:
-    """Read + verify one committed snapshot; returns the topology body.
-    Raises `SnapshotCorruptError` on a torn write, bad crc, or format
-    mismatch — a restore must never half-apply rotten state."""
+def load_snapshot_payload(path: str) -> Dict[str, Any]:
+    """Read + verify one committed snapshot; returns the FULL payload
+    (header — `saved_at`, `host_id`, `generation` — plus the `fleet`
+    body).  Raises `SnapshotCorruptError` on a torn write, bad crc, or
+    format mismatch — a restore must never half-apply rotten state."""
     try:
         with open(path, "r", encoding="utf-8") as f:
             payload = json.load(f)
@@ -604,7 +605,37 @@ def load_snapshot(path: str) -> Dict[str, Any]:
         raise SnapshotCorruptError(
             f"{path}: crc mismatch (stored {payload['crc32']}, "
             f"computed {crc})")
-    return body
+    return payload
+
+
+def load_snapshot(path: str) -> Dict[str, Any]:
+    """Read + verify one committed snapshot; returns the topology body."""
+    return load_snapshot_payload(path)["fleet"]
+
+
+def select_snapshot(paths: Sequence[str]):
+    """Pick the best copy among replicated snapshots: the intact one with
+    the highest `(generation, saved_at)` — so a corrupt newest copy falls
+    back to an older intact generation instead of failing the restore.
+    Returns `(path, payload)`; raises `SnapshotCorruptError` if no copy
+    survives verification."""
+    best = None
+    errors = []
+    for p in paths:
+        try:
+            payload = load_snapshot_payload(p)
+        except SnapshotCorruptError as e:
+            errors.append(str(e))
+            continue
+        key = (int(payload.get("generation", -1)),
+               float(payload.get("saved_at", 0.0)))
+        if best is None or key > best[0]:
+            best = (key, p, payload)
+    if best is None:
+        raise SnapshotCorruptError(
+            "no intact snapshot among %d candidate(s): %s"
+            % (len(list(paths)), "; ".join(errors) or "none given"))
+    return best[1], best[2]
 
 
 class FleetSnapshotter:
@@ -620,21 +651,41 @@ class FleetSnapshotter:
     """
 
     def __init__(self, fleet, path: str,
-                 interval_s: Optional[float] = None):
+                 interval_s: Optional[float] = None,
+                 host_id: Optional[str] = None):
         self.fleet = fleet
         self.path = str(path)
         self.interval_s = interval_s
+        self.host_id = host_id
+        # Membership generation stamped into the header; the federation
+        # HostAgent bumps this on every REFORM/WELCOME so replicated
+        # copies order correctly across hosts even under clock skew.
+        self.generation = 0
         self.last_saved: Optional[float] = None      # monotonic
         self.saves = 0
         self._lock = threading.Lock()
+        # Replicated snapshots cross machines: a pre-existing intact file
+        # (written by an earlier process, possibly another host) seeds the
+        # age from its wall-clock header instead of reporting -1.
+        self._seed_saved_at: Optional[float] = None
+        try:
+            self._seed_saved_at = float(
+                load_snapshot_payload(self.path).get("saved_at", 0.0))
+        except (SnapshotCorruptError, TypeError, ValueError):
+            self._seed_saved_at = None
 
     # ---- age ----
     def age_s(self) -> float:
-        """Seconds since the last committed save; -1.0 before the
-        first (the `fleet_snapshot_age_s` gauge value)."""
-        if self.last_saved is None:
-            return -1.0
-        return time.monotonic() - self.last_saved
+        """Seconds since the last committed save; -1.0 before the first
+        in this process with no intact file on disk (the
+        `fleet_snapshot_age_s` gauge value).  Clamped at >= 0: a
+        replicated copy stamped by a skew-ahead clock must not report a
+        negative age."""
+        if self.last_saved is not None:
+            return max(0.0, time.monotonic() - self.last_saved)
+        if self._seed_saved_at is not None:
+            return max(0.0, time.time() - self._seed_saved_at)
+        return -1.0
 
     def maybe_save(self) -> bool:
         if self.interval_s is None:
@@ -650,6 +701,8 @@ class FleetSnapshotter:
         with self._lock:
             body = self._collect()
             payload = {"format": SNAPSHOT_FORMAT, "saved_at": time.time(),
+                       "host_id": self.host_id,
+                       "generation": int(self.generation),
                        "fleet": body,
                        "crc32": zlib.crc32(_canonical(body)) & 0xFFFFFFFF}
             d = os.path.dirname(os.path.abspath(self.path))

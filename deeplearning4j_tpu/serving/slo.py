@@ -131,6 +131,70 @@ class FleetPolicy:
                 "ladder_down_after/ladder_up_after must be >= 1")
 
 
+@dataclasses.dataclass(frozen=True)
+class FederationPolicy:
+    """Cross-host federation knobs (serving/federation.py).
+
+    Membership (mirrors the elastic-gang deadlines, but for *hosts*):
+    `heartbeat_interval_s` — HostAgent -> router heartbeat cadence.
+    `failure_deadline_s` — silence (no frame at all) past this evicts the
+    host with cause `partition`; an EOF evicts immediately with `crash`.
+    `straggler_deadline_s` — a host that keeps heartbeating but answers
+    no dispatch while one is outstanding this long is evicted as a
+    `straggler` (hung accelerator, live control plane).
+
+    Routing:
+    `max_failovers` — per-request bound on cross-host re-dispatches; the
+    deadline budget carries across them (`FailoverRequest` semantics).
+    `affinity_slack` — the consistent-hash (rendezvous) affinity host is
+    preferred until its outstanding-request count exceeds the least
+    loaded host's by more than this; then least-loaded wins.
+    `ghost_linger_s` — how long an evicted host's socket is kept readable
+    so its late, stale-generation replies are *fenced and counted*
+    instead of vanishing (the observability half of the fence).
+
+    Recovery:
+    `replicate_snapshots` — HostAgents forward every committed
+    `FleetSnapshotter` save to the router, which fans copies out to peer
+    hosts; eviction re-places the dead host's models from the newest
+    intact copy.
+    `auto_admit` — JOINed hosts (new or relaunched) are admitted at the
+    next reactor pass; `False` parks them until `admit_joiners()`.
+    `ladder_down_after` / `ladder_up_after` — consecutive pressured /
+    healthy membership ticks before the federation-level degraded ladder
+    steps down / recovers one level.
+    """
+
+    heartbeat_interval_s: float = 0.25
+    failure_deadline_s: float = 2.0
+    straggler_deadline_s: float = 4.0
+    max_failovers: int = 2
+    affinity_slack: int = 8
+    ghost_linger_s: float = 10.0
+    replicate_snapshots: bool = True
+    auto_admit: bool = True
+    ladder_down_after: int = 2
+    ladder_up_after: int = 3
+
+    def __post_init__(self):
+        if self.heartbeat_interval_s <= 0:
+            raise ValueError("heartbeat_interval_s must be > 0")
+        if self.failure_deadline_s <= self.heartbeat_interval_s:
+            raise ValueError(
+                "failure_deadline_s must exceed heartbeat_interval_s")
+        if self.straggler_deadline_s <= 0:
+            raise ValueError("straggler_deadline_s must be > 0")
+        if self.max_failovers < 0:
+            raise ValueError("max_failovers must be >= 0")
+        if self.affinity_slack < 0:
+            raise ValueError("affinity_slack must be >= 0")
+        if self.ghost_linger_s < 0:
+            raise ValueError("ghost_linger_s must be >= 0")
+        if self.ladder_down_after < 1 or self.ladder_up_after < 1:
+            raise ValueError(
+                "ladder_down_after/ladder_up_after must be >= 1")
+
+
 class SLOTracker:
     """Sustained-breach state machine over windowed p99 observations.
 

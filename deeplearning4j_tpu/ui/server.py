@@ -35,6 +35,7 @@ class UIServer:
         self._paths: List[str] = []
         self._serving: List = []          # serving.ServingMetrics sources
         self._fleets: List = []           # serving.ModelFleet sources
+        self._federations: List = []      # serving.FederationRouter sources
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.refresh_seconds = 5
@@ -87,6 +88,27 @@ class UIServer:
         self._fleets = [f for f in self._fleets if f is not fleet]
         return self
 
+    def attach_federation(self, fed) -> "UIServer":
+        """Monitor a `serving.FederationRouter` (anything with
+        `federation_stats()` and `healthz()`): exported as JSON at
+        `/federation` (membership, generation, per-host pending, recent
+        eviction / re-placement events) and folded into `/healthz`."""
+        self._federations.append(fed)
+        return self
+
+    def detach_federation(self, fed) -> "UIServer":
+        self._federations = [f for f in self._federations if f is not fed]
+        return self
+
+    def _federation_snapshots(self) -> List[dict]:
+        out = []
+        for f in list(self._federations):
+            try:
+                out.append(f.federation_stats())
+            except Exception as e:  # a dead federation must not 500 the UI
+                out.append({"error": repr(e)})
+        return out
+
     def _fleet_snapshots(self) -> List[dict]:
         out = []
         for f in list(self._fleets):
@@ -117,11 +139,19 @@ class UIServer:
                 fleets.append(f.healthz())
             except Exception as e:      # a dead fleet must not 500 /healthz
                 fleets.append({"ok": False, "error": repr(e)})
+        feds = []
+        for f in list(self._federations):
+            try:
+                feds.append(f.healthz())
+            except Exception as e:
+                feds.append({"ok": False, "error": repr(e)})
         return {"ok": True,
                 "storages": len(self._storages) + len(self._paths),
                 "serving_sources": len(self._serving),
                 "fleets": len(self._fleets),
-                "fleet_health": fleets}
+                "fleet_health": fleets,
+                "federations": len(self._federations),
+                "federation_health": feds}
 
     def readyz(self) -> dict:
         """Aggregate readiness for `GET /readyz`: every attached serving
@@ -205,6 +235,11 @@ class UIServer:
                     # fleet topology: residency, per-model SLO state,
                     # slice allocation, recent controller actions
                     body = json.dumps(ui._fleet_snapshots()).encode()
+                    ctype = "application/json"
+                elif self.path.rstrip("/") == "/federation":
+                    # federation membership: hosts, generation, ladder,
+                    # recent eviction / re-placement events
+                    body = json.dumps(ui._federation_snapshots()).encode()
                     ctype = "application/json"
                 elif self.path.rstrip("/") == "/healthz":
                     # liveness: this thread answered, so the server is up

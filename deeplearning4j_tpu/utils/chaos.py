@@ -15,6 +15,8 @@ radius is observable next to the recovery counters it should trigger.
     FlakyDispatch        serving dispatch_fn that raises N times
     ReplicaChaos         kill/hang/slow/flaky ONE live fleet replica
                          (serving self-healing / failover scenarios)
+    HostChaos            kill/partition/hang/slow an ENTIRE host agent
+                         (cross-host federation failure domains)
 
 None of this is imported by production code paths — tests (and operators
 running game days) compose it in explicitly.
@@ -384,3 +386,101 @@ class ReplicaChaos:
                 raise ChaosError(
                     f"injected flaky dispatch {self._flaked}/{self.times}")
         return self._orig(*args, **kwargs)
+
+
+class HostChaos:
+    """Injects a HOST-LEVEL fault into one live federation `HostAgent` —
+    a whole failure domain at once, where :class:`ReplicaChaos` takes
+    out a single replica.  `arm(agent)` wraps the agent's dispatch
+    handler so the fault fires at dispatch `at_dispatch` (or call
+    `fire(agent)` to trigger it manually).  `mode`:
+
+      * ``"kill"``      — the agent drops its connection without a
+        goodbye (`agent.crash()`); the router sees EOF and evicts the
+        host with cause ``crash``.  `os_kill=True` hard-kills the whole
+        worker process (`os._exit(9)`) instead — the multi-process
+        form;
+      * ``"partition"`` — both directions go silent for `duration_s`
+        (`agent.partition`): the router evicts on the heartbeat
+        deadline (cause ``partition``), and the replies the host flushes
+        on heal arrive stale — the router fences and counts every one;
+      * ``"hang"``      — heartbeats keep flowing but dispatch replies
+        are withheld for `duration_s` (`agent.hang`): only the router's
+        straggler detector can see this (cause ``straggler``);
+      * ``"slow"``      — every dispatch is delayed `delay_s` (bounded,
+        below every failure deadline): the negative control — no
+        eviction may occur.
+
+    `marker` (file path) makes the injector one-shot across re-arms and
+    process relaunches, exactly like :class:`PeerKiller`.  `restore()`
+    unwraps and clears the slow-mode delay."""
+
+    def __init__(self, mode: str = "kill", at_dispatch: int = 0,
+                 duration_s: float = 2.0, delay_s: float = 0.05,
+                 marker: Optional[str] = None, os_kill: bool = False):
+        if mode not in ("kill", "partition", "hang", "slow"):
+            raise ValueError(f"unknown HostChaos mode {mode!r}")
+        self.mode = mode
+        self.at_dispatch = int(at_dispatch)
+        self.duration_s = float(duration_s)
+        self.delay_s = float(delay_s)
+        self.marker = marker
+        self.os_kill = bool(os_kill)
+        self.fired = False
+        self.calls = 0
+        self._agent = None
+        self._orig = None
+
+    def armed(self) -> bool:
+        if self.fired:
+            return False
+        return self.marker is None or not os.path.exists(self.marker)
+
+    def arm(self, agent):
+        """Wrap one live HostAgent's dispatch handler."""
+        if self._agent is not None:
+            raise RuntimeError("HostChaos is already armed")
+        self._agent = agent
+        self._orig = agent._on_request
+        agent._on_request = self._on_request
+        return agent
+
+    def restore(self) -> None:
+        if self._agent is not None and self._orig is not None:
+            self._agent._on_request = self._orig
+            if self.mode == "slow":
+                self._agent.slow(0.0)
+        self._agent = self._orig = None
+
+    def fire(self, agent=None) -> None:
+        """Trigger the fault on `agent` (default: the armed one) now."""
+        agent = agent if agent is not None else self._agent
+        if agent is None:
+            raise RuntimeError("HostChaos: no agent to fire on")
+        self.fired = True
+        if self.marker is not None:
+            with open(self.marker, "w") as f:
+                f.write(f"{self.mode}@{self.calls}")
+        _count(f"host_{self.mode}")
+        if self.mode == "kill":
+            if self.os_kill:
+                os._exit(9)
+            agent.crash()
+        elif self.mode == "partition":
+            agent.partition(True)
+            import threading
+            t = threading.Timer(self.duration_s, agent.partition, [False])
+            t.daemon = True
+            t.start()
+        elif self.mode == "hang":
+            agent.hang(self.duration_s)
+        else:                       # "slow"
+            agent.slow(self.delay_s)
+
+    def _on_request(self, gen, msg, raw):
+        self.calls += 1
+        if self.armed() and self.calls > self.at_dispatch:
+            self.fire()
+            if self.mode == "kill":     # a dead host serves nothing
+                return None
+        return self._orig(gen, msg, raw)

@@ -1,0 +1,83 @@
+"""Federation host worker (spawned by test_federation's multi-process
+scenario and `examples/federated_fleet.py` — NOT a pytest file).
+
+Each process runs ONE failure domain: a full `ModelFleet` (model "m",
+deployed warm against the SHARED persistent AOT cache under `work_dir`)
+wrapped by a `HostAgent` that joins the parent's `FederationRouter` over
+loopback TCP.  Every host builds the SAME seeded net, so a survivor can
+warm-re-place a dead host's model with zero fresh compiles.
+
+A `HostChaos(mode="kill", os_kill=True)` hook (argv-armed) hard-kills
+the whole process at dispatch `kill_after` — the real multi-process form
+of a host crash; the marker file keeps a relaunched replacement from
+re-firing.  The worker drops `<host_id>.ready` once WELCOMEd, then parks
+until the parent creates `stop`, finally writing `<host_id>.done` with
+`agent.describe()` so the parent can assert generations and rejoins.
+
+argv: host_id port work_dir [kill_after]
+  kill_after -1 (default) disables chaos
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.nn import (DenseLayer, InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.serving import (FederationPolicy, HostAgent,
+                                        LatencySLO, ModelFleet)
+from deeplearning4j_tpu.train.updaters import Sgd
+from deeplearning4j_tpu.utils.chaos import HostChaos
+
+host_id = sys.argv[1]
+port = int(sys.argv[2])
+work_dir = sys.argv[3]
+kill_after = int(sys.argv[4]) if len(sys.argv) > 4 else -1
+
+N_IN, N_OUT = 8, 3
+
+conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(1e-1))
+        .list([DenseLayer(n_out=16, activation="relu"),
+               OutputLayer(n_out=N_OUT, loss="mcxent",
+                           activation="softmax")])
+        .set_input_type(InputType.feed_forward(N_IN)).build())
+net = MultiLayerNetwork(conf).init()
+
+host_dir = os.path.join(work_dir, host_id)
+os.makedirs(host_dir, exist_ok=True)
+fleet = ModelFleet(max_resident=2, n_slices=2, max_batch=8,
+                   batch_timeout_ms=1.0,
+                   cache_dir=os.path.join(work_dir, "exec-cache"),
+                   snapshot_path=os.path.join(host_dir, "snapshot.json"),
+                   snapshot_interval_s=0.2, host_id=host_id)
+fleet.deploy("m", net, slo=LatencySLO(target_p99_ms=2000.0, priority=5),
+             warm=True)
+
+policy = FederationPolicy(heartbeat_interval_s=0.1, failure_deadline_s=0.8,
+                          straggler_deadline_s=5.0)
+agent = HostAgent(host_id, fleet, ("127.0.0.1", port), policy=policy,
+                  replicas_dir=os.path.join(host_dir, "replicas"))
+agent.start(timeout=30.0)
+if kill_after >= 0:
+    chaos = HostChaos(mode="kill", at_dispatch=kill_after, os_kill=True,
+                      marker=os.path.join(work_dir, f"{host_id}.killed"))
+    if chaos.armed():
+        chaos.arm(agent)
+fleet.save_snapshot()                    # replicate topology to the router
+
+with open(os.path.join(work_dir, f"{host_id}.ready"), "w") as f:
+    json.dump({"generation": agent.generation, "pid": os.getpid()}, f)
+print(f"{host_id}: joined at generation {agent.generation}", flush=True)
+
+stop = os.path.join(work_dir, "stop")
+while not os.path.exists(stop):
+    time.sleep(0.05)
+
+with open(os.path.join(work_dir, f"{host_id}.done"), "w") as f:
+    json.dump(agent.describe(), f)
+agent.close()
+fleet.shutdown()
+print(f"{host_id}: done at generation {agent.generation} "
+      f"(rejoins={agent.rejoins})", flush=True)

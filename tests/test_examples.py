@@ -14,7 +14,7 @@ EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), "..", "examples")
 SCRIPTS = sorted(f for f in os.listdir(EXAMPLES_DIR) if f.endswith(".py"))
 # multi-process supervisor examples exceed the tier-1 budget; their
 # training paths are covered by the `slow` subprocess tests directly
-SLOW_SCRIPTS = {"elastic_gang_training.py"}
+SLOW_SCRIPTS = {"elastic_gang_training.py", "federated_fleet.py"}
 
 
 def test_every_example_is_covered():
