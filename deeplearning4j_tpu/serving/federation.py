@@ -114,15 +114,16 @@ def _array_from(msg: Dict[str, Any], raw: bytes) -> np.ndarray:
 
 
 class _HostRecord:
-    __slots__ = ("host_id", "sock", "reader", "last_heard", "pending",
-                 "models", "joined_gen", "evicted", "evicted_at",
-                 "send_lock")
+    __slots__ = ("host_id", "sock", "reader", "last_heard", "last_reply",
+                 "pending", "models", "joined_gen", "evicted",
+                 "evicted_at", "send_lock")
 
     def __init__(self, host_id: str, sock: socket.socket, joined_gen: int):
         self.host_id = host_id
         self.sock = sock
         self.reader = _FrameReader()
         self.last_heard = time.monotonic()
+        self.last_reply = self.last_heard
         self.pending: Dict[int, float] = {}      # request id -> dispatch t
         self.models: Dict[str, int] = {}         # model -> priority
         self.joined_gen = joined_gen
@@ -196,11 +197,13 @@ class FederationRouter:
         self.events: List[Dict[str, Any]] = []
         self._hosts: Dict[str, _HostRecord] = {}
         self._ghosts: Dict[str, _HostRecord] = {}
-        self._handshakes: List[Tuple[socket.socket, _FrameReader]] = []
+        self._handshakes: List[
+            Tuple[socket.socket, _FrameReader, float]] = []
         self._joiners: List[tuple] = []
         self._known: set = set()                 # host ids ever admitted
         self._replicas: Dict[str, Dict[str, Any]] = {}   # latest payloads
-        self._replacing: Dict[str, Tuple[str, float]] = {}
+        self._replacing: Dict[
+            str, Tuple[str, float, Dict[str, int]]] = {}
         self._pending: Dict[int, _Pending] = {}
         self._expected_hosts = 0
         self._next_id = 0
@@ -238,7 +241,7 @@ class FederationRouter:
                     rec.sock.close()
                 except OSError:
                     pass
-            for sock, _ in self._handshakes:
+            for sock, _, _ in self._handshakes:
                 try:
                     sock.close()
                 except OSError:
@@ -262,30 +265,37 @@ class FederationRouter:
         hb_interval = self.policy.heartbeat_interval_s
         last_hb = last_tick = 0.0
         while self._running:
-            socks = [self._listener]
-            with self._lock:
-                socks += [r.sock for r in self._hosts.values()]
-                socks += [r.sock for r in self._ghosts.values()]
-                socks += [s for s, _ in self._handshakes]
+            # the settlement guarantee rests on this thread staying
+            # alive: one bad frame or race must not kill the front door
             try:
-                readable, _, _ = select.select(socks, [], [], hb_interval)
-            except (OSError, ValueError):
-                readable = []
-            now = time.monotonic()
-            for sock in readable:
-                if sock is self._listener:
-                    self._accept()
-                else:
-                    self._pump(sock)
-            if now - last_hb >= hb_interval:
-                last_hb = now
-                self._broadcast_hb()
-            if now - last_tick >= hb_interval:
-                last_tick = now
-                self._check_deadlines(now)
-                self._sweep_pending(now)
-                self._sweep_ghosts(now)
-                self._tick_ladder()
+                socks = [self._listener]
+                with self._lock:
+                    socks += [r.sock for r in self._hosts.values()]
+                    socks += [r.sock for r in self._ghosts.values()]
+                    socks += [s for s, _, _ in self._handshakes]
+                try:
+                    readable, _, _ = select.select(socks, [], [],
+                                                   hb_interval)
+                except (OSError, ValueError):
+                    readable = []
+                now = time.monotonic()
+                for sock in readable:
+                    if sock is self._listener:
+                        self._accept()
+                    else:
+                        self._pump(sock)
+                if now - last_hb >= hb_interval:
+                    last_hb = now
+                    self._broadcast_hb()
+                if now - last_tick >= hb_interval:
+                    last_tick = now
+                    self._check_deadlines(now)
+                    self._sweep_pending(now)
+                    self._sweep_ghosts(now)
+                    self._sweep_handshakes(now)
+                    self._tick_ladder()
+            except Exception as e:
+                self._event("reactor-error", error=repr(e))
 
     def _accept(self) -> None:
         while True:
@@ -295,15 +305,16 @@ class FederationRouter:
                 return
             conn.settimeout(_SEND_TIMEOUT_S)
             with self._lock:
-                self._handshakes.append((conn, _FrameReader()))
+                self._handshakes.append(
+                    (conn, _FrameReader(), time.monotonic()))
 
     def _pump(self, sock: socket.socket) -> None:
         with self._lock:
             rec = next((r for r in list(self._hosts.values())
                         + list(self._ghosts.values())
                         if r.sock is sock), None)
-            hs = next(((s, rd) for s, rd in self._handshakes
-                       if s is sock), None)
+            hs = next((t for t in self._handshakes if t[0] is sock),
+                      None)
         try:
             data = sock.recv(1 << 16)
         except socket.timeout:
@@ -429,17 +440,21 @@ class FederationRouter:
 
     def _check_deadlines(self, now: float) -> None:
         with self._lock:
-            recs = list(self._hosts.values())
-        for rec in recs:
+            # snapshot under the lock: submit() inserts into rec.pending
+            # concurrently, and a straggler host that still completes
+            # SOME dispatches (recent last_reply) is slow, not dead
+            recs = [(r, min(r.pending.values(), default=None),
+                     r.last_reply) for r in self._hosts.values()]
+        for rec, oldest, last_reply in recs:
             silence = now - rec.last_heard
             if silence > self.policy.failure_deadline_s:
                 self._evict(rec.host_id, "partition", silence * 1000.0)
                 continue
-            if rec.pending:
-                oldest = min(rec.pending.values())
-                if now - oldest > self.policy.straggler_deadline_s:
-                    self._evict(rec.host_id, "straggler",
-                                (now - oldest) * 1000.0)
+            if oldest is not None \
+                    and now - oldest > self.policy.straggler_deadline_s \
+                    and now - last_reply > self.policy.straggler_deadline_s:
+                self._evict(rec.host_id, "straggler",
+                            (now - oldest) * 1000.0)
 
     def _evict(self, host_id: str, cause: str,
                detection_ms: float) -> None:
@@ -499,7 +514,8 @@ class FederationRouter:
                             else "no survivor")
                 return
             target = min(live, key=lambda r: len(r.pending))
-            self._replacing[host_id] = (target.host_id, time.monotonic())
+            self._replacing[host_id] = (target.host_id, time.monotonic(),
+                                        dict(rec.models))
             msg = {"type": "replace", "host_id": host_id,
                    "body": body}
         try:
@@ -514,11 +530,16 @@ class FederationRouter:
         with self._lock:
             pending = self._replacing.pop(host_id, None)
             t0 = pending[1] if pending else time.monotonic()
+            # re-placed models keep the dead host's recorded priorities:
+            # the shed_floor admission floor must not drop just because
+            # the highest-priority host died
+            dead_models = pending[2] if pending else {}
             fresh = int(msg.get("fresh_compiles") or 0)
             warm = fresh == 0
             ms = (time.monotonic() - t0) * 1000.0
             rec.models.update(
-                {str(m): rec.models.get(str(m), 0)
+                {str(m): dead_models.get(
+                    str(m), rec.models.get(str(m), 0))
                  for m in msg.get("models", [])})
             self.instruments.record_replacement(warm, ms)
             self._event("replaced", host=host_id, on=rec.host_id,
@@ -715,6 +736,7 @@ class FederationRouter:
         with self._lock:
             entry = self._pending.get(rid)
             rec.pending.pop(rid, None)
+            rec.last_reply = time.monotonic()
             # THE fence: only the live attempt settles the client future.
             # A ghost's reply, a reply from a superseded attempt, or a
             # reply stamped with a stale dispatch generation is counted
@@ -772,6 +794,20 @@ class FederationRouter:
             self._settle_exc(entry, DeadlineExceededError(
                 f"request {entry.id} ({entry.model}): no reply within "
                 "deadline"))
+
+    def _sweep_handshakes(self, now: float) -> None:
+        """Connections that never complete a JOIN must not leak sockets
+        into the reactor's select set forever."""
+        with self._lock:
+            stale = [t for t in self._handshakes
+                     if now - t[2] > self.policy.failure_deadline_s]
+            for t in stale:
+                self._handshakes.remove(t)
+        for sock, _, _ in stale:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def _sweep_ghosts(self, now: float) -> None:
         with self._lock:
