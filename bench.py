@@ -97,6 +97,16 @@ AOT cache in a second subprocess — gates: >=2x throughput per byte
 resident OR >=1.5x QPS, parity delta <=1%, warm restart with zero
 compiles, quantized fingerprint distinct from f32; detail to stderr +
 `BENCH_quant.json`, one stdout JSON line.
+
+`python bench.py --decode [--quick]` floods the autoregressive decode
+engine (`serving.decode`: bucketed prefill → token-level continuous
+batching → paged KV cache) with sequence-length-skewed traffic and A/Bs
+paged-int8 against contiguous-f32 KV memory — gates: zero fresh XLA
+compiles after warmup across the skewed flood, tokens/sec floor,
+inter-token p99 bound, int8 paged KV holds >=1.5x concurrent sequences
+per HBM byte vs an f32 contiguous (max-length-reserving) cache at <=1%
+attention parity; detail to stderr + `BENCH_decode.json`, one stdout
+JSON line.
 """
 import json
 import sys
@@ -2794,6 +2804,180 @@ def main_pallas(quick: bool):
         sys.exit(1)
 
 
+def bench_decode(n_seqs=48, max_seq_len=256, max_decode_batch=8,
+                 num_blocks=192, vocab=96, d_model=64, n_heads=4,
+                 seed=0):
+    """Sequence-length-skewed decode flood + paged-vs-contiguous KV A/B.
+
+    One `DecodeEngine` with int8 paged KV serves `n_seqs` prompts whose
+    lengths are skewed across every prefill bucket (short head, long
+    tail).  Measured: tokens/sec and inter-token p99 across the flood,
+    fresh XLA compiles after warmup (must be zero — admits/retires and
+    ragged lengths never change a traced shape), peak KV pages vs peak
+    concurrent sequences.  The memory A/B compares measured bytes per
+    concurrent sequence against the contiguous-f32 baseline every
+    pre-paged serving stack pays: a `max_seq_len` * heads * head_dim *
+    2(K,V) * 4(f32) reservation per sequence regardless of actual
+    length.  Parity: int8-KV vs f32-KV paged attention on the engine's
+    OWN prefill KV (not synthetic noise), relative L2."""
+    from deeplearning4j_tpu.ops.pallas import paged_attention as pa
+    from deeplearning4j_tpu.ops.quant_kernels import quantize_tensor
+    from deeplearning4j_tpu.serving.decode import (DecodeEngine,
+                                                   TinyDecodeModel)
+
+    rng = np.random.default_rng(seed)
+    model = TinyDecodeModel(vocab=vocab, d_model=d_model,
+                            n_heads=n_heads, seed=seed)
+    eng = DecodeEngine(model, num_blocks=num_blocks,
+                       max_seq_len=max_seq_len,
+                       max_decode_batch=max_decode_batch,
+                       kv_dtype="int8", model_label="bench")
+    try:
+        warm = eng.warmup()
+        fresh_before = eng.fresh_compiles()
+
+        # skewed lengths: most prompts short, a long tail touching the
+        # top buckets — every bucket in the ladder gets traffic
+        max_prompt = max_seq_len - 24
+        pool = [3, 5, 7, 9, 14, 20, 33, 60]
+        pool = [p for p in pool if p < max_prompt] + [max_prompt]
+        weights = np.array([4.0] * (len(pool) - 1) + [1.0])
+        lens = rng.choice(pool, size=n_seqs, p=weights / weights.sum())
+        t0 = time.monotonic()
+        futs = [eng.submit(rng.integers(1, vocab, size=int(n)),
+                           max_new_tokens=int(rng.integers(4, 20)))
+                for n in lens]
+        peak_active = peak_blocks = 0
+        pending = list(futs)
+        while pending:
+            peak_active = max(peak_active, eng.cache.active_sequences)
+            peak_blocks = max(peak_blocks, eng.cache.blocks_in_use)
+            pending = [f for f in pending if not f.done()]
+            time.sleep(0.002)
+        outs = [f.result(timeout=60) for f in futs]
+        wall_s = time.monotonic() - t0
+        tokens = int(sum(len(o) for o in outs))
+        fresh_after = eng.fresh_compiles()
+        p99 = eng.instruments.inter_token("bench").percentiles(
+            (50, 99))
+
+        # ---- memory A/B: measured paged-int8 vs contiguous-f32 ----
+        head_dim = model.head_dim
+        contig_f32_bytes = max_seq_len * n_heads * head_dim * 2 * 4
+        paged_bytes = (peak_blocks * eng.cache.bytes_per_block
+                       / max(peak_active, 1))
+        density_ratio = contig_f32_bytes / max(paged_bytes, 1.0)
+
+        # ---- parity: int8-KV vs f32-KV attention on real prefill KV ----
+        import jax.numpy as jnp
+        T = min(64, max_prompt)
+        prompt = rng.integers(1, vocab, size=(1, T)).astype(np.int32)
+        _, k, v = model.prefill(jnp.asarray(prompt),
+                                jnp.asarray([T], np.int32))
+        k = np.asarray(k)[0]
+        v = np.asarray(v)[0]                      # [T, H, D]
+        page = eng.page_size
+        n_pages = -(-T // page)
+        shape = (n_pages, page, n_heads, head_dim)
+        kf = np.zeros(shape, np.float32)
+        vf = np.zeros(shape, np.float32)
+        kf.reshape(-1, n_heads, head_dim)[:T] = k
+        vf.reshape(-1, n_heads, head_dim)[:T] = v
+        k8 = np.zeros(shape, np.int8)
+        v8 = np.zeros(shape, np.int8)
+        ks = np.ones(shape[:3], np.float32)
+        vs = np.ones(shape[:3], np.float32)
+        for p in range(n_pages):
+            for s in range(page):
+                qt = quantize_tensor(kf[p, s], axis=0)
+                k8[p, s] = np.asarray(qt.q)
+                ks[p, s] = np.asarray(qt.scale).reshape(-1)
+                qt = quantize_tensor(vf[p, s], axis=0)
+                v8[p, s] = np.asarray(qt.q)
+                vs[p, s] = np.asarray(qt.scale).reshape(-1)
+        q1 = rng.standard_normal((1, n_heads, head_dim)).astype(
+            np.float32)
+        bt = np.arange(n_pages, dtype=np.int32)[None, :]
+        sl = np.array([T], np.int32)
+        a_f32 = np.asarray(pa.paged_attention_reference(
+            q1, kf, vf, bt, sl))
+        a_i8 = np.asarray(pa.paged_attention_reference(
+            q1, k8, v8, bt, sl, k_scales=ks, v_scales=vs))
+        parity = float(np.linalg.norm(a_i8 - a_f32)
+                       / max(np.linalg.norm(a_f32), 1e-12))
+        stats = eng.stats()
+    finally:
+        eng.shutdown(drain=False)
+    return {
+        "n_seqs": n_seqs, "max_seq_len": max_seq_len,
+        "max_decode_batch": max_decode_batch, "num_blocks": num_blocks,
+        "prompt_lens": sorted(set(int(n) for n in lens)),
+        "tokens": tokens, "wall_s": wall_s,
+        "tokens_per_sec": tokens / max(wall_s, 1e-9),
+        "inter_token_p50_ms": p99["p50"],
+        "inter_token_p99_ms": p99["p99"],
+        "warmup_programs": warm,
+        "fresh_compiles_after_warmup": fresh_after - fresh_before,
+        "peak_concurrent_sequences": peak_active,
+        "peak_kv_blocks": peak_blocks,
+        "paged_int8_bytes_per_seq": paged_bytes,
+        "contiguous_f32_bytes_per_seq": contig_f32_bytes,
+        "seqs_per_byte_ratio": density_ratio,
+        "int8_attention_parity": parity,
+        "engine_stats": stats,
+    }
+
+
+def main_decode(quick: bool):
+    """`--decode` mode: flood detail to stderr + BENCH_decode.json, ONE
+    stdout JSON line.  Gates (exit 1 on any failure): zero fresh compiles
+    after warmup across the skewed flood, tokens/sec floor, inter-token
+    p99 bound, paged-int8 >=1.5x concurrent sequences per HBM byte vs
+    contiguous f32 at <=1% attention parity."""
+    import os
+    if not os.environ.get("JAX_PLATFORMS"):
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from __graft_entry__ import _probe_backend_device_count
+        if _probe_backend_device_count() < 1:
+            print("[bench] TPU backend unreachable; decode bench on CPU",
+                  file=sys.stderr, flush=True)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = (bench_decode(n_seqs=12, max_seq_len=64, max_decode_batch=4,
+                          num_blocks=64)
+             if quick else bench_decode())
+    except Exception as e:
+        print(json.dumps({"metric": "decode_tokens_per_sec",
+                          "value": None, "unit": "tokens/sec",
+                          "error": repr(e)[:300]}))
+        sys.exit(1)
+    for k, v in r.items():      # detail to stderr: stdout stays one line
+        print(f"[decode] {k} = {v}", file=sys.stderr, flush=True)
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_decode.json"), "w") as f:
+        json.dump(r, f, indent=2)
+    gates = {
+        "zero_recompile": r["fresh_compiles_after_warmup"] == 0,
+        "throughput": r["tokens_per_sec"] >= 5.0,
+        "inter_token_p99": r["inter_token_p99_ms"] <= 1000.0,
+        "int8_density": r["seqs_per_byte_ratio"] >= 1.5,
+        "parity": r["int8_attention_parity"] <= 0.01,
+    }
+    print(json.dumps({
+        "metric": "decode_tokens_per_sec",
+        "value": round(r["tokens_per_sec"], 1),
+        "unit": "tokens/sec",
+        "inter_token_p99_ms": round(r["inter_token_p99_ms"], 3),
+        "fresh_compiles_after_warmup": r["fresh_compiles_after_warmup"],
+        "seqs_per_byte_ratio": round(r["seqs_per_byte_ratio"], 2),
+        "int8_attention_parity": round(r["int8_attention_parity"], 5),
+        "gates": gates,
+        "pass": all(gates.values()),
+    }))
+    if not all(gates.values()):
+        sys.exit(1)
+
+
 def main():
     quick = "--quick" in sys.argv
     if "--aot-child" in sys.argv:
@@ -2812,6 +2996,9 @@ def main():
         return
     if "--quant" in sys.argv:
         main_quant(quick)
+        return
+    if "--decode" in sys.argv:
+        main_decode(quick)
         return
     if "--pallas" in sys.argv:
         main_pallas(quick)

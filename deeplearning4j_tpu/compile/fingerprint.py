@@ -206,7 +206,8 @@ def kernel_tier_fingerprint() -> Dict[str, Any]:
         from deeplearning4j_tpu.ops.pallas import dispatch as _kd
         return _kd.kernel_tier_fingerprint()
     except Exception:
-        return {"mode": "reference", "pallas": False, "tiles": {}}
+        return {"mode": "reference", "pallas": False, "tiles": {},
+                "kv_dtype": "f32"}
 
 
 def args_signature(args: Any) -> Tuple:

@@ -752,6 +752,117 @@ class QuantInstruments:
         self.accuracy_delta.set(float(delta))
 
 
+class DecodeInstruments:
+    """Autoregressive decode-engine handles (serving.decode).  Everything
+    is a lazily-created labeled child keyed per model, matching the fleet
+    bundle's pattern, so N decode fleet members land on one aggregatable
+    family each instead of N private stores."""
+
+    def __init__(self, registry_: Optional[MetricsRegistry] = None):
+        reg = registry_ if registry_ is not None else registry()
+        self._reg = reg
+        self._tokens: dict = {}
+        self._inter_token: dict = {}
+        self._blocks: dict = {}
+        self._bytes: dict = {}
+        self._active: dict = {}
+        self._restarts: dict = {}
+
+    def tokens(self, model: str):
+        c = self._tokens.get(model)
+        if c is None:
+            c = self._reg.counter(
+                "decode_tokens_total",
+                help="tokens emitted by the decode engine (prefill last "
+                "token + every generated token)",
+                labels={"model": model})
+            self._tokens[model] = c
+        return c
+
+    def inter_token(self, model: str):
+        h = self._inter_token.get(model)
+        if h is None:
+            h = self._reg.histogram(
+                "decode_inter_token_ms",
+                help="wall time between consecutive tokens of one "
+                "sequence — the per-token SLO series (p99 drives the "
+                "fleet tracker for decode members)",
+                labels={"model": model})
+            self._inter_token[model] = h
+        return h
+
+    def kv_blocks(self, model: str):
+        g = self._blocks.get(model)
+        if g is None:
+            g = self._reg.gauge(
+                "decode_kv_blocks_in_use",
+                help="KV pages currently allocated out of the shared "
+                "pool (free-list allocator occupancy)",
+                labels={"model": model})
+            self._blocks[model] = g
+        return g
+
+    def kv_bytes(self, model: str, dtype: str):
+        key = (model, dtype)
+        g = self._bytes.get(key)
+        if g is None:
+            g = self._reg.gauge(
+                "decode_kv_bytes",
+                help="bytes of KV-cache pages currently in use, labeled "
+                "by page dtype (int8 pages count their f32 scales too)",
+                labels={"model": model, "dtype": dtype})
+            self._bytes[key] = g
+        return g
+
+    def sequences_active(self, model: str):
+        g = self._active.get(model)
+        if g is None:
+            g = self._reg.gauge(
+                "decode_sequences_active",
+                help="sequences currently holding KV pages in the "
+                "token-level continuous batcher (admitted, not retired)",
+                labels={"model": model})
+            self._active[model] = g
+        return g
+
+    def restarts(self, model: str):
+        c = self._restarts.get(model)
+        if c is None:
+            c = self._reg.counter(
+                "decode_sequence_restarts_total",
+                help="sequences explicitly restarted from token 0 on "
+                "another replica after a replica failure (decode "
+                "failover is restart-and-count, never silent resume)",
+                labels={"model": model})
+            self._restarts[model] = c
+        return c
+
+    def record_token(self, model: str, inter_token_ms: Optional[float],
+                     n: int = 1) -> None:
+        if not enabled():
+            return
+        self.tokens(model).inc(n)
+        if inter_token_ms is not None:
+            self.inter_token(model).observe(float(inter_token_ms))
+
+    def record_kv(self, model: str, blocks_in_use: int, bytes_in_use: int,
+                  dtype: str) -> None:
+        if not enabled():
+            return
+        self.kv_blocks(model).set(int(blocks_in_use))
+        self.kv_bytes(model, dtype).set(int(bytes_in_use))
+
+    def record_active(self, model: str, n: int) -> None:
+        if not enabled():
+            return
+        self.sequences_active(model).set(int(n))
+
+    def record_restart(self, model: str) -> None:
+        if not enabled():
+            return
+        self.restarts(model).inc()
+
+
 _pipeline: Optional[PipelineInstruments] = None
 _resilience: Optional[ResilienceInstruments] = None
 _aot: Optional[AotCacheInstruments] = None
@@ -805,6 +916,15 @@ class OpsInstruments:
 
 _quant: Optional[QuantInstruments] = None
 _ops: Optional[OpsInstruments] = None
+_decode: Optional[DecodeInstruments] = None
+
+
+def decode_instruments() -> DecodeInstruments:
+    """Process-wide decode-engine handle bundle (lazy singleton)."""
+    global _decode
+    if _decode is None:
+        _decode = DecodeInstruments()
+    return _decode
 
 
 def quant_instruments() -> QuantInstruments:

@@ -12,7 +12,8 @@ shape class, and folded into AOT cache keys via
 Importing this package registers the kernel set; call sites go through
 ``dispatch.resolve`` and never import kernel modules directly.
 """
-from deeplearning4j_tpu.ops.pallas import attention, dispatch, matmul, tiles
+from deeplearning4j_tpu.ops.pallas import (attention, dispatch, matmul,
+                                           paged_attention, tiles)
 from deeplearning4j_tpu.ops.pallas.tiles import (  # noqa: F401
     DEFAULT_TILES,
     TILE_FORMAT,
@@ -28,6 +29,13 @@ dispatch.register(
     reference_fn=attention.attention_reference,
     supports=attention.attention_supports,
     profitable=attention.attention_profitable,
+)
+dispatch.register(
+    "paged_attention",
+    pallas_fn=paged_attention.paged_attention,
+    reference_fn=paged_attention.paged_attention_reference,
+    supports=paged_attention.paged_supports,
+    profitable=paged_attention.paged_profitable,
 )
 dispatch.register(
     "int8_matmul",
@@ -55,6 +63,7 @@ __all__ = [
     "attention",
     "dispatch",
     "matmul",
+    "paged_attention",
     "tiles",
     "TileConfig",
     "DEFAULT_TILES",

@@ -94,6 +94,11 @@ class KernelSpec:
 
 _registry: Dict[str, KernelSpec] = {}
 _tiles: Dict[str, TileConfig] = {}
+#: KV-cache storage dtype of the decode engine ("f32" / "bf16" / "int8").
+#: Part of program identity: an int8-KV decode step traces a different
+#: program (in-kernel dequant) than an f32-KV one, so the fingerprint
+#: must split them or the AOT cache would serve a stale executable.
+_kv_dtype: str = "f32"
 
 
 def register(
@@ -174,25 +179,41 @@ def clear_tiles() -> None:
         _tiles.clear()
 
 
+def set_kv_dtype(dtype: str) -> str:
+    """Install the decode KV-cache dtype ("f32"/"bf16"/"int8") into the
+    tier fingerprint; returns the previous value (for try/finally)."""
+    global _kv_dtype
+    with _lock:
+        prev, _kv_dtype = _kv_dtype, str(dtype)
+    return prev
+
+
+def kv_dtype() -> str:
+    return _kv_dtype
+
+
 def reset() -> None:
     """Test hook: restore env-derived mode and drop installed tiles."""
-    global _mode
+    global _mode, _kv_dtype
     with _lock:
         _mode = os.environ.get("DL4J_TPU_KERNEL_TIER", "auto")
         if _mode not in _MODES:
             _mode = "auto"
         _tiles.clear()
+        _kv_dtype = "f32"
 
 
 def kernel_tier_fingerprint() -> Dict[str, Any]:
     """Stable description of the tier config, folded into AOT cache keys.
 
     Distinguishes reference programs from Pallas-default programs from
-    autotuned-tile programs: any change in mode, availability, or any
-    installed tile changes the fingerprint.
+    autotuned-tile programs: any change in mode, availability, any
+    installed tile, or the decode KV-cache dtype changes the fingerprint
+    (an f32-KV and an int8-KV decode program never share an AOT entry).
     """
     return {
         "mode": _mode,
         "pallas": pallas_available(),
         "tiles": {k: cfg.to_json() for k, cfg in sorted(_tiles.items())},
+        "kv_dtype": _kv_dtype,
     }

@@ -61,6 +61,9 @@ DEFAULT_TILES: Dict[str, TileConfig] = {
     "int8_matmul": TileConfig(block_m=256, block_n=256, block_k=512),
     "q_matmul": TileConfig(block_m=256, block_n=256, block_k=512),
     "fused_dense": TileConfig(block_m=256, block_n=256, block_k=512),
+    # decode attention: block_kv IS the KV page size the serving layer
+    # allocates (one page per grid step), block_q is the single decode row
+    "paged_attention": TileConfig(block_q=1, block_kv=16),
 }
 
 #: Candidate values per tile dimension, per kernel.  Kept deliberately
@@ -86,6 +89,9 @@ TILE_SPACES: Dict[str, Dict[str, List[int]]] = {
         "block_n": [128, 256, 512],
         "block_k": [256, 512, 1024],
     },
+    "paged_attention": {
+        "block_kv": [8, 16, 32, 64, 128],
+    },
 }
 
 #: Dimensions swept by the coarse grid stage (the rest are greedy-refined).
@@ -94,6 +100,7 @@ TILE_GRID_DIMS: Dict[str, Tuple[str, ...]] = {
     "int8_matmul": ("block_m", "block_n"),
     "q_matmul": ("block_m", "block_n"),
     "fused_dense": ("block_m", "block_n"),
+    "paged_attention": ("block_kv",),
 }
 
 

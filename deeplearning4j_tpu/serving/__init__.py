@@ -19,6 +19,10 @@ AOT compiled-executable serving model (PAPERS.md).
     resilience      — serving fault tolerance: per-replica circuit
                       breaker, failover + hedged dispatch, degraded-mode
                       ladder, crc-guarded fleet topology snapshot/restore
+    decode          — autoregressive decode engine: bucketed prefill,
+                      token-level continuous batching, paged (optionally
+                      int8) KV cache; joins the fleet via deploy_decode
+                      with per-token SLOs and restart-and-count failover
     federation      — cross-host fleet federation: HostAgent per host,
                       FederationRouter front door, generation-fenced
                       membership, replicated snapshots + warm host-loss
@@ -28,6 +32,9 @@ from deeplearning4j_tpu.serving.batcher import (  # noqa: F401
     ContinuousBatcher, DeadlineExceededError, RejectedError)
 from deeplearning4j_tpu.serving.compile_cache import (  # noqa: F401
     BucketedCompileCache, bucket_for, bucket_sizes)
+from deeplearning4j_tpu.serving.decode import (  # noqa: F401
+    DecodeEngine, DecodeSequence, DecodeServerAdapter, KVBlockAllocator,
+    KVCacheExhausted, PagedKVCache, TinyDecodeModel)
 from deeplearning4j_tpu.serving.federation import (  # noqa: F401
     FederationRouter, HostAgent, HostLostError)
 from deeplearning4j_tpu.serving.fleet import (  # noqa: F401

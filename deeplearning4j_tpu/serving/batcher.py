@@ -137,6 +137,25 @@ class ContinuousBatcher:
             self._cond.notify_all()
         return req.future
 
+    def cancel(self, fut: Future) -> bool:
+        """Retire one queued request NOW: remove it from the queue, cancel
+        its future, and release its admission slot immediately (waking
+        anything waiting on queue capacity).  Before this, retirement
+        accounting only settled at group boundaries — a request abandoned
+        mid-group kept occupying a `max_queue` slot until the worker's
+        next `_collect` pass got around to expiry.  Returns False when the
+        future is unknown or already dispatched (a dispatched request
+        cannot be recalled from the device)."""
+        with self._cond:
+            for r in self._pending:
+                if r.future is fut:
+                    self._pending.remove(r)
+                    self.metrics.record_queue_depth(len(self._pending))
+                    self._cond.notify_all()
+                    fut.cancel()
+                    return True
+        return False
+
     @property
     def queue_depth(self) -> int:
         with self._cond:
@@ -172,10 +191,15 @@ class ContinuousBatcher:
         return r.priority
 
     def _expire_locked(self) -> None:
-        """Fail and drop past-deadline requests (caller holds the lock)."""
+        """Fail and drop past-deadline requests (caller holds the lock).
+        Requests whose future was cancelled out from under us (client-side
+        `Future.cancel` instead of `ContinuousBatcher.cancel`) are dropped
+        too — never dispatched, never `set_result` on a cancelled future."""
         now = time.monotonic()
         alive = []
         for r in self._pending:
+            if r.future.cancelled():
+                continue
             if r.deadline is not None and now > r.deadline:
                 self.metrics.expired.inc()
                 self.metrics.record_shed(r.priority, "expired")
@@ -239,7 +263,8 @@ class ContinuousBatcher:
         except Exception as e:         # propagate to every waiter
             self.metrics.failed.inc(len(batch))
             for r in batch:
-                r.future.set_exception(e)
+                if not r.future.cancelled():
+                    r.future.set_exception(e)
             return
         finally:
             self._inflight_since = None
@@ -250,12 +275,15 @@ class ContinuousBatcher:
                 f"{len(batch)} requests")
             self.metrics.failed.inc(len(batch))
             for r in batch:
-                r.future.set_exception(err)
+                if not r.future.cancelled():
+                    r.future.set_exception(err)
             return
         self.metrics.record_dispatch(
             n_requests=len(batch), rows=sum(x.shape[0] for x in xs),
             dispatch_ms=(now - t0) * 1000.0)
         for r, o in zip(batch, outs):
+            if r.future.cancelled():
+                continue
             self.metrics.record_latency((now - r.enqueued) * 1000.0)
             r.future.set_result(o)
 

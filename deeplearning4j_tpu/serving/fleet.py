@@ -217,7 +217,11 @@ class FleetMember:
     name: str
     slo: LatencySLO
     tracker: SLOTracker
-    latency: Histogram                   # fleet_latency_ms{model=}
+    latency: Histogram                   # fleet_latency_ms{model=}; for
+    #                                      decode members this is the
+    #                                      decode_inter_token_ms{model=}
+    #                                      child — per-TOKEN SLO
+    kind: str = "output"                 # output | decode
     replicas_target: int = 1
     schedule: Any = None                 # compile.Schedule or None
     state: str = "cold"                  # cold | resident | evicting
@@ -242,6 +246,7 @@ class FleetMember:
     def describe(self, now: float) -> Dict[str, Any]:
         return {
             "state": self.state,
+            "kind": self.kind,
             "priority": self.slo.priority,
             "slo": self.tracker.snapshot(),
             "replicas": self.group.describe() if self.group else [],
@@ -542,6 +547,7 @@ class FleetController:
             resident = fleet.pool.resident()
             # self-healing first: a dead replica is worse than a slow one
             self._heal(resident, actions, now)
+            self._heal_decode(actions, now)
             # degraded-mode ladder: sustained breach or capacity still
             # lost after healing steps the fleet down one named level
             pressured_fleet = (
@@ -618,6 +624,48 @@ class FleetController:
                         detect_ms = age * 1000.0
                 if cause is not None:
                     self._respawn(m, r, cause, detect_ms, actions)
+
+    def _heal_decode(self, actions: List[Dict[str, Any]],
+                     now: float) -> None:
+        """Caller holds the admission lock.  Decode members are outside
+        the warm pool, so the output-member heal walk never sees them;
+        this pass respawns every poisoned decode replica through its
+        stored engine factory on the SAME slice.  In-flight sequences on
+        the dead engine have already failed over (restart-and-count in
+        `generate`); the fresh engine starts empty."""
+        fleet = self.fleet
+        for m in fleet._decode_members():
+            group = m.group
+            factory = fleet._decode_factories.get(m.name)
+            if group is None or factory is None:
+                continue
+            for r in group.snapshot():
+                if not r.poisoned:
+                    continue
+                t0 = time.monotonic()
+                group.replicas.remove(r)         # routing-first
+                opened = r.breaker.opened_at
+                detect_ms = ((now - opened) * 1000.0
+                             if opened is not None else 0.0)
+                try:
+                    r.server.shutdown(drain=False, timeout=1.0)
+                except Exception:    # a dead engine may fail teardown
+                    pass
+                group.replicas.append(fleet._build_decode_replica(
+                    m, r.slice, factory))
+                m.respawns += 1
+                m.last_respawn = {
+                    "cause": "poisoned", "slice": r.slice.index,
+                    "fresh_compiles": None,
+                    "detect_ms": round(detect_ms, 3),
+                    "respawn_ms": round(
+                        (time.monotonic() - t0) * 1000.0, 3),
+                    "drain_expired": []}
+                fleet.instruments.respawns("poisoned").inc()
+                fleet._note_breaker(m)
+                actions.append({"action": "respawn", "model": m.name,
+                                "slice": r.slice.index,
+                                "cause": "poisoned", "kind": "decode"})
 
     def _respawn(self, member: FleetMember, replica: Replica, cause: str,
                  detect_ms: float, actions: List[Dict[str, Any]]) -> None:
@@ -755,6 +803,7 @@ class ModelFleet:
         self._reg = registry_ if registry_ is not None else registry()
         self.instruments = FleetInstruments(self._reg)
         self._members: Dict[str, FleetMember] = {}
+        self._decode_factories: Dict[str, Any] = {}   # respawn recipes
         self._admission_lock = threading.RLock()
         self._slices, self._free_slices = self._build_slices(
             devices, slice_size, n_slices, max_resident)
@@ -875,6 +924,160 @@ class ModelFleet:
         if warm:
             self.pool.ensure_resident(member)
         return member
+
+    def deploy_decode(self, name: str, engine_factory, *,
+                      slo: Optional[LatencySLO] = None,
+                      replicas: int = 1) -> FleetMember:
+        """Deploy an autoregressive decode engine as a first-class fleet
+        member (`kind="decode"`).  `engine_factory(slice_)` builds one
+        `serving.decode.DecodeEngine` per replica (called again on
+        respawn, so a poisoned replica heals through the same recipe).
+
+        Decode members differ from output members in exactly two ways:
+
+        * their SLO series is **inter-token** latency — `member.latency`
+          IS the engine's `decode_inter_token_ms{model=}` histogram
+          child (registry get-or-create identity), so the PR-12 SLO
+          tracker, shed ordering and degraded ladder all act on
+          per-token p99 with zero new machinery;
+        * they are NOT warm-pool managed: a decode replica holds live KV
+          state for in-flight sequences, so LRU eviction would silently
+          kill them.  Residency is permanent until `shutdown()`; healing
+          is the controller's `_heal_decode` pass.
+
+        Route traffic with `generate()`, not `submit()`."""
+        if self._closed:
+            raise RejectedError("fleet is shut down")
+        if name in self._members:
+            raise ValueError(f"model '{name}' already deployed")
+        slo = slo if slo is not None else LatencySLO()
+        member = FleetMember(
+            name=name, slo=slo,
+            tracker=SLOTracker(slo, breach_after=self.policy.breach_after,
+                               clear_after=self.policy.clear_after),
+            latency=self._reg.histogram(
+                "decode_inter_token_ms", labels={"model": name}),
+            kind="decode", replicas_target=max(int(replicas), 1))
+        self._decode_factories[name] = engine_factory
+        with self._admission_lock:
+            group = ReplicaGroup(name, instruments=self.instruments)
+            for _ in range(member.replicas_target):
+                slice_ = self._take_slice()
+                group.replicas.append(self._build_decode_replica(
+                    member, slice_, engine_factory))
+            member.group = group
+            member.state = "resident"
+            member.last_used = time.monotonic()
+        self._members[name] = member
+        self.instruments.models.set(len(self._members))
+        return member
+
+    def _build_decode_replica(self, member: FleetMember, slice_,
+                              engine_factory) -> Replica:
+        """Caller holds the admission lock (or is constructing the
+        member).  Builds engine + adapter on `slice_` and re-binds
+        `member.latency` to the engine's actual inter-token series, so
+        SLO observation reads exactly what the engine records."""
+        from deeplearning4j_tpu.serving.decode import DecodeServerAdapter
+        engine = engine_factory(slice_)
+        member.latency = engine.instruments.inter_token(engine.model_label)
+        return Replica(f"{member.name}/r{slice_.index}",
+                       DecodeServerAdapter(engine), slice_)
+
+    def _decode_members(self) -> List[FleetMember]:
+        return [m for m in self._members.values() if m.kind == "decode"]
+
+    def generate(self, name: str, prompt,
+                 max_new_tokens: Optional[int] = None,
+                 priority: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 eos_token: Optional[int] = None) -> Future:
+        """Route one decode sequence: SLO admission (shed ordering over
+        inter-token p99), least-loaded replica pick, then the engine's
+        token-level batcher.  On a fatal/dispatch replica failure the
+        sequence fails over: it RESTARTS from token 0 on the next
+        replica — a decode sequence's KV pages die with its replica, so
+        restart-and-count (`decode_sequence_restarts_total` +
+        `fleet_failovers_total`) is the honest semantic, never a silent
+        resume — bounded by `FleetPolicy.max_failovers` and the
+        remaining deadline budget.  Returns a Future resolving to the
+        generated token ids."""
+        if self._closed:
+            raise RejectedError("fleet is shut down")
+        member = self.member(name)
+        if member.kind != "decode":
+            raise ValueError(
+                f"'{name}' is an output member; use submit()/output()")
+        t0 = time.monotonic()
+        prio = self.router.admission_priority(member)   # may shed
+        if priority is not None:
+            prio = int(priority)
+        dl = deadline_ms if deadline_ms is not None \
+            else member.slo.request_deadline_ms()
+        deadline_at = None if dl is None else t0 + float(dl) / 1000.0
+        member.last_used = t0
+        outer: Future = Future()
+        attempts = [0]
+
+        def remaining_ms() -> Optional[float]:
+            if deadline_at is None:
+                return None
+            return max((deadline_at - time.monotonic()) * 1000.0, 1.0)
+
+        def attempt() -> None:
+            replica = self.router.pick(member)
+            try:
+                fut = replica.server.engine.submit(
+                    prompt, max_new_tokens=max_new_tokens, priority=prio,
+                    deadline_ms=remaining_ms(), eos_token=eos_token)
+            except Exception as e:    # refused at the engine's door —
+                fail(replica, e)      # same health path as a mid-flight
+                return                # failure
+            fut.add_done_callback(lambda f: on_done(replica, f))
+
+        def fail(replica: Replica, e: BaseException) -> None:
+            from deeplearning4j_tpu.serving.resilience import \
+                classify_error
+            cls = classify_error(e)
+            if cls == "fatal":
+                replica.poison(e)
+                self._note_breaker(member)
+            elif cls == "dispatch":
+                if replica.record_failure(self.policy.unhealthy_after):
+                    self._note_breaker(member)
+            if cls in ("fatal", "dispatch") \
+                    and attempts[0] < self.policy.max_failovers:
+                attempts[0] += 1
+                self.instruments.failovers.inc()
+                replica.server.engine.instruments.record_restart(
+                    member.name)
+                try:
+                    attempt()                  # restart from token 0
+                except Exception as e2:
+                    outer.set_exception(e2)
+                return
+            outer.set_exception(e)
+
+        def on_done(replica: Replica, f: Future) -> None:
+            if f.cancelled():
+                outer.cancel()
+                return
+            e = f.exception()
+            if e is None:
+                replica.record_success()
+                outer.set_result(f.result())
+                return
+            fail(replica, e)
+
+        attempt()
+        self.instruments.routing_ms.observe(
+            (time.monotonic() - t0) * 1000.0)
+        self.instruments.requests(name).inc()
+        member.requests += 1
+        if member.requests % self.observe_every == 0 \
+                and member.latency.count:
+            self._observe_member(member)
+        return outer
 
     def roll(self, name: str, model, version: Optional[int] = None,
              **kwargs):
@@ -1030,6 +1233,10 @@ class ModelFleet:
         if self._closed:
             raise RejectedError("fleet is shut down")
         member = self.member(name)
+        if member.kind == "decode":
+            raise ValueError(
+                f"'{name}' is a decode member; use generate() — a decode "
+                "sequence is many steps, not one dispatch")
         t0 = time.monotonic()
         batch_priority = self.router.admission_priority(member)
         if priority is not None:            # explicit caller override
@@ -1258,7 +1465,7 @@ class ModelFleet:
             reasons.append("fleet is shut down")
         if not self._members:
             reasons.append("no models deployed")
-        for m in self.pool.resident():
+        for m in self.pool.resident() + self._decode_members():
             group = m.group
             for replica in (group.snapshot() if group else []):
                 r = replica.server.readyz()
@@ -1283,6 +1490,7 @@ class ModelFleet:
                 pass
         with self._admission_lock:
             replicas = [r for m in self.pool.resident()
+                        + self._decode_members()
                         if m.group is not None
                         for r in m.group.snapshot()]
             if drain:

@@ -43,6 +43,13 @@ def _register_everything(reg: MetricsRegistry):
     fed.record_replacement(True, 1.0)
     I.QuantInstruments(reg).models("int8")
     I.OpsInstruments(reg).dispatch("matmul", "pallas")
+    dec = I.DecodeInstruments(reg)
+    dec.tokens("m")
+    dec.inter_token("m")
+    dec.kv_blocks("m")
+    dec.kv_bytes("m", "int8")
+    dec.sequences_active("m")
+    dec.restarts("m")
     # forecaster gauge is minted on the first post-baseline tick
     fc = ArrivalRateForecaster(registry_=reg)
     reg.counter("fleet_requests_total", labels={"model": "m"}).inc(10)
@@ -83,7 +90,7 @@ def test_documented_series_exist():
         prefix = name.split("_")[0]
         if prefix in ("training", "pipeline", "parallel", "resilience",
                       "aot", "comms", "gang", "fleet", "fed", "quant",
-                      "ops", "chaos") and name not in families:
+                      "ops", "chaos", "decode") and name not in families:
             stale.append(name)
     assert not stale, f"docs rows reference unknown families: {sorted(set(stale))}"
 
