@@ -2978,8 +2978,383 @@ def main_decode(quick: bool):
         sys.exit(1)
 
 
+def arbiter_child(workdir: str, phase: str):
+    """`--arbiter-child` subprocess for the --arbiter chaos episode (the
+    bench twin of tests/arbiter_worker.py).
+
+    Phase ``run``: build a seeded net + CheckpointManager, a
+    LocalElasticGang over slices [0, 1], a ModelFleet sharing `workdir`,
+    and a SliceArbiter with a REAL `HandoffChaos(target="arbiter",
+    mode="kill", at_phase="shrink")` hooked in — `to_serving()` journals
+    the phase-1 intent and the chaos hook `os._exit(9)`s the process
+    with the record durable and ZERO side effects executed.
+
+    Phase ``recover``: a fresh process over the SAME journal — the
+    arbiter constructor replays the in-flight handoff (the marker keeps
+    the chaos one-shot), then writes `recover_result.json` so the parent
+    can assert single ownership and a counted replay."""
+    import os
+    import numpy as np
+    from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.serving import ModelFleet
+    from deeplearning4j_tpu.serving.slo import ArbiterPolicy
+    from deeplearning4j_tpu.train.arbiter import (LocalElasticGang,
+                                                  SliceArbiter)
+    from deeplearning4j_tpu.train.resilience import CheckpointManager
+    from deeplearning4j_tpu.train.updaters import Sgd
+    from deeplearning4j_tpu.utils.chaos import HandoffChaos
+
+    journal = os.path.join(workdir, "journal.json")
+    marker = os.path.join(workdir, "chaos_once")
+    conf = (NeuralNetConfiguration.builder().seed(11).updater(Sgd(0.1))
+            .list([DenseLayer(n_out=8, activation="tanh"),
+                   OutputLayer(n_out=2, loss="mcxent",
+                               activation="softmax")])
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    manager = CheckpointManager(os.path.join(workdir, "ckpt"),
+                                keep_last=50, save_every_steps=None)
+    rng = np.random.RandomState(3)
+    x = rng.randn(6, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+    net.fit(x, y)               # the shrink checkpoint is non-trivial
+    gang = LocalElasticGang(net, manager, slices=[0, 1])
+    fleet = ModelFleet(max_resident=1, n_slices=1,
+                       cache_dir=os.path.join(workdir, "exec-cache"),
+                       registry_=MetricsRegistry())
+    arb = SliceArbiter(journal, training=gang, fleet=fleet,
+                       policy=ArbiterPolicy(min_training_slices=1),
+                       registry_=MetricsRegistry())
+    if phase == "run":
+        arb.chaos = HandoffChaos(target="arbiter", mode="kill",
+                                 at_phase="shrink", marker=marker)
+        arb.to_serving()                # chaos kills us after phase-1
+        print("UNREACHABLE: chaos did not fire", flush=True)
+        sys.exit(3)
+    # phase == "recover": the constructor already replayed (recover=True)
+    result = {
+        "recovered": arb.recovered,
+        "describe": arb.describe(),
+        "gang_held": gang.held_slices(),
+        "ckpt_latest": manager.latest_step(),
+        "marker_exists": os.path.exists(marker),
+    }
+    with open(os.path.join(workdir, "recover_result.json"), "w") as f:
+        json.dump(result, f)
+
+
+def bench_arbiter(quick=False):
+    """`--arbiter` gate: preemption-safe train/serve slice handoffs
+    (train/arbiter.py + docs/robustness.md "Pod arbiter").
+
+    A compressed diurnal pressure trace with a 10x flash spike drives
+    `SliceArbiter.maybe_rebalance` over a 3-slice pod shared by a
+    LocalElasticGang (training a real net through the real blocking-
+    checkpoint shrink/readmit path) and a ModelFleet serving a
+    hi-priority model off the shared persistent AOT cache.  An
+    uninterrupted reference net trains on the IDENTICAL batch stream.
+
+    Gates: >= 2 full handoff cycles; zero hi-priority SLO breaches at
+    peak; per-step training loss bitwise-identical to the uninterrupted
+    run (checked at every shrink/grow boundary and every tick) and final
+    params bitwise-equal; `fresh_compiles == 0` on BOTH sides of every
+    handoff (fleet AOT cache delta == 0, the gang's jitted train step
+    never re-traces); plus one REAL mid-handoff arbiter kill in a child
+    process (`--arbiter-child`, HandoffChaos `os._exit(9)` right after
+    the phase-1 journal commit) recovered by a relaunched arbiter
+    replaying the journal with the slice single-owned."""
+    import os
+    import shutil
+    import subprocess
+    import tempfile
+    import numpy as np
+    from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+    from deeplearning4j_tpu.nn import (DenseLayer, InputType,
+                                       MultiLayerNetwork,
+                                       NeuralNetConfiguration, OutputLayer)
+    from deeplearning4j_tpu.serving import LatencySLO, ModelFleet
+    from deeplearning4j_tpu.serving.slo import ArbiterPolicy
+    from deeplearning4j_tpu.train.arbiter import (LocalElasticGang,
+                                                  SliceArbiter)
+    from deeplearning4j_tpu.train.resilience import CheckpointManager
+    from deeplearning4j_tpu.train.updaters import Sgd
+
+    n_in = 12
+    hi_slo_ms = 1500.0
+    base_p, peak_p = 0.3, 3.0           # 10x flash spike
+    cycles = 2 if quick else 3
+    base_len, spike_len = (3, 4) if quick else (5, 6)
+    burst = 4 if quick else 8           # hi requests per peak tick
+
+    def make_net(seed):
+        conf = (NeuralNetConfiguration.builder().seed(seed)
+                .updater(Sgd(0.05))
+                .list([DenseLayer(n_out=24, activation="relu"),
+                       OutputLayer(n_out=3, loss="mcxent",
+                                   activation="softmax")])
+                .set_input_type(InputType.feed_forward(n_in)).build())
+        return MultiLayerNetwork(conf).init()
+
+    # diurnal trace: lull -> flash spike -> lull, repeated
+    trace = []
+    for _ in range(cycles):
+        trace += [base_p] * base_len + [peak_p] * spike_len
+    trace += [base_p] * (base_len + 1)  # final lull reclaims the slice
+
+    work_dir = tempfile.mkdtemp(prefix="bench-arbiter-")
+    try:
+        journal = os.path.join(work_dir, "journal.json")
+        # the arbitrated net and the uninterrupted reference: same seed,
+        # same batch stream — the handoffs are the ONLY difference
+        net, ref = make_net(21), make_net(21)
+        rng = np.random.RandomState(5)
+        batches = []
+        for _ in range(len(trace)):
+            x = rng.randn(16, n_in).astype(np.float32)
+            y = np.eye(3, dtype=np.float32)[
+                (np.abs(x[:, 0]) * 2.9).astype(int) % 3]
+            batches.append((x, y))
+
+        manager = CheckpointManager(os.path.join(work_dir, "ckpt"),
+                                    keep_last=100, save_every_steps=None)
+        gang = LocalElasticGang(net, manager, slices=[0, 1, 2])
+        fleet = ModelFleet(max_resident=2, n_slices=1, max_batch=8,
+                           batch_timeout_ms=1.0,
+                           cache_dir=os.path.join(work_dir, "exec-cache"),
+                           registry_=MetricsRegistry())
+        fleet.deploy("hi", make_net(1001),
+                     slo=LatencySLO(target_p99_ms=hi_slo_ms, priority=10),
+                     warm=True)
+        policy = ArbiterPolicy(grant_at_forecast=1.5,
+                               return_below_forecast=0.5,
+                               min_training_slices=1, max_fleet_leases=1,
+                               drain_timeout_s=2.0, cooldown_s=0.0)
+        arb = SliceArbiter(journal, training=gang, fleet=fleet,
+                           policy=policy, registry_=MetricsRegistry())
+        fleet.attach_arbiter(arb)
+
+        # pre-warm the request shape so peak traffic (and the leased
+        # slice's replicas) runs entirely off the warm AOT cache
+        req_x = np.random.RandomState(9).rand(4, n_in).astype(np.float32)
+        for _ in range(2):
+            fleet.output("hi", req_x, deadline_ms=60_000.0, timeout=120)
+
+        # first step pays the one train-step trace+compile on each net;
+        # from here both jit caches must be frozen across every handoff
+        net.fit(*batches[0])
+        ref.fit(*batches[0])
+        step_fn = net._get_train_step()
+        train_cache0 = step_fn._cache_size()
+
+        boundaries = []
+        loss_mismatch_ticks = []
+        hi_lat_ms, hi_breaches, hi_requests = [], 0, 0
+        to_serving = to_training = 0
+        for t, p in enumerate(trace):
+            if t > 0:                   # tick 0 trained above (warmup)
+                net.fit(*batches[t])
+                ref.fit(*batches[t])
+            loss_n, loss_r = net.score(), ref.score()
+            if loss_n != loss_r:        # bitwise: exact float equality
+                loss_mismatch_ticks.append(t)
+            cache_before = fleet.cache.stats["compiles"]
+            rec = arb.maybe_rebalance(pressure=p)
+            if rec is not None:
+                serving_fresh = (fleet.cache.stats["compiles"]
+                                 - cache_before)
+                cur_step = net._get_train_step()
+                train_fresh = (cur_step._cache_size() - train_cache0
+                               if cur_step is step_fn else -1)
+                if rec["direction"] == "to_serving":
+                    to_serving += 1
+                else:
+                    to_training += 1
+                boundaries.append({
+                    "tick": t, "direction": rec["direction"],
+                    "slice": rec["slice"],
+                    "loss": loss_n, "ref_loss": loss_r,
+                    "bitwise": loss_n == loss_r,
+                    "serving_fresh_compiles": serving_fresh,
+                    "training_fresh_compiles": train_fresh,
+                    "gang_world": gang.world,
+                    "gang_generation": gang.generation,
+                })
+            if p >= policy.grant_at_forecast:       # peak: hi flood
+                for _ in range(burst):
+                    hi_requests += 1
+                    t0 = time.perf_counter()
+                    try:
+                        fleet.output("hi", req_x, deadline_ms=60_000.0,
+                                     timeout=120)
+                        lat = (time.perf_counter() - t0) * 1000.0
+                        hi_lat_ms.append(lat)
+                        if lat > hi_slo_ms:
+                            hi_breaches += 1
+                    except Exception:
+                        hi_breaches += 1
+
+        hi_member = fleet.member("hi")
+        hi_p99 = hi_member.latency.percentiles((99,))["p99"]
+        tracker_breaches = hi_member.tracker.breaches_total
+        import jax
+        params_equal = all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree_util.tree_leaves(net.params_),
+                            jax.tree_util.tree_leaves(ref.params_)))
+        end_step = net._get_train_step()
+        train_fresh_total = (end_step._cache_size() - train_cache0
+                             if end_step is step_fn else -1)
+        final = arb.describe()
+        fleet.shutdown()
+
+        # ---- chaos episode: REAL kill between journal phases ----
+        chaos_dir = os.path.join(work_dir, "chaos")
+        os.makedirs(chaos_dir, exist_ok=True)
+        here = os.path.dirname(os.path.abspath(__file__))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+
+        def child(phase):
+            return subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--arbiter-child", chaos_dir, phase],
+                cwd=here, env=env, capture_output=True, text=True,
+                timeout=300)
+
+        run = child("run")
+        with open(os.path.join(chaos_dir, "journal.json")) as f:
+            killed_state = json.load(f)["state"]
+        recover = child("recover")
+        rec_result = {}
+        rec_path = os.path.join(chaos_dir, "recover_result.json")
+        if os.path.exists(rec_path):
+            with open(rec_path) as f:
+                rec_result = json.load(f)
+        recovered = rec_result.get("recovered") or {}
+        chaos = {
+            "run_rc": run.returncode,                       # want 9
+            "journal_phase_after_kill":
+                (killed_state.get("handoff") or {}).get("phase"),
+            "lease_after_kill":
+                killed_state.get("leases", {}).get("1"),
+            "recover_rc": recover.returncode,
+            "outcome": recovered.get("outcome"),
+            "replays": (rec_result.get("describe") or {}).get("replays"),
+            "single_owned": (
+                (rec_result.get("describe") or {}).get("leases", {})
+                .get("1") == "serving"
+                and 1 not in (rec_result.get("gang_held") or [1])),
+            "marker_exists": rec_result.get("marker_exists"),
+            "stderr_tail": (run.stderr or "")[-300:]
+            if run.returncode != 9 else "",
+        }
+        return {
+            "ticks": len(trace),
+            "base_pressure": base_p,
+            "peak_pressure": peak_p,
+            "spike_ratio": peak_p / base_p,
+            "to_serving_handoffs": to_serving,
+            "to_training_handoffs": to_training,
+            "handoff_cycles": min(to_serving, to_training),
+            "boundaries": boundaries,
+            "loss_mismatch_ticks": loss_mismatch_ticks,
+            "final_params_bitwise_equal": bool(params_equal),
+            "hi_requests_at_peak": hi_requests,
+            "hi_breaches_at_peak": hi_breaches,
+            "hi_p99_ms": hi_p99,
+            "hi_slo_ms": hi_slo_ms,
+            "hi_tracker_breaches": tracker_breaches,
+            "serving_fresh_compiles_total": sum(
+                b["serving_fresh_compiles"] for b in boundaries),
+            "training_fresh_compiles_total": train_fresh_total,
+            "gang_generation": gang.generation,
+            "journal_replays": final["replays"],
+            "journal_commits": final["journal_commits"],
+            "final_leases": {str(k): v
+                             for k, v in final["leases"].items()},
+            "chaos": chaos,
+        }
+    finally:
+        shutil.rmtree(work_dir, ignore_errors=True)
+
+
+def main_arbiter(quick: bool):
+    """`--arbiter` mode: trace detail to stderr + BENCH_arbiter.json,
+    ONE stdout JSON line.  Gates (exit 1 on any failure): >= 2 handoff
+    cycles under the diurnal 10x-spike trace, zero hi-priority SLO
+    breaches at peak, bitwise training-loss parity with the
+    uninterrupted run at every boundary, fresh_compiles == 0 on both
+    sides of every handoff, and the injected mid-handoff kill recovered
+    by journal replay with the slice single-owned."""
+    import os
+    if not os.environ.get("JAX_PLATFORMS"):
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from __graft_entry__ import _probe_backend_device_count
+        if _probe_backend_device_count() < 1:
+            print("[bench] TPU backend unreachable; arbiter bench on CPU",
+                  file=sys.stderr, flush=True)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = bench_arbiter(quick)
+    except Exception as e:
+        print(json.dumps({"metric": "arbiter_handoff_cycles",
+                          "value": None, "unit": "cycles",
+                          "error": repr(e)[:300]}))
+        sys.exit(1)
+    for k, v in r.items():      # detail to stderr: stdout stays one line
+        print(f"[arbiter] {k} = {v}", file=sys.stderr, flush=True)
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_arbiter.json"), "w") as f:
+        json.dump(r, f, indent=2)
+    c = r["chaos"]
+    gates = {
+        "cycles": r["handoff_cycles"] >= 2,
+        "slo_at_peak": (r["hi_requests_at_peak"] > 0
+                        and r["hi_breaches_at_peak"] == 0
+                        and r["hi_tracker_breaches"] == 0),
+        "bitwise": (not r["loss_mismatch_ticks"]
+                    and all(b["bitwise"] for b in r["boundaries"])
+                    and r["final_params_bitwise_equal"]),
+        "zero_recompile": (r["serving_fresh_compiles_total"] == 0
+                           and r["training_fresh_compiles_total"] == 0),
+        "chaos_replay": (c["run_rc"] == 9
+                         and c["journal_phase_after_kill"] == "shrink"
+                         and c["lease_after_kill"] == "transit"
+                         and c["recover_rc"] == 0
+                         and c["outcome"] == "replayed"
+                         and c["replays"] == 1
+                         and bool(c["single_owned"])),
+    }
+    print(json.dumps({
+        "metric": "arbiter_handoff_cycles",
+        "value": r["handoff_cycles"],
+        "unit": "cycles",
+        "threshold": 2,
+        "hi_breaches_at_peak": r["hi_breaches_at_peak"],
+        "hi_p99_ms": round(r["hi_p99_ms"], 2),
+        "fresh_compiles": (r["serving_fresh_compiles_total"]
+                           + max(r["training_fresh_compiles_total"], 0)),
+        "journal_replays_after_kill": c["replays"],
+        "gates": gates,
+        "pass": all(gates.values()),
+    }))
+    if not all(gates.values()):
+        sys.exit(1)
+
+
 def main():
     quick = "--quick" in sys.argv
+    if "--arbiter-child" in sys.argv:
+        i = sys.argv.index("--arbiter-child")
+        arbiter_child(sys.argv[i + 1], sys.argv[i + 2])
+        return
+    if "--arbiter" in sys.argv:
+        main_arbiter(quick)
+        return
     if "--aot-child" in sys.argv:
         i = sys.argv.index("--aot-child")
         aot_child(sys.argv[i + 1], int(sys.argv[i + 2]),

@@ -914,9 +914,85 @@ class OpsInstruments:
         self.tile_cache_hits.inc()
 
 
+class ArbiterInstruments:
+    """Pod-arbiter handles (train.arbiter SliceArbiter) — slice
+    movement between the elastic training gang and the serving fleet.
+    Labeled children (direction/outcome/owner) are created lazily and
+    memoized, matching the fleet bundle's pattern."""
+
+    def __init__(self, registry_: Optional[MetricsRegistry] = None):
+        reg = registry_ if registry_ is not None else registry()
+        self._reg = reg
+        self.handoff_ms = reg.histogram(
+            "arbiter_handoff_ms",
+            help="wall time of one committed slice handoff, journal "
+            "phase-1 write to commit (shrink/drain + lease/readmit "
+            "inclusive)")
+        self.journal_replays = reg.counter(
+            "arbiter_journal_replays_total",
+            help="in-flight handoffs resumed from the crc-guarded "
+            "journal after an arbiter restart (crash recovery, not the "
+            "happy path)")
+        self.leases = reg.gauge(
+            "arbiter_leases",
+            help="slices currently leased to the serving fleet (owner="
+            "serving rows of the lease table)")
+        self._handoffs: dict = {}
+        self._slices: dict = {}
+
+    def handoffs(self, direction: str, outcome: str):
+        key = (direction, outcome)
+        c = self._handoffs.get(key)
+        if c is None:
+            c = self._reg.counter(
+                "arbiter_handoffs_total",
+                help="slice handoffs by direction "
+                "(to_serving|to_training) and outcome "
+                "(committed|replayed|aborted)",
+                labels={"direction": direction, "outcome": outcome})
+            self._handoffs[key] = c
+        return c
+
+    def slices(self, owner: str):
+        g = self._slices.get(owner)
+        if g is None:
+            g = self._reg.gauge(
+                "arbiter_slices",
+                help="pod slices by current lease-table owner "
+                "(training|serving|transit)",
+                labels={"owner": owner})
+            self._slices[owner] = g
+        return g
+
+    def record_handoff(self, direction: str, outcome: str,
+                       ms: Optional[float] = None) -> None:
+        if not enabled():
+            return
+        self.handoffs(direction, outcome).inc()
+        if ms is not None:
+            self.handoff_ms.observe(float(ms))
+
+    def record_owners(self, counts: dict) -> None:
+        """Export the lease table: {owner: n_slices}."""
+        if not enabled():
+            return
+        for owner in ("training", "serving", "transit"):
+            self.slices(owner).set(int(counts.get(owner, 0)))
+        self.leases.set(int(counts.get("serving", 0)))
+
+
 _quant: Optional[QuantInstruments] = None
 _ops: Optional[OpsInstruments] = None
 _decode: Optional[DecodeInstruments] = None
+_arbiter: Optional[ArbiterInstruments] = None
+
+
+def arbiter_instruments() -> ArbiterInstruments:
+    """Process-wide pod-arbiter handle bundle (lazy singleton)."""
+    global _arbiter
+    if _arbiter is None:
+        _arbiter = ArbiterInstruments()
+    return _arbiter
 
 
 def decode_instruments() -> DecodeInstruments:

@@ -317,6 +317,16 @@ class HierarchicalAllReduce:
             return None
         return self._mesh.admit_joiners(resume_step)
 
+    def request_evict(self, rank: int, resume_step=None,
+                      cause: str = "shrink"):
+        """Coordinated shrink: evict `rank` at an agreed resume step (the
+        pod arbiter's scale-to-serving path).  Returns the reform info
+        dict or None when not elastic."""
+        if self._mesh is None or not hasattr(self._mesh, "request_evict"):
+            return None
+        return self._mesh.request_evict(rank, resume_step=resume_step,
+                                        cause=cause)
+
     def stats(self) -> dict:
         """Last-exchange numbers (what BENCH_comms.json aggregates)."""
         mesh = self._mesh

@@ -1004,6 +1004,23 @@ class ElasticGradientMesh:
                                     self.world)
         return info
 
+    def request_evict(self, rank: int, resume_step: Optional[int] = None,
+                      cause: str = "shrink") -> Dict[str, Any]:
+        """Externally-initiated shrink (the pod arbiter reclaiming a
+        slice): evict `rank` exactly as if it had crashed, but at a
+        COORDINATED resume step — the caller checkpoints at that step
+        first, so the evicted worker's slice can be handed off while the
+        survivors bitwise-resume.  The evicted peer receives the same
+        eviction-notice REFORM frame a partitioned straggler would
+        (-> GangEvictedError -> park/rejoin); the coordinator's own loop
+        sees GangReformed on its next collective.  Coordinator only."""
+        if self.rank != 0:
+            raise RuntimeError("request_evict is coordinator-only")
+        if rank == 0:
+            raise ValueError("cannot evict the coordinator (rank 0)")
+        return self._reform(lost={rank}, cause=cause,
+                            resume_step=resume_step)
+
     # ------------------------------------------------------------------
     # allgather
     # ------------------------------------------------------------------

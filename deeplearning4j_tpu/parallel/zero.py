@@ -309,6 +309,23 @@ def reshard_zero1(model, new_mesh: Mesh, axis: str = "data",
     return zt
 
 
+def reshard_to_devices(model, devices, axis: str = "data",
+                       rules: Optional[ShardingRules] = None
+                       ) -> Optional[Zero1Transform]:
+    """Externally-initiated world change (the pod arbiter handing a
+    DeviceSlice to or from serving): re-shard the model's ZeRO-1 state
+    to a fresh data-axis mesh over exactly `devices` — the surviving
+    world after a shrink, or the grown world after a slice returns.
+    Returns the new transform, or None (no-op) when ZeRO-1 was never
+    enabled — a plain data-parallel model carries no sharded moments to
+    move."""
+    if getattr(model, "_step_transform", None) is None:
+        return None
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh({axis: len(devices)}, devices=list(devices))
+    return reshard_zero1(model, mesh, axis=axis, rules=rules)
+
+
 def opt_state_bytes_per_replica(opt_state: PyTree) -> int:
     """Optimizer-state bytes resident on ONE device: replicated leaves
     count in full, leaves sharded N ways count 1/N — the quantity the

@@ -90,6 +90,7 @@ class DeviceSlice:
                  devices: Optional[Tuple[Any, ...]] = None):
         self.index = int(index)
         self.devices = tuple(devices) if devices else None
+        self.lease_tag: Optional[str] = None   # set on arbiter-leased slices
         self._mesh = None
 
     @property
@@ -408,7 +409,7 @@ class WarmPool:
                 return
             need = member.replicas_target
             while (len(self._resident) >= self.max_resident
-                   or len(fleet._free_slices) < need):
+                   or len(fleet._available_slices()) < need):
                 victim = self._lru_victim(member)
                 if victim is None:
                     raise RejectedError(
@@ -711,7 +712,10 @@ class FleetController:
                                  actions: List[Dict[str, Any]]
                                  ) -> Optional[DeviceSlice]:
         fleet = self.fleet
-        if fleet._free_slices:
+        # arbiter-blocked slices are invisible here: a slice journaled
+        # for return to training must not be grabbed by a growth action
+        # racing the handoff
+        if fleet._available_slices():
             return fleet._take_slice(needy.preferred_slices)
         donors = [m for m in resident
                   if m is not needy and len(m.group.replicas) > 1
@@ -723,7 +727,7 @@ class FleetController:
         donor = min(donors, key=lambda m: m.last_used)
         self._remove_replica(donor, actions, why="reclaimed")
         return fleet._take_slice(needy.preferred_slices) \
-            if fleet._free_slices else None
+            if fleet._available_slices() else None
 
     def _remove_replica(self, member: FleetMember,
                         actions: List[Dict[str, Any]], why: str) -> None:
@@ -805,6 +809,7 @@ class ModelFleet:
         self._members: Dict[str, FleetMember] = {}
         self._decode_factories: Dict[str, Any] = {}   # respawn recipes
         self._admission_lock = threading.RLock()
+        self.arbiter = None                  # pod SliceArbiter, when attached
         self._slices, self._free_slices = self._build_slices(
             devices, slice_size, n_slices, max_resident)
         self._closed = False
@@ -842,24 +847,126 @@ class ModelFleet:
             slices = [DeviceSlice(i) for i in range(max(int(n), 1))]
         return slices, [s.index for s in slices]
 
+    def _blocked_slices(self) -> frozenset:
+        """Fleet-slice indexes the attached pod arbiter has journaled for
+        return to training.  Placement must never pick one: the handoff
+        journal is the lease table of record, and a slice it says is in
+        transit back to the gang already belongs to training even while
+        it still sits in our free list."""
+        if self.arbiter is None:
+            return frozenset()
+        try:
+            return frozenset(self.arbiter.blocked_fleet_slices())
+        except Exception:           # a sick arbiter must not down serving
+            return frozenset()
+
+    def _available_slices(self) -> List[int]:
+        blocked = self._blocked_slices()
+        return [i for i in self._free_slices if i not in blocked]
+
     def _take_slice(self, preferred: Optional[List[int]] = None
                     ) -> DeviceSlice:
         """Caller holds the admission lock.  Prefer a member's previous
         slices: on device-pinned fleets the persistent-cache key includes
         the mesh fingerprint, so re-admission onto the same slice is the
-        zero-recompile path."""
+        zero-recompile path.  Slices the arbiter has journaled for return
+        to training are never picked (see `_blocked_slices`)."""
+        avail = self._available_slices()
         for idx in preferred or ():
-            if idx in self._free_slices:
+            if idx in avail:
                 self._free_slices.remove(idx)
                 return self._slices[idx]
-        if not self._free_slices:
+        if not avail:
             raise RejectedError("no free device slice")
-        return self._slices[self._free_slices.pop(0)]
+        self._free_slices.remove(avail[0])
+        return self._slices[avail[0]]
 
     def _return_slice(self, slice_: DeviceSlice) -> None:
         if slice_.index not in self._free_slices:
             self._free_slices.append(slice_.index)
             self._free_slices.sort()
+
+    # ---- pod-arbiter slice leasing (train/arbiter.py) ----
+    def attach_arbiter(self, arbiter) -> "ModelFleet":
+        """Attach the pod `SliceArbiter`: reconcile/placement will
+        consult its lease table before taking a free slice."""
+        self.arbiter = arbiter
+        return self
+
+    def _replicas_on(self, slice_: DeviceSlice
+                     ) -> List[Tuple[FleetMember, Replica]]:
+        """Caller holds the admission lock."""
+        out: List[Tuple[FleetMember, Replica]] = []
+        for m in self.pool.resident() + self._decode_members():
+            if m.group is None:
+                continue
+            out.extend((m, r) for r in m.group.snapshot()
+                       if r.slice is slice_)
+        return out
+
+    def lease_slice(self, devices: Optional[List[Any]] = None,
+                    tag: Optional[str] = None) -> int:
+        """Admit one slice leased from the pod arbiter into the
+        inventory + free list; returns its fleet-local index.  Idempotent
+        by `tag`: journal replay may re-grant a slice the crashed run
+        already admitted — the existing lease is reused, re-freed only if
+        nothing is placed on it."""
+        with self._admission_lock:
+            if tag is not None:
+                for s in self._slices:
+                    if s.lease_tag == tag:
+                        if s.index not in self._free_slices \
+                                and not self._replicas_on(s):
+                            self._return_slice(s)
+                        return s.index
+            idx = len(self._slices)
+            s = DeviceSlice(idx, tuple(devices) if devices else None)
+            s.lease_tag = tag
+            self._slices.append(s)
+            self._free_slices.append(idx)
+            self._free_slices.sort()
+            return idx
+
+    def release_slice(self, index: int,
+                      timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Retire one slice (the arbiter reclaiming it for training):
+        remove each replica on it from routing FIRST, concurrent drain
+        under `drain_timeout_s` (a hung replica expires, is force-shut,
+        and the slice is released anyway — a hang cannot pin a slice),
+        evict the member entirely when the leaving replica was its only
+        one, then pull the slice from the free list so nothing places
+        onto it again.  Idempotent: releasing an unknown or
+        already-retired slice is a no-op."""
+        timeout = self.policy.drain_timeout_s if timeout is None \
+            else float(timeout)
+        out: Dict[str, Any] = {"slice": index, "drained": [],
+                               "evicted": [], "drain_expired": []}
+        with self._admission_lock:
+            if not (0 <= index < len(self._slices)):
+                return out
+            slice_ = self._slices[index]
+            for m, r in self._replicas_on(slice_):
+                group = m.group
+                if group is not None and len(group.replicas) > 1:
+                    group.replicas.remove(r)         # routing-first
+                    expired = drain_replicas(
+                        [r], timeout=timeout,
+                        counter=self.instruments.drain_timeouts)
+                    if expired:
+                        out["drain_expired"].extend(expired)
+                        try:                         # hung: force-shut
+                            r.server.shutdown(drain=False, timeout=0.5)
+                        except Exception:
+                            pass
+                    r.server.cache.invalidate()
+                    self._return_slice(r.slice)
+                    out["drained"].append(r.name)
+                else:
+                    self.pool.evict(m, reason="arbiter")
+                    out["evicted"].append(m.name)
+            if index in self._free_slices:
+                self._free_slices.remove(index)
+        return out
 
     # ---- deployment ----
     def members(self) -> List[FleetMember]:

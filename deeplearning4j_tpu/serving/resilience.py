@@ -208,15 +208,29 @@ class CircuitBreaker:
 # ---------------------------------------------------------------------------
 
 
+def _at_rest(server) -> bool:
+    """True when the server's worker thread actually stopped.  A drain
+    only counts as complete on this condition: `shutdown` returns after
+    its internal join times out even when the worker is wedged inside a
+    compiled dispatch (the in-flight batch is no longer in the pending
+    queue the drain wait watches), and THAT replica must be reported
+    expired, not merely slow — its shutdown call and the shared drain
+    deadline otherwise finish within microseconds of each other and the
+    classification becomes a coin flip."""
+    worker = getattr(getattr(server, "batcher", server), "_worker", None)
+    return worker is None or not worker.is_alive()
+
+
 def drain_replicas(replicas, timeout: float = 10.0,
                    counter=None) -> List[str]:
     """Drain many replica servers concurrently under ONE shared deadline
     (the serial form let a single hung replica burn the whole budget
     before the next was even tried).  Returns the names of replicas whose
-    drain did NOT finish inside the deadline; each expiry increments
-    `counter` (`serving_drain_timeouts_total`) when one is given.  An
-    expired drain keeps running on its daemon thread — its leftover
-    futures still fail over; we just stop waiting for it."""
+    drain did NOT finish inside the deadline — shutdown still running OR
+    the worker thread still wedged (see `_at_rest`); each expiry
+    increments `counter` (`serving_drain_timeouts_total`) when one is
+    given.  An expired drain keeps running on its daemon thread — its
+    leftover futures still fail over; we just stop waiting for it."""
     replicas = list(replicas)
     if not replicas:
         return []
@@ -232,7 +246,7 @@ def drain_replicas(replicas, timeout: float = 10.0,
     expired = []
     for r, t in zip(replicas, threads):
         t.join(timeout=max(deadline - time.monotonic(), 0.0))
-        if t.is_alive():
+        if t.is_alive() or not _at_rest(r.server):
             expired.append(r.name)
             if counter is not None:
                 counter.inc()

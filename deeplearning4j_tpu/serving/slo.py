@@ -195,6 +195,58 @@ class FederationPolicy:
                 "ladder_down_after/ladder_up_after must be >= 1")
 
 
+@dataclasses.dataclass(frozen=True)
+class ArbiterPolicy:
+    """Pod-arbiter knobs (train/arbiter.py) — when DeviceSlices move
+    between the elastic training gang and the serving fleet.
+
+    Pressure (scale-to-serving): a handoff to serving triggers when
+    `fleet_arrival_forecast{model=}` (or an explicit pressure signal)
+    exceeds `grant_at_forecast` x the fleet's current capacity estimate,
+    and reverses when it falls below `return_below_forecast` x — the gap
+    between the two is the hysteresis band that stops a flapping slice.
+    `min_training_slices` — the gang never shrinks below this many
+    slices (the coordinator's slice is never handed off).
+    `max_fleet_leases` — at most this many slices leased to serving at
+    once (0 = unlimited).
+    `drain_timeout_s` — shared deadline for draining a fleet replica off
+    a reclaimed slice (expiries force-shutdown and still release — a
+    hung replica cannot pin a slice).
+    `shrink_request_timeout_s` — how long the arbiter waits for the gang
+    to acknowledge a shrink request before the handoff is abandoned and
+    rolled back in the journal.
+    `cooldown_s` — minimum wall-clock between committed handoffs in
+    either direction (damps forecast noise the hysteresis band misses).
+    """
+
+    grant_at_forecast: float = 1.5
+    return_below_forecast: float = 0.5
+    min_training_slices: int = 1
+    max_fleet_leases: int = 0
+    drain_timeout_s: float = 5.0
+    shrink_request_timeout_s: float = 30.0
+    cooldown_s: float = 0.0
+
+    def __post_init__(self):
+        if self.grant_at_forecast <= 0:
+            raise ValueError("grant_at_forecast must be > 0")
+        if not (0 <= self.return_below_forecast < self.grant_at_forecast):
+            raise ValueError(
+                "return_below_forecast must be >= 0 and below "
+                "grant_at_forecast (the hysteresis band)")
+        if self.min_training_slices < 1:
+            raise ValueError("min_training_slices must be >= 1 (the "
+                             "coordinator's slice is never handed off)")
+        if self.max_fleet_leases < 0:
+            raise ValueError("max_fleet_leases must be >= 0")
+        if self.drain_timeout_s <= 0:
+            raise ValueError("drain_timeout_s must be > 0")
+        if self.shrink_request_timeout_s <= 0:
+            raise ValueError("shrink_request_timeout_s must be > 0")
+        if self.cooldown_s < 0:
+            raise ValueError("cooldown_s must be >= 0")
+
+
 class SLOTracker:
     """Sustained-breach state machine over windowed p99 observations.
 

@@ -35,6 +35,7 @@ copies and the data order is the iterator's own determinism.
 from __future__ import annotations
 
 import base64
+import json
 import os
 import shutil
 import signal
@@ -90,7 +91,27 @@ def _model_arrays(model) -> Dict[str, Any]:
             "rng": getattr(model, "_rng", None)}
 
 
+def _uncommit_local(tree):
+    """The loader's `make_array_from_callback` commits its output to
+    explicit devices, but live training state is uncommitted (jit places
+    it) — and committed-ness is part of the jit cache key, so assigning
+    committed leaves makes the first post-restore step silently
+    retrace+recompile the train step.  Shed the commitment on
+    single-device leaves by a host round-trip; mesh-sharded leaves keep
+    their placement (that layout is the point of the resharding
+    loader)."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(leaf):
+        if isinstance(leaf, jax.Array) and len(leaf.devices()) == 1:
+            return jnp.asarray(np.asarray(leaf))
+        return leaf
+    return jax.tree_util.tree_map(one, tree)
+
+
 def _assign_model_arrays(model, tree: Dict[str, Any]) -> None:
+    tree = _uncommit_local(tree)
     attr = "variables_" if hasattr(model, "variables_") else "params_"
     setattr(model, attr, tree["params"])
     if tree.get("state") is not None:
@@ -98,11 +119,7 @@ def _assign_model_arrays(model, tree: Dict[str, Any]) -> None:
     if tree.get("opt") is not None:
         model.opt_state_ = tree["opt"]
     if tree.get("rng") is not None:
-        # the resharding loader commits its output to explicit devices; the
-        # live RNG key must stay UNcommitted (jit moves it next to the
-        # params, which may be mesh-sharded under ParallelWrapper)
-        import jax.numpy as jnp
-        model._rng = jnp.asarray(np.asarray(tree["rng"]))
+        model._rng = tree["rng"]
 
 
 def _host_snapshot(tree):
@@ -806,17 +823,30 @@ class ElasticTrainer(FaultTolerantTrainer):
     (`save_every_steps` set); peers pass a manager on the same shared
     directory with ``save_every_steps=None`` and ``save_initial=False``
     so they restore from it but never race rank 0's writes.
+
+    `control_dir` opts into externally-requested shrinks (the pod
+    arbiter's scale-to-serving path, train/arbiter.py): the coordinator
+    polls the directory each step for a ``shrink-request.json``; on one,
+    it commits a blocking checkpoint, evicts the requested rank at that
+    coordinated resume step (`request_evict` — the victim raises
+    ``GangEvictedError`` and parks; survivors catch ``GangReformed`` and
+    bitwise-rewind), and atomically writes ``shrink-ack.json`` carrying
+    the resume step and new generation for the arbiter's journal.
     """
+
+    SHRINK_REQUEST = "shrink-request.json"
+    SHRINK_ACK = "shrink-ack.json"
 
     def __init__(self, model, manager: Optional[CheckpointManager] = None,
                  *, policy: str = "shrink", rejoin_wait_s: float = 30.0,
-                 **kwargs):
+                 control_dir: Optional[str] = None, **kwargs):
         super().__init__(model, manager, **kwargs)
         if policy not in ("shrink", "block"):
             raise ValueError(
                 f"policy must be 'shrink' or 'block', got {policy!r}")
         self.policy = policy
         self.rejoin_wait_s = float(rejoin_wait_s)
+        self.control_dir = control_dir
         self.reformations: List[Dict[str, Any]] = []
         from deeplearning4j_tpu.monitor.instrument import gang_instruments
         self._gang = gang_instruments()
@@ -877,6 +907,57 @@ class ElasticTrainer(FaultTolerantTrainer):
             self.model.set_normalizer(self.normalizer)
         self.batch_in_epoch = int(meta.get("batch_in_epoch", 0))
         return self.batch_in_epoch
+
+    # ---- externally-requested shrink (pod arbiter) ----
+    def _step_end(self) -> None:
+        self._poll_shrink_request()
+        super()._step_end()
+
+    def _poll_shrink_request(self) -> None:
+        """Coordinator-side: honor a pending `shrink-request.json` from
+        the control dir.  Ordering is the safety argument: the blocking
+        checkpoint commits BEFORE the eviction, so whatever happens next
+        (victim already dead, arbiter crash, coordinator's own
+        GangReformed) training rewinds to an intact coordinated step."""
+        if self.control_dir is None or self.manager is None:
+            return
+        sharing = self._sharing()
+        if sharing is None or sharing.rank != 0 \
+                or not hasattr(sharing, "request_evict"):
+            return
+        req_path = os.path.join(self.control_dir, self.SHRINK_REQUEST)
+        if not os.path.exists(req_path):
+            return
+        try:
+            with open(req_path) as f:
+                req = json.load(f)
+        except (OSError, ValueError):
+            return                  # mid-write; picked up next step
+        victim = int(req.get("rank", sharing.world - 1))
+        if not (0 < victim < sharing.world):
+            ack = {"request_id": req.get("id"), "error":
+                   f"rank {victim} not evictable (world {sharing.world})"}
+        else:
+            self.manager.save(
+                self.model, metadata=self._save_meta(self.batch_in_epoch),
+                block=True, **self._checkpoint_kwargs())
+            step = int(self.manager.latest_step() or 0)
+            info = sharing.request_evict(victim, resume_step=step,
+                                         cause="shrink") or {}
+            ack = {"request_id": req.get("id"), "resume_step": step,
+                   "generation": info.get("generation"),
+                   "world": info.get("world"), "rank": victim}
+        ack_path = os.path.join(self.control_dir, self.SHRINK_ACK)
+        tmp = ack_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(ack, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, ack_path)
+        try:
+            os.remove(req_path)
+        except OSError:
+            pass
 
     # ---- joiner admission (shrink policy: epoch boundary) ----
     def _epoch_boundary(self) -> None:

@@ -144,11 +144,22 @@ class ConjugateGradient:
         vg, flat, unflatten = _flat_loss_fn(model, x, y)
         loss, grad = vg(flat)
         direction = -grad
+        prev_step = 1e-1
         for _ in range(self.max_iterations):
+            # warm-start the search from the last accepted step: Armijo
+            # backtracking only ever shrinks, so a cold 1e-1 restart caps
+            # progress at 0.1*|d| per iteration and the solver stalls
             step, new_flat, new_loss, new_grad = backtrack_line_search(
-                vg, flat, loss, grad, direction, initial_step=1e-1)
+                vg, flat, loss, grad, direction,
+                initial_step=min(prev_step * 2.0, 1e3))
             if step == 0.0:
-                break
+                # stale conjugate direction — restart with steepest descent
+                step, new_flat, new_loss, new_grad = backtrack_line_search(
+                    vg, flat, loss, grad, -grad, initial_step=1e-1)
+                if step == 0.0:
+                    break
+                direction = -grad
+            prev_step = step
             beta = jnp.maximum(
                 0.0, jnp.vdot(new_grad, new_grad - grad)
                 / jnp.maximum(jnp.vdot(grad, grad), 1e-20))   # PR+
@@ -172,11 +183,15 @@ class LineGradientDescent:
     def optimize(self, model, x, y) -> float:
         vg, flat, unflatten = _flat_loss_fn(model, x, y)
         loss, grad = vg(flat)
+        prev_step = 1e-1
         for _ in range(self.max_iterations):
+            # warm-start from the last accepted step (see ConjugateGradient)
             step, new_flat, new_loss, new_grad = backtrack_line_search(
-                vg, flat, loss, grad, -grad, initial_step=1e-1)
+                vg, flat, loss, grad, -grad,
+                initial_step=min(prev_step * 2.0, 1e3))
             if step == 0.0:
                 break
+            prev_step = step
             improved = float(loss) - float(new_loss)
             flat, loss, grad = new_flat, new_loss, new_grad
             if improved < self.tolerance:

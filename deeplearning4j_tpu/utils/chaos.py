@@ -23,6 +23,7 @@ running game days) compose it in explicitly.
 """
 from __future__ import annotations
 
+import json
 import os
 import signal
 import time
@@ -484,3 +485,134 @@ class HostChaos:
             if self.mode == "kill":     # a dead host serves nothing
                 return None
         return self._orig(gen, msg, raw)
+
+
+class HandoffChaos:
+    """Injects ONE fault into a pod-arbiter slice handoff, at the exact
+    point that makes the journal-recovery claim falsifiable.  Three
+    targets, one shot each (`marker`-gated across relaunches, like
+    :class:`PeerKiller`):
+
+      * ``target="arbiter"`` — hook this object as ``arbiter.chaos``;
+        the arbiter calls :meth:`on_journal` RIGHT AFTER each journal
+        commit, so ``at_phase="shrink"`` kills the arbiter process
+        (``mode="kill"`` → `os._exit(9)`) with the phase-1 intent
+        durable but zero side effects executed — the canonical
+        between-phases crash a relaunch must replay;
+      * ``target="gang"`` — pass as an `ElasticTrainer` hook (or call
+        :meth:`step_hook` directly from a step loop); it fires
+        (kill/hang) the first step a ``shrink-request.json`` naming this
+        worker's LIVE rank sits in `control_dir` — the gang rank dying
+        mid-shrink-window, which must compose with the coordinator's
+        ``GangReformed`` eviction;
+      * ``target="replica"`` — ``arm(replica)`` wraps the replica's
+        compiled-run entry point to sleep `duration_s` on every call
+        (a replica hung mid-drain: `release_slice`'s drain deadline
+        must expire and release the slice anyway).
+
+    Counts ``chaos_faults_injected_total{kind="handoff-<target>-<mode>"}``.
+    """
+
+    def __init__(self, target: str = "arbiter", mode: str = "kill",
+                 at_phase: str = "shrink", direction: Optional[str] = None,
+                 rank: Optional[int] = None, duration_s: float = 30.0,
+                 control_dir: Optional[str] = None,
+                 marker: Optional[str] = None):
+        if target not in ("arbiter", "gang", "replica"):
+            raise ValueError(f"unknown HandoffChaos target {target!r}")
+        if mode not in ("kill", "hang"):
+            raise ValueError(f"unknown HandoffChaos mode {mode!r}")
+        self.target = target
+        self.mode = mode
+        self.at_phase = at_phase
+        self.direction = direction
+        self.rank = rank
+        self.duration_s = float(duration_s)
+        self.control_dir = control_dir
+        self.marker = marker
+        self.fired = False
+        self._orig = None
+        self._cache = None
+
+    def armed(self) -> bool:
+        if self.fired:
+            return False
+        return self.marker is None or not os.path.exists(self.marker)
+
+    def _fire(self) -> None:
+        self.fired = True
+        if self.marker is not None:
+            with open(self.marker, "w") as f:
+                f.write(f"{self.target}-{self.mode}@{self.at_phase}")
+        _count(f"handoff-{self.target}-{self.mode}")
+        if self.mode == "kill":
+            os._exit(9)
+        time.sleep(self.duration_s)
+
+    # ---- target="arbiter": SliceArbiter.chaos hook ----
+    def on_journal(self, direction: str, phase: str) -> None:
+        """Called by the arbiter immediately after each journal commit
+        (the record for `phase` is durable, its effects are not)."""
+        if self.target != "arbiter" or not self.armed():
+            return
+        if phase != self.at_phase:
+            return
+        if self.direction is not None and direction != self.direction:
+            return
+        self._fire()
+
+    # ---- target="gang": victim-rank step hook ----
+    def __call__(self, trainer) -> None:
+        """`ElasticTrainer` hook form of :meth:`step_hook`: reads the
+        live gang rank off the trainer (reformations remap ranks) and
+        the control dir from `control_dir` or the trainer itself."""
+        mesh = PeerKiller._mesh_of(trainer)
+        rank = mesh.rank if mesh is not None else 0
+        control_dir = self.control_dir \
+            if self.control_dir is not None \
+            else getattr(trainer, "control_dir", None)
+        if control_dir is not None:
+            self.step_hook(control_dir, rank)
+
+    def step_hook(self, control_dir: str, rank: int) -> None:
+        """Call once per training step on every worker; fires on the
+        worker whose rank a pending shrink request names."""
+        if self.target != "gang" or not self.armed():
+            return
+        want = self.rank if self.rank is not None else rank
+        if rank != want:
+            return
+        path = os.path.join(control_dir, "shrink-request.json")
+        try:
+            with open(path) as f:
+                req = json.load(f)
+        except (OSError, ValueError):
+            return
+        if int(req.get("rank", -1)) != rank:
+            return
+        self._fire()
+
+    # ---- target="replica": hang the compiled-run entry point ----
+    def arm(self, replica):
+        """Wrap `replica.server.cache.run` to hang every dispatch — a
+        replica that will never finish draining."""
+        if self.target != "replica":
+            raise ValueError("arm() is for target='replica'")
+        if self._cache is not None:
+            raise RuntimeError("HandoffChaos is already armed")
+        self._cache = replica.server.cache
+        self._orig = self._cache.run
+        self._cache.run = self._run
+        return replica
+
+    def restore(self) -> None:
+        if self._cache is not None and self._orig is not None:
+            self._cache.run = self._orig
+        self._cache = self._orig = None
+
+    def _run(self, *args, **kwargs):
+        if self.armed():
+            self._fire()
+        elif self.mode == "hang" and self.fired:
+            time.sleep(self.duration_s)     # keep hanging: every dispatch
+        return self._orig(*args, **kwargs)

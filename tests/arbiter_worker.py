@@ -1,0 +1,86 @@
+"""Pod-arbiter journal-recovery worker (spawned by test_arbiter — NOT a
+pytest file).
+
+Phase ``run``: build a real seeded net + CheckpointManager, a
+LocalElasticGang over slices [0, 1], a virtual-slice ModelFleet sharing
+`workdir`, and a SliceArbiter with `HandoffChaos(target="arbiter",
+mode="kill", at_phase="shrink")` hooked in — `to_serving()` journals the
+phase-1 intent and the chaos hook `os._exit(9)`s the process with the
+record durable and ZERO side effects executed.
+
+Phase ``recover``: a fresh process over the SAME journal path — the
+arbiter's constructor replays the in-flight handoff (the marker file
+keeps the chaos from re-firing), the shrink + lease actually execute,
+and the result JSON lets the parent assert single ownership, a counted
+replay, and a coordinated checkpoint rewind.
+
+argv: workdir phase(run|recover)
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+from deeplearning4j_tpu.nn import (DenseLayer, InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.serving import ModelFleet
+from deeplearning4j_tpu.serving.slo import ArbiterPolicy
+from deeplearning4j_tpu.train.arbiter import LocalElasticGang, SliceArbiter
+from deeplearning4j_tpu.train.resilience import CheckpointManager
+from deeplearning4j_tpu.train.updaters import Sgd
+from deeplearning4j_tpu.utils.chaos import HandoffChaos
+
+workdir = sys.argv[1]
+phase = sys.argv[2]
+journal = os.path.join(workdir, "journal.json")
+marker = os.path.join(workdir, "chaos_once")
+
+
+def _net():
+    conf = (NeuralNetConfiguration.builder().seed(11).updater(Sgd(0.1))
+            .list([DenseLayer(n_out=8, activation="tanh"),
+                   OutputLayer(n_out=2, loss="mcxent",
+                               activation="softmax")])
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+net = _net()
+manager = CheckpointManager(os.path.join(workdir, "ckpt"), keep_last=50,
+                            save_every_steps=None)
+# one real step so the checkpoint the shrink commits is non-trivial
+rng = np.random.RandomState(3)
+x = rng.randn(6, 4).astype(np.float32)
+y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+net.fit(x, y)
+
+gang = LocalElasticGang(net, manager, slices=[0, 1])
+fleet = ModelFleet(max_resident=1, n_slices=1,
+                   cache_dir=os.path.join(workdir, "exec-cache"),
+                   registry_=MetricsRegistry())
+arb = SliceArbiter(journal, training=gang, fleet=fleet,
+                   policy=ArbiterPolicy(min_training_slices=1),
+                   registry_=MetricsRegistry())
+
+if phase == "run":
+    arb.chaos = HandoffChaos(target="arbiter", mode="kill",
+                             at_phase="shrink", marker=marker)
+    arb.to_serving()                    # chaos kills us after phase-1
+    print("UNREACHABLE: chaos did not fire", flush=True)
+    sys.exit(3)
+
+# phase == "recover": the constructor already replayed (recover=True)
+result = {
+    "recovered": arb.recovered,
+    "describe": arb.describe(),
+    "gang_held": gang.held_slices(),
+    "gang_events": gang.events,
+    "ckpt_latest": manager.latest_step(),
+    "fleet_free": fleet._available_slices(),
+    "marker_exists": os.path.exists(marker),
+}
+with open(os.path.join(workdir, "recover_result.json"), "w") as f:
+    json.dump(result, f)
+print("recover ok", flush=True)

@@ -1,0 +1,116 @@
+"""Arbiter-driven elastic-gang worker (spawned by test_arbiter via
+ElasticLocalRunner.run_elastic — NOT a pytest file).
+
+Same deterministic gang-sharded training as mh_worker_elastic_gang, but
+the trainer opts into the pod arbiter's control-dir shrink protocol
+(`ElasticTrainer(control_dir=...)`): the parent test pre-places a
+``shrink-request.json`` naming a victim rank, the coordinator commits a
+blocking checkpoint and evicts that rank at the coordinated resume step,
+and writes ``shrink-ack.json``.  With `chaos_rank >= 0` a
+`HandoffChaos(target="gang", mode="kill")` hook hard-kills the victim
+THE MOMENT the request names it — racing the coordinator's eviction, so
+the run exercises "gang rank dies mid-shrink-window": whichever side
+wins, the gang must re-form to world-1 once and the survivors must end
+bitwise-identical.
+
+argv: out_dir steps_per_epoch epochs control_dir chaos_rank
+  chaos_rank -1 disables the chaos hook
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import DataSetIterator
+from deeplearning4j_tpu.nn import (DenseLayer, InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.parallel.hierarchical import (
+    HierarchicalGradientSharing)
+from deeplearning4j_tpu.parallel.multihost import ENV_CKPT, ENV_PID
+from deeplearning4j_tpu.parallel.transport import (GangEvictedError,
+                                                   PeerUnreachableError)
+from deeplearning4j_tpu.train.resilience import (CheckpointManager,
+                                                 ElasticTrainer)
+from deeplearning4j_tpu.train.updaters import Sgd
+from deeplearning4j_tpu.utils.chaos import HandoffChaos
+
+out_dir = sys.argv[1]
+steps_per_epoch = int(sys.argv[2])
+epochs = int(sys.argv[3])
+control_dir = sys.argv[4]
+chaos_rank = int(sys.argv[5])
+
+rank = int(os.environ.get(ENV_PID, "0"))
+ckpt_dir = os.environ[ENV_CKPT]
+
+N_IN, N_OUT, GLOBAL_BATCH = 16, 3, 12
+
+conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.1))
+        .list([DenseLayer(n_out=32, activation="tanh"),
+               OutputLayer(n_out=N_OUT, loss="mcxent",
+                           activation="softmax")])
+        .set_input_type(InputType.feed_forward(N_IN)).build())
+net = MultiLayerNetwork(conf).init()
+net.set_gradient_sharing(HierarchicalGradientSharing(
+    threshold=5e-3, elastic=True))
+
+
+class GangShardIterator(DataSetIterator):
+    """Deterministic global stream, live-rank strided shards (same
+    stream contract as mh_worker_elastic_gang)."""
+
+    def __init__(self, model, steps: int):
+        self.model = model
+        self.steps = int(steps)
+
+    def __iter__(self):
+        for i in range(self.steps):
+            seed = 1000 + int(self.model.epoch) * self.steps + i
+            rng = np.random.RandomState(seed)
+            xg = rng.randn(GLOBAL_BATCH, N_IN).astype(np.float32)
+            labels = ((xg[:, 0] > 0).astype(int)
+                      + (xg[:, 1] > 0).astype(int))
+            yg = np.eye(N_OUT, dtype=np.float32)[labels]
+            sharing = self.model.gradient_sharing
+            r, w = sharing.rank, sharing.world
+            yield DataSet(xg[r::w], yg[r::w])
+
+    def __len__(self):
+        return self.steps
+
+    def batch_size(self) -> int:
+        return GLOBAL_BATCH
+
+
+manager = CheckpointManager(ckpt_dir, keep_last=200,
+                            save_every_steps=1 if rank == 0 else None)
+hooks = []
+if chaos_rank >= 0:
+    hooks.append(HandoffChaos(
+        target="gang", mode="kill", rank=chaos_rank,
+        control_dir=control_dir,
+        marker=os.path.join(out_dir, "chaos_once")))
+trainer = ElasticTrainer(net, manager, policy="shrink", rejoin_wait_s=60.0,
+                         hooks=hooks, save_initial=(rank == 0),
+                         control_dir=control_dir if rank == 0 else None)
+data = GangShardIterator(net, steps_per_epoch)
+try:
+    trainer.fit(data, epochs=epochs)
+except (GangEvictedError, PeerUnreachableError) as e:
+    print(f"rank {rank}: left the gang: {e}", flush=True)
+    net.set_gradient_sharing(None)
+    sys.exit(7)
+
+stats = net.gradient_sharing.stats()
+np.savez(os.path.join(out_dir, f"final_{rank}.npz"),
+         params=np.asarray(net.params()),
+         iteration=np.int64(net.iteration),
+         score=np.float64(net.score()))
+with open(os.path.join(out_dir, f"elastic_{rank}.json"), "w") as f:
+    json.dump({"stats": stats, "reformations": trainer.reformations}, f)
+net.set_gradient_sharing(None)
+print(f"rank {rank}: done at iteration {net.iteration} "
+      f"(world={stats['world']}, generation={stats['generation']})",
+      flush=True)

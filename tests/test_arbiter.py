@@ -1,0 +1,596 @@
+"""Pod-arbiter contract (ISSUE 20 acceptance): crc-guarded handoff
+journal, two-phase slice handoffs between an elastic gang and a serving
+fleet with journal-before-side-effect ordering, idempotent journal
+replay after a mid-handoff kill (subprocess kill-and-relaunch), the
+fleet controller's lease-table check (a slice journaled for return to
+training is invisible to growth), the hung-replica drain-deadline
+release, the `ElasticTrainer` control-dir shrink protocol against a real
+3-process gang, and the gang-rank-killed-mid-shrink composition."""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+from deeplearning4j_tpu.nn import (DenseLayer, InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration, OutputLayer)
+from deeplearning4j_tpu.serving import ModelFleet, RejectedError
+from deeplearning4j_tpu.serving.fleet import FleetController
+from deeplearning4j_tpu.serving.slo import ArbiterPolicy
+from deeplearning4j_tpu.train.arbiter import (ArbiterBusyError,
+                                              GangControlClient,
+                                              HandoffAbortedError,
+                                              HandoffJournal,
+                                              JournalCorruptError,
+                                              LocalElasticGang, SliceArbiter)
+from deeplearning4j_tpu.train.resilience import CheckpointManager
+from deeplearning4j_tpu.train.updaters import Sgd
+from deeplearning4j_tpu.utils.chaos import HandoffChaos
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+# ---------------------------------------------------------------------------
+# shared fakes (the unit tests; subprocess tests use the real stack)
+# ---------------------------------------------------------------------------
+
+class FakeManager:
+    """Checkpoint manager double: monotone steps, records ordering."""
+
+    def __init__(self):
+        self.step = 0
+        self.saves = []
+        self.restores = []
+
+    def save(self, model, block=False, **kw):
+        assert block, "the arbiter path must use BLOCKING saves"
+        self.step += 1
+        self.saves.append(self.step)
+
+    def latest_step(self):
+        return self.step
+
+    def restore(self, model, step=None):
+        self.restores.append(step)
+
+
+class FakeFleet:
+    """Fleet double implementing just the lease API the arbiter uses."""
+
+    def __init__(self):
+        self.leases = {}
+        self.released = []
+        self.n = 0
+
+    def lease_slice(self, devices=None, tag=None):
+        if tag in self.leases:
+            return self.leases[tag]
+        self.n += 1
+        self.leases[tag] = self.n
+        return self.n
+
+    def release_slice(self, index, timeout=None):
+        self.released.append((index, timeout))
+        return {"slice": index, "drained": [], "evicted": [],
+                "drain_expired": []}
+
+
+def _arbiter(tmp_path, slices=(0, 1, 2), fleet=None, **policy_kw):
+    policy_kw.setdefault("min_training_slices", 1)
+    gang = LocalElasticGang(object(), FakeManager(), list(slices))
+    arb = SliceArbiter(str(tmp_path / "journal.json"), training=gang,
+                       fleet=fleet if fleet is not None else FakeFleet(),
+                       policy=ArbiterPolicy(**policy_kw),
+                       registry_=MetricsRegistry())
+    return arb, gang
+
+
+def _net(seed=0, n_in=8, n_out=3):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+            .list([DenseLayer(n_out=16, activation="relu"),
+                   OutputLayer(n_out=n_out, loss="mcxent",
+                               activation="softmax")])
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+# ---------------------------------------------------------------------------
+# journal
+# ---------------------------------------------------------------------------
+
+def test_journal_roundtrip_atomic_and_corruption(tmp_path):
+    j = HandoffJournal(str(tmp_path / "j.json"))
+    assert j.load() is None                         # no journal yet
+    state = {"seq": 3, "leases": {"0": "training"}, "handoff": None}
+    j.commit(state)
+    assert j.load() == state
+    assert not os.path.exists(j.path + ".tmp")      # replaced, not left
+
+    # crc guards the state body: a flipped byte refuses to load
+    with open(j.path) as f:
+        payload = json.load(f)
+    payload["state"]["seq"] = 4                     # body no longer matches
+    with open(j.path, "w") as f:
+        json.dump(payload, f)
+    with pytest.raises(JournalCorruptError, match="crc"):
+        j.load()
+
+    # torn JSON refuses to load rather than half-applying
+    with open(j.path, "w") as f:
+        f.write('{"format": 1, "state"')
+    with pytest.raises(JournalCorruptError, match="unreadable"):
+        j.load()
+
+    # future format refuses outright
+    with open(j.path, "w") as f:
+        json.dump({"format": 99, "state": state, "crc32": 0}, f)
+    with pytest.raises(JournalCorruptError, match="format"):
+        j.load()
+
+
+def test_arbiter_policy_validation():
+    with pytest.raises(ValueError, match="grant_at_forecast"):
+        ArbiterPolicy(grant_at_forecast=0.0)
+    with pytest.raises(ValueError, match="return_below_forecast"):
+        ArbiterPolicy(grant_at_forecast=1.0, return_below_forecast=1.5)
+    with pytest.raises(ValueError, match="min_training_slices"):
+        ArbiterPolicy(min_training_slices=0)
+    with pytest.raises(ValueError, match="drain_timeout_s"):
+        ArbiterPolicy(drain_timeout_s=0.0)
+    with pytest.raises(ValueError, match="cooldown_s"):
+        ArbiterPolicy(cooldown_s=-1.0)
+
+
+# ---------------------------------------------------------------------------
+# two-phase handoffs (fakes)
+# ---------------------------------------------------------------------------
+
+def test_full_handoff_cycle_updates_leases_journal_and_metrics(tmp_path):
+    arb, gang = _arbiter(tmp_path)
+    fleet = arb.fleet
+    assert arb.owner_counts() == {"training": 3, "serving": 0,
+                                  "transit": 0}
+
+    out = arb.to_serving()
+    assert out["outcome"] == "committed" and out["direction"] == "to_serving"
+    assert out["slice"] == 2                # highest index moves first
+    assert out["resume_step"] == 1          # blocking save happened
+    assert gang.held_slices() == [0, 1]
+    assert gang.manager.restores == [1]     # coordinated rewind
+    assert arb.fleet_index_of(2) == 1
+    assert arb.owners()[2] == "serving"
+    # durable: a fresh journal reader sees the committed lease table
+    assert HandoffJournal(arb.journal.path).load()["leases"]["2"] \
+        == "serving"
+
+    back = arb.to_training()
+    assert back["outcome"] == "committed"
+    assert back["slice"] == 2
+    assert fleet.released == [(1, arb.policy.drain_timeout_s)]
+    assert gang.held_slices() == [0, 1, 2]
+    assert arb.owner_counts() == {"training": 3, "serving": 0,
+                                  "transit": 0}
+    assert arb.fleet_index_of(2) is None
+
+    reg = arb._ins._reg
+    fams = set(reg.families())
+    assert {"arbiter_handoffs_total", "arbiter_handoff_ms",
+            "arbiter_slices", "arbiter_journal_replays_total",
+            "arbiter_leases"} <= fams
+    by_labels = {tuple(sorted(lbl.items())): c.value
+                 for lbl, c in reg.children("arbiter_handoffs_total")}
+    assert by_labels[(("direction", "to_serving"),
+                      ("outcome", "committed"))] == 1
+    assert by_labels[(("direction", "to_training"),
+                      ("outcome", "committed"))] == 1
+    owners = {lbl["owner"]: g.value
+              for lbl, g in reg.children("arbiter_slices")}
+    assert owners == {"training": 3, "serving": 0, "transit": 0}
+
+
+def test_policy_floors_and_busy_guard(tmp_path):
+    arb, _ = _arbiter(tmp_path, slices=(0, 1), min_training_slices=1,
+                      max_fleet_leases=1)
+    arb.to_serving()
+    # training floor: the last slice never leaves
+    with pytest.raises(ValueError, match="min_training_slices"):
+        arb.to_serving()
+    arb.to_training()
+    arb2, _ = _arbiter(tmp_path / "b", slices=(0, 1, 2),
+                       max_fleet_leases=1)
+    arb2.to_serving()
+    with pytest.raises(ValueError, match="max_fleet_leases"):
+        arb2.to_serving()
+    # moving a slice the named owner does not hold (slice 0 is training)
+    with pytest.raises(ValueError, match="owned by"):
+        arb2.to_training(pod_slice=0)
+    # one handoff at a time (white-box: pin an in-flight record)
+    arb2._state["handoff"] = {"id": "hX", "direction": "to_serving",
+                              "slice": 0, "phase": "shrink"}
+    with pytest.raises(ArbiterBusyError):
+        arb2.to_serving()
+    with pytest.raises(ArbiterBusyError):
+        arb2.to_training()
+
+
+def test_maybe_rebalance_hysteresis_and_cooldown(tmp_path):
+    arb, _ = _arbiter(tmp_path, grant_at_forecast=1.5,
+                      return_below_forecast=0.5, cooldown_s=30.0)
+    out = arb.maybe_rebalance(pressure=2.0)
+    assert out is not None and out["direction"] == "to_serving"
+    # cooldown: even at spike pressure, no immediate second move
+    assert arb.maybe_rebalance(pressure=5.0) is None
+    arb._last_handoff_at = time.monotonic() - 60.0
+    assert arb.maybe_rebalance(pressure=1.0) is None    # hysteresis band
+    out = arb.maybe_rebalance(pressure=0.1)
+    assert out is not None and out["direction"] == "to_training"
+    arb._last_handoff_at = time.monotonic() - 60.0
+    assert arb.maybe_rebalance(pressure=0.0) is None    # nothing leased
+
+
+def test_aborted_handoff_rolls_lease_back(tmp_path):
+    """A gang that never acks aborts the handoff with no side effects:
+    the journal rolls back to the previous owner and the fleet never
+    sees a lease."""
+    client = GangControlClient(str(tmp_path / "ctl"), slices=[0, 1],
+                               timeout_s=0.2, poll_s=0.02)
+    fleet = FakeFleet()
+    arb = SliceArbiter(str(tmp_path / "j.json"), training=client,
+                       fleet=fleet, policy=ArbiterPolicy(),
+                       registry_=MetricsRegistry())
+    with pytest.raises(HandoffAbortedError, match="did not ack"):
+        arb.to_serving()
+    assert arb.owners() == {0: "training", 1: "training"}
+    assert arb.describe()["handoff"] is None
+    assert fleet.leases == {}
+    reg = arb._ins._reg
+    by_labels = {tuple(sorted(lbl.items())): c.value
+                 for lbl, c in reg.children("arbiter_handoffs_total")}
+    assert by_labels[(("direction", "to_serving"),
+                      ("outcome", "aborted"))] == 1
+    # and the arbiter is NOT wedged: a later handoff works
+    ctl2 = tmp_path / "ctl"
+
+    def _coordinator_acks():
+        req_path = ctl2 / GangControlClient.REQUEST
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if req_path.exists():
+                req = json.loads(req_path.read_text())
+                (ctl2 / GangControlClient.ACK).write_text(json.dumps(
+                    {"request_id": req["id"], "resume_step": 7,
+                     "generation": 2, "world": 1, "rank": req["rank"]}))
+                return
+            time.sleep(0.01)
+
+    client.timeout_s = 5.0
+    t = threading.Thread(target=_coordinator_acks, daemon=True)
+    t.start()
+    out = arb.to_serving()
+    t.join(timeout=5.0)
+    assert out["outcome"] == "committed" and out["resume_step"] == 7
+    assert arb.owners()[out["slice"]] == "serving"
+
+
+def test_gang_control_client_error_ack_raises(tmp_path):
+    ctl = tmp_path / "ctl"
+    client = GangControlClient(str(ctl), slices=[0, 1], timeout_s=5.0,
+                               poll_s=0.02)
+
+    def _refuse():
+        req_path = ctl / GangControlClient.REQUEST
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if req_path.exists():
+                req = json.loads(req_path.read_text())
+                (ctl / GangControlClient.ACK).write_text(json.dumps(
+                    {"request_id": req["id"],
+                     "error": "rank 1 not evictable"}))
+                return
+            time.sleep(0.01)
+
+    t = threading.Thread(target=_refuse, daemon=True)
+    t.start()
+    with pytest.raises(HandoffAbortedError, match="refused"):
+        client.shrink(1)
+    t.join(timeout=5.0)
+    assert client.held_slices() == [0, 1]   # nothing moved
+
+
+# ---------------------------------------------------------------------------
+# LocalElasticGang against the real checkpoint manager
+# ---------------------------------------------------------------------------
+
+def test_local_gang_shrink_then_readmit_is_bitwise_stable(tmp_path):
+    net = _net(seed=5)
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 8).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+    net.fit(x, y)
+    manager = CheckpointManager(str(tmp_path / "ckpt"), keep_last=20,
+                                save_every_steps=None)
+    gang = LocalElasticGang(net, manager, slices=[0, 1])
+    before = np.asarray(net.params()).copy()
+
+    info = gang.shrink(1)
+    assert info["world"] == 1 and info["generation"] == 1
+    assert info["resume_step"] == int(manager.latest_step())
+    # save-then-pinned-restore round-trips the params bitwise
+    np.testing.assert_array_equal(before, np.asarray(net.params()))
+
+    info = gang.readmit(1)
+    assert info["world"] == 2 and info["generation"] == 2
+    np.testing.assert_array_equal(before, np.asarray(net.params()))
+    # idempotency (journal replay re-runs executors)
+    assert gang.shrink(5).get("already")
+    assert gang.readmit(1).get("already")
+    assert gang.held_slices() == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# fleet lease table: growth must never grab a slice in transit
+# ---------------------------------------------------------------------------
+
+class _BlockingArbiter:
+    """Arbiter double exposing just the lease-table query."""
+
+    def __init__(self, blocked=()):
+        self.blocked = set(blocked)
+
+    def blocked_fleet_slices(self):
+        return frozenset(self.blocked)
+
+
+def test_reconcile_growth_skips_arbiter_blocked_slice(tmp_path):
+    """The race ISSUE 20 names: a slice journaled for return to
+    training sits in the fleet's free list while the drain runs.  A
+    reconcile growth action racing the handoff must not place onto it —
+    without the `_available_slices` check in `_free_or_reclaimed_slice`
+    this test fails by growing onto the blocked slice."""
+    fleet = ModelFleet(max_resident=1, n_slices=2,
+                       cache_dir=str(tmp_path / "exec-cache"),
+                       registry_=MetricsRegistry())
+    fleet.deploy("m", model=_net(), input_shape=(8,), warm=True)
+    m = fleet.member("m")
+    used = m.group.replicas[0].slice.index
+    free = 1 - used
+    assert fleet._free_slices == [free]
+
+    arb = _BlockingArbiter(blocked={free})
+    fleet.attach_arbiter(arb)
+    controller = FleetController(fleet)
+    # white-box into the exact decision point reconcile's grow path uses
+    with fleet._admission_lock:
+        got = controller._free_or_reclaimed_slice(
+            m, fleet.pool.resident(), [])
+    assert got is None, ("growth grabbed a slice journaled for return "
+                         "to training")
+    assert fleet._free_slices == [free]     # still free, still blocked
+    with pytest.raises(RejectedError):
+        with fleet._admission_lock:
+            fleet._take_slice()
+
+    # handoff completes -> unblocked -> the same call now grants it
+    arb.blocked.clear()
+    with fleet._admission_lock:
+        got = controller._free_or_reclaimed_slice(
+            m, fleet.pool.resident(), [])
+    assert got is not None and got.index == free
+    fleet.shutdown()
+
+
+def test_lease_slice_idempotent_by_tag_and_release_idempotent(tmp_path):
+    fleet = ModelFleet(max_resident=1, n_slices=1,
+                       cache_dir=str(tmp_path / "exec-cache"),
+                       registry_=MetricsRegistry())
+    idx = fleet.lease_slice(tag="pod-3")
+    assert idx == 1 and idx in fleet._free_slices
+    assert fleet.lease_slice(tag="pod-3") == idx    # replayed grant
+    assert fleet._free_slices.count(idx) == 1
+    assert fleet.lease_slice(tag="pod-4") == 2      # distinct lease
+
+    out = fleet.release_slice(idx, timeout=0.5)
+    assert idx not in fleet._free_slices
+    assert out["drained"] == [] and out["evicted"] == []
+    out = fleet.release_slice(idx, timeout=0.5)     # replayed release
+    assert out["drained"] == [] and out["evicted"] == []
+    out = fleet.release_slice(99)                   # unknown: no-op
+    assert out["slice"] == 99
+    fleet.shutdown()
+
+
+def test_release_slice_hung_replica_expires_drain_and_frees_slice(
+        tmp_path):
+    """ISSUE 20 chaos path (c): a replica hung mid-drain cannot pin the
+    slice — the drain deadline expires, the replica is force-shut, and
+    the slice is still released."""
+    fleet = ModelFleet(max_resident=1, n_slices=1, batch_timeout_ms=1.0,
+                       cache_dir=str(tmp_path / "exec-cache"),
+                       registry_=MetricsRegistry())
+    fleet.deploy("m", model=_net(), input_shape=(8,), warm=True)
+    m = fleet.member("m")
+    leased = fleet.lease_slice(tag="pod-1")
+    with fleet._admission_lock:
+        slice_ = fleet._take_slice([leased])
+        assert slice_.index == leased
+        m.group.replicas.append(fleet._build_replica(m, slice_))
+    victim = m.group.replicas[-1]
+
+    from deeplearning4j_tpu.monitor.registry import registry as global_reg
+
+    def _chaos_count():
+        return sum(c.value for lbl, c in
+                   global_reg().children("chaos_faults_injected_total")
+                   if lbl["kind"] == "handoff-replica-hang")
+
+    before = _chaos_count()
+    chaos = HandoffChaos(target="replica", mode="hang", duration_s=20.0)
+    chaos.arm(victim)
+    x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+    victim.server.submit("m", x)            # in-flight work to hang on
+    deadline = time.monotonic() + 10.0
+    while not chaos.fired and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert chaos.fired, "chaos hang never engaged"
+
+    t0 = time.monotonic()
+    out = fleet.release_slice(leased, timeout=0.5)
+    took = time.monotonic() - t0
+    assert out["drain_expired"] == [victim.name]
+    assert took < 10.0                      # deadline, not the full hang
+    assert leased not in fleet._free_slices
+    assert victim not in m.group.replicas   # out of routing first
+    assert _chaos_count() == before + 1     # fault was counted
+    chaos.restore()
+    fleet.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# journal recovery: kill-and-relaunch subprocess tests
+# ---------------------------------------------------------------------------
+
+def _run_worker(args, timeout=240):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    repo_root = os.path.dirname(HERE)
+    extra = [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+    env["PYTHONPATH"] = os.pathsep.join([repo_root] + extra)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(HERE, "arbiter_worker.py")]
+        + [str(a) for a in args],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    return proc
+
+
+@pytest.mark.slow
+def test_arbiter_killed_between_journal_phases_relaunch_replays(tmp_path):
+    """ISSUE 20 chaos path (a): the arbiter process is hard-killed right
+    after the phase-1 journal write (intent durable, zero side effects).
+    A relaunched arbiter over the same journal resumes the handoff:
+    the shrink executes, the lease is granted, the slice ends
+    single-owned, and the replay is counted."""
+    workdir = tmp_path / "pod"
+    workdir.mkdir()
+    proc = _run_worker([workdir, "run"])
+    assert proc.returncode == 9, proc.stdout + proc.stderr
+
+    # the durable phase-1 record: handoff in flight, slice in transit,
+    # gang untouched
+    state = HandoffJournal(str(workdir / "journal.json")).load()
+    assert state["handoff"]["phase"] == "shrink"
+    assert state["handoff"]["direction"] == "to_serving"
+    assert state["leases"][str(state["handoff"]["slice"])] == "transit"
+
+    proc = _run_worker([workdir, "recover"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    with open(workdir / "recover_result.json") as f:
+        result = json.load(f)
+    assert result["recovered"]["outcome"] == "replayed"
+    assert result["describe"]["replays"] == 1
+    assert result["describe"]["handoff"] is None
+    moved = result["recovered"]["slice"]
+    assert result["describe"]["leases"][str(moved)] == "serving"
+    assert moved not in result["gang_held"]         # single-owned
+    assert str(moved) in result["describe"]["fleet_index"] \
+        or moved in [int(k) for k in result["describe"]["fleet_index"]]
+    # the replayed shrink committed a checkpoint and rewound to it
+    assert result["gang_events"][0]["resume_step"] == result["ckpt_latest"]
+    assert result["marker_exists"]                  # chaos stayed one-shot
+
+    # final journal is clean: a THIRD process sees no handoff in flight
+    state = HandoffJournal(str(workdir / "journal.json")).load()
+    assert state["handoff"] is None
+    assert state["replays"] == 1
+
+
+def _read_acks(control_dir):
+    try:
+        with open(os.path.join(control_dir, "shrink-ack.json")) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+@pytest.mark.slow
+def test_control_dir_shrink_protocol_coordinated_eviction(tmp_path):
+    """A real 3-process gang honors a pre-placed shrink request: the
+    coordinator blocking-saves, evicts the named rank at that step
+    (cause ``shrink``), acks with the resume step, and the survivors
+    finish bitwise-identical."""
+    from deeplearning4j_tpu.parallel.multihost import ElasticLocalRunner
+    script = os.path.join(HERE, "mh_worker_arbiter_gang.py")
+    out, ctl = tmp_path / "out", tmp_path / "ctl"
+    out.mkdir()
+    ctl.mkdir()
+    (ctl / "shrink-request.json").write_text(
+        json.dumps({"id": "req-test-1", "rank": 2}))
+    runner = ElasticLocalRunner(num_processes=3, backoff_base_s=0.2)
+    results = runner.run_elastic(
+        script, [str(out), "8", "1", str(ctl), "-1"], timeout=420,
+        checkpoint_dir=str(tmp_path / "ckpt"), policy="shrink",
+        heartbeat_s=0.1, failure_deadline_s=2.0, relaunch=False)
+    assert results["r0"][0] == 0, results["r0"][1][-2000:]
+    assert results["r1"][0] == 0, results["r1"][1][-2000:]
+    assert results["r2"][0] == 7, results["r2"][1][-2000:]  # evicted, parked
+    ack = _read_acks(str(ctl))
+    assert ack is not None and ack["request_id"] == "req-test-1"
+    assert ack.get("error") is None
+    assert ack["rank"] == 2 and ack["world"] == 2
+    with open(out / "elastic_0.json") as f:
+        info0 = json.load(f)
+    reforms = info0["reformations"]
+    assert len(reforms) == 1
+    assert reforms[0]["cause"] == "shrink"
+    assert reforms[0]["world"] == 2
+    assert reforms[0]["resume_step"] == ack["resume_step"]
+    final0 = np.load(out / "final_0.npz")
+    final1 = np.load(out / "final_1.npz")
+    np.testing.assert_array_equal(final0["params"], final1["params"])
+    assert int(final0["iteration"]) == 8
+
+
+@pytest.mark.slow
+def test_gang_rank_killed_mid_shrink_composes_with_eviction(tmp_path):
+    """ISSUE 20 chaos path (b): the victim rank is hard-killed inside
+    the shrink window (a HandoffChaos gang hook fires the moment the
+    request names it), racing the coordinator's coordinated eviction.
+    Whichever side wins, the gang re-forms to world 2 exactly once, an
+    ack is written (coordinated, or an error ack when the crash-reform
+    got there first), and the survivors end bitwise-identical."""
+    from deeplearning4j_tpu.parallel.multihost import ElasticLocalRunner
+    script = os.path.join(HERE, "mh_worker_arbiter_gang.py")
+    out, ctl = tmp_path / "out", tmp_path / "ctl"
+    out.mkdir()
+    ctl.mkdir()
+    (ctl / "shrink-request.json").write_text(
+        json.dumps({"id": "req-test-2", "rank": 2}))
+    runner = ElasticLocalRunner(num_processes=3, backoff_base_s=0.2)
+    results = runner.run_elastic(
+        script, [str(out), "8", "1", str(ctl), "2"], timeout=420,
+        checkpoint_dir=str(tmp_path / "ckpt"), policy="shrink",
+        heartbeat_s=0.1, failure_deadline_s=2.0, relaunch=False)
+    assert results["r0"][0] == 0, results["r0"][1][-2000:]
+    assert results["r1"][0] == 0, results["r1"][1][-2000:]
+    assert results["r2"][0] in (7, 9), results["r2"][1][-2000:]
+    with open(out / "elastic_0.json") as f:
+        info0 = json.load(f)
+    reforms = info0["reformations"]
+    assert len(reforms) == 1, reforms   # composed: ONE world change
+    assert reforms[0]["world"] == 2
+    assert reforms[0]["cause"] in ("shrink", "crash", "partition",
+                                   "straggler")
+    assert info0["stats"]["world"] == 2
+    # an ack always lands: coordinated when the eviction won the race,
+    # an error ack when the crash-reform shrank the world first
+    ack = _read_acks(str(ctl))
+    assert ack is not None and ack["request_id"] == "req-test-2"
+    assert ack.get("error") is not None or ack["resume_step"] >= 0
+    final0 = np.load(out / "final_0.npz")
+    final1 = np.load(out / "final_1.npz")
+    np.testing.assert_array_equal(final0["params"], final1["params"])
+    assert int(final0["iteration"]) == 8

@@ -50,6 +50,9 @@ def _register_everything(reg: MetricsRegistry):
     dec.kv_bytes("m", "int8")
     dec.sequences_active("m")
     dec.restarts("m")
+    arb = I.ArbiterInstruments(reg)
+    arb.handoffs("to_serving", "committed")
+    arb.slices("training")
     # forecaster gauge is minted on the first post-baseline tick
     fc = ArrivalRateForecaster(registry_=reg)
     reg.counter("fleet_requests_total", labels={"model": "m"}).inc(10)
@@ -90,7 +93,8 @@ def test_documented_series_exist():
         prefix = name.split("_")[0]
         if prefix in ("training", "pipeline", "parallel", "resilience",
                       "aot", "comms", "gang", "fleet", "fed", "quant",
-                      "ops", "chaos", "decode") and name not in families:
+                      "ops", "chaos", "decode", "arbiter") \
+                and name not in families:
             stale.append(name)
     assert not stale, f"docs rows reference unknown families: {sorted(set(stale))}"
 
